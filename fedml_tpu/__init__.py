@@ -1,0 +1,25 @@
+"""fedml_tpu — a TPU-native federated learning framework.
+
+A ground-up JAX/XLA re-design with the capabilities of the reference FedML
+library (PyTorch + MPI message passing).  The core inversion: on-TPU,
+"communication" is an XLA collective inside one jit-compiled program — a
+FedAvg round that in the reference is a choreography of MPI messages
+(`fedml_api/distributed/fedavg/FedAvgServerManager.py`) collapses here into a
+single `shard_map`-ped cohort step whose aggregation is a weighted `lax.psum`
+over the ICI mesh.  The message-passing actor layer survives only at the
+cross-silo / host edge (gRPC/MQTT transports in `fedml_tpu.comm`).
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+
+    fedml_tpu.experiments   CLI entry points (parity with fedml_experiments/)
+    fedml_tpu.algorithms    FedAvg/FedOpt/FedProx/FedNova/... (fedml_api/*)
+    fedml_tpu.models        flax model zoo (fedml_api/model/*)
+    fedml_tpu.data          dataset loaders + cohort stacking (data_preprocessing/*)
+    fedml_tpu.core          kernel: aggregation math, sampling, partition,
+                            robustness, topology (fedml_core/*)
+    fedml_tpu.parallel      mesh / shard_map cohort engine (replaces MPI runtime)
+    fedml_tpu.comm          cross-silo transports: Message protocol, local fake,
+                            gRPC, MQTT (fedml_core/distributed/communication/*)
+"""
+
+__version__ = "0.1.0"

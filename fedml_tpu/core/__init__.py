@@ -1,0 +1,16 @@
+from fedml_tpu.core.pytree import (
+    tree_weighted_mean,
+    tree_zeros_like,
+    tree_global_norm,
+    tree_scale,
+    tree_add,
+    tree_sub,
+    tree_vector_norm,
+    tree_cast,
+)
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.partition import (
+    partition_dirichlet,
+    partition_homo,
+    record_data_stats,
+)

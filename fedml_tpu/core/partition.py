@@ -1,0 +1,134 @@
+"""Non-IID data partitioners (host-side, numpy).
+
+Re-implements the reference's two partitioning stacks:
+
+* the core LDA partitioner
+  ``fedml_core/non_iid_partition/noniid_partition.py:6-91`` (classification
+  and multi-label segmentation variants, per-class Dirichlet split with a
+  min-size-10 retry loop), and
+* the cifar-style ``partition_data`` switch
+  (``fedml_api/data_preprocessing/cifar10/data_loader.py:113-161``):
+  ``homo`` uniform split, ``hetero`` Dirichlet split with the
+  capacity-capping trick ``p * (len(idx_j) < N / client_num)``.
+
+Partitioning is inherently host-side and sequential — it runs once at setup —
+so numpy is the right tool; the TPU work starts downstream where the
+resulting per-client index lists are stacked into padded device arrays
+(`fedml_tpu.data.stacking`).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def _dirichlet_split_class(N: int, alpha: float, client_num: int,
+                           idx_batch: List[List[int]], idx_k: np.ndarray,
+                           rng: np.random.RandomState):
+    """One class's Dirichlet allocation (noniid_partition.py:76-91).
+
+    Clients already holding >= N/client_num samples get probability 0 for this
+    class, which bounds the imbalance.
+    """
+    rng.shuffle(idx_k)
+    proportions = rng.dirichlet(np.repeat(alpha, client_num))
+    proportions = np.array(
+        [p * (len(idx_j) < N / client_num) for p, idx_j in zip(proportions, idx_batch)])
+    proportions = proportions / proportions.sum()
+    cuts = (np.cumsum(proportions) * len(idx_k)).astype(int)[:-1]
+    idx_batch = [idx_j + idx.tolist() for idx_j, idx in zip(idx_batch, np.split(idx_k, cuts))]
+    min_size = min(len(idx_j) for idx_j in idx_batch)
+    return idx_batch, min_size
+
+
+def partition_dirichlet(label_list, client_num: int, classes, alpha: float,
+                        task: str = "classification",
+                        seed: int | None = None,
+                        min_size_floor: int = 10) -> Dict[int, np.ndarray]:
+    """LDA partition (noniid_partition.py:6-73).
+
+    ``classes`` is an int (number of classes) for classification or a list of
+    category ids for segmentation (where one instance can hold multiple
+    categories and is assigned by its first matching category).
+    Retries until every client holds at least ``min_size_floor`` samples.
+    """
+    rng = np.random.RandomState(seed) if seed is not None else np.random
+    if task == "segmentation":
+        N = len(label_list)
+    else:
+        label_list = np.asarray(label_list)
+        N = label_list.shape[0]
+
+    min_size = 0
+    while min_size < min_size_floor:
+        idx_batch: List[List[int]] = [[] for _ in range(client_num)]
+        if task == "segmentation":
+            for c, cat in enumerate(classes):
+                if c > 0:
+                    hit = np.asarray([
+                        np.any(label_list[i] == cat)
+                        and not np.any(np.isin(label_list[i], classes[:c]))
+                        for i in range(len(label_list))])
+                else:
+                    hit = np.asarray([np.any(label_list[i] == cat)
+                                      for i in range(len(label_list))])
+                idx_k = np.where(hit)[0]
+                idx_batch, min_size = _dirichlet_split_class(
+                    N, alpha, client_num, idx_batch, idx_k, rng)
+        else:
+            for k in range(int(classes)):
+                idx_k = np.where(label_list == k)[0]
+                idx_batch, min_size = _dirichlet_split_class(
+                    N, alpha, client_num, idx_batch, idx_k, rng)
+
+    out = {}
+    for i in range(client_num):
+        rng.shuffle(idx_batch[i])
+        out[i] = np.asarray(idx_batch[i], dtype=np.int64)
+    return out
+
+
+def partition_homo(n_samples: int, client_num: int,
+                   seed: int | None = None) -> Dict[int, np.ndarray]:
+    """IID split (cifar10/data_loader.py:119-123): shuffle then array_split."""
+    rng = np.random.RandomState(seed) if seed is not None else np.random
+    idxs = rng.permutation(n_samples)
+    return {i: np.sort(part).astype(np.int64)
+            for i, part in enumerate(np.array_split(idxs, client_num))}
+
+
+def partition_from_distribution(labels: Sequence[int],
+                                distribution: Dict[int, Dict[int, int]]
+                                ) -> Dict[int, np.ndarray]:
+    """`hetero-fix` mode: assign counts per (client, class) from a fixed table
+    (cifar10/data_loader.py:150-156 reads these from distribution files)."""
+    labels = np.asarray(labels)
+    per_class = {k: list(np.where(labels == k)[0]) for k in np.unique(labels)}
+    out: Dict[int, List[int]] = {}
+    for cid, cls_counts in distribution.items():
+        take: List[int] = []
+        for k, cnt in cls_counts.items():
+            pool = per_class[k]
+            take.extend(pool[:cnt])
+            del pool[:cnt]
+        out[int(cid)] = np.asarray(take, dtype=np.int64)
+    return out
+
+
+def record_data_stats(y_train, net_dataidx_map: Dict[int, np.ndarray],
+                      task: str = "classification") -> Dict[int, Dict[int, int]]:
+    """Per-client class histograms (noniid_partition.py:96-105)."""
+    y_train = np.asarray(y_train, dtype=object) if task == "segmentation" else np.asarray(y_train)
+    net_cls_counts = {}
+    for net_i, dataidx in net_dataidx_map.items():
+        if task == "segmentation":
+            vals = np.concatenate([np.asarray(y_train[i]).ravel() for i in dataidx])
+        else:
+            vals = y_train[dataidx]
+        unq, unq_cnt = np.unique(vals, return_counts=True)
+        net_cls_counts[net_i] = {int(u): int(c) for u, c in zip(unq, unq_cnt)}
+    logging.debug("Data statistics: %s", net_cls_counts)
+    return net_cls_counts

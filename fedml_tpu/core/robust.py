@@ -1,0 +1,75 @@
+"""Robust aggregation defenses — pure-JAX, fuseable into the aggregation step.
+
+Re-implements ``fedml_core/robustness/robust_aggregation.py``:
+
+* ``clip_update`` = ``RobustAggregator.norm_diff_clipping`` (:38-49): scale a
+  client update so that ||w_client - w_global|| <= norm_bound.
+* ``add_gaussian_noise`` = ``RobustAggregator.add_noise`` (:51-55): weak
+  differential privacy via N(0, stddev) perturbation.
+
+Unlike the reference (torch ops on CPU state_dicts, one client at a time),
+these are jit-able and vmap over a stacked client axis, so the whole cohort's
+defense + aggregation compiles to one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.pytree import tree_sub
+
+Pytree = Any
+
+
+def _masked_global_norm(tree: Pytree, is_weight) -> jax.Array:
+    """L2 norm over leaves selected by ``is_weight(path)``."""
+    total = 0.0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        if is_weight(path):
+            total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return jnp.sqrt(total)
+
+
+def default_is_weight_param(path) -> bool:
+    """Parity with ``is_weight_param`` (robust_aggregation.py:28-30): exclude
+    normalization running statistics from the norm and from clipping.  In
+    flax those live under a ``batch_stats`` collection (keys ``mean``/``var``);
+    we also honor the reference's torch-style key names."""
+    keys = "/".join(str(getattr(p, "key", getattr(p, "idx", ""))) for p in path)
+    return not any(s in keys for s in
+                   ("batch_stats", "running_mean", "running_var",
+                    "num_batches_tracked"))
+
+
+def clip_update(client_params: Pytree, global_params: Pytree,
+                norm_bound: float, is_weight=default_is_weight_param) -> Pytree:
+    """Norm-difference clipping (robust_aggregation.py:38-49).
+
+    weight_diff_norm = ||client - global|| over *weight* leaves only;
+    client' = global + (client-global) * min(1, bound/||diff||).  Non-weight
+    leaves (running statistics) pass through unclipped, as in the reference's
+    ``load_model_weight_diff`` (robust_aggregation.py:12-25).
+    """
+    diff = tree_sub(client_params, global_params)
+    norm = _masked_global_norm(diff, is_weight)
+    scale = jnp.minimum(1.0, norm_bound / jnp.maximum(norm, 1e-12))
+
+    def _apply(path, g, d, c):
+        if is_weight(path):
+            return g + d * scale.astype(d.dtype)
+        return c
+
+    return jax.tree_util.tree_map_with_path(_apply, global_params, diff,
+                                            client_params)
+
+
+def add_gaussian_noise(params: Pytree, key: jax.Array, stddev: float) -> Pytree:
+    """Weak-DP Gaussian noise (robust_aggregation.py:51-55)."""
+    leaves, treedef = jax.tree.flatten(params)
+    keys = jax.random.split(key, len(leaves))
+    noised = [x + stddev * jax.random.normal(k, x.shape, x.dtype)
+              for x, k in zip(leaves, keys)]
+    return jax.tree.unflatten(treedef, noised)

@@ -1,0 +1,32 @@
+"""Per-round client sampling with the reference's seeded determinism.
+
+The reference reseeds numpy with the round index each round so that runs are
+comparable across algorithms (``FedAVGAggregator.client_sampling``,
+fedml_api/distributed/fedavg/FedAVGAggregator.py:89-97).  We reproduce that
+exactly (same sequence of sampled client ids for a given round) so accuracy
+curves line up with published baselines, and also offer a splittable
+jax.random variant for fully-on-device pipelines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+
+def sample_clients(round_idx: int, client_num_in_total: int,
+                   client_num_per_round: int) -> np.ndarray:
+    """Bit-exact port of the reference sampler (FedAVGAggregator.py:89-97)."""
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total, dtype=np.int64)
+    num_clients = min(client_num_per_round, client_num_in_total)
+    rng = np.random.RandomState(round_idx)
+    return rng.choice(range(client_num_in_total), num_clients, replace=False)
+
+
+def sample_clients_jax(key: jax.Array, client_num_in_total: int,
+                       client_num_per_round: int) -> jax.Array:
+    """On-device sampler (trace-safe): permutation-based choice w/o replacement."""
+    num = min(client_num_per_round, client_num_in_total)
+    perm = jax.random.permutation(key, client_num_in_total)
+    return perm[:num]

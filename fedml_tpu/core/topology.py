@@ -1,0 +1,152 @@
+"""Graph topologies for decentralized FL.
+
+Re-implements ``fedml_core/distributed/topology/``:
+``SymmetricTopologyManager`` (symmetric_topology_manager.py:21-52) and
+``AsymmetricTopologyManager`` (asymmetric_topology_manager.py:24-75).
+
+The reference builds its graphs from ``networkx.watts_strogatz_graph(n, k, 0)``
+— with rewiring probability 0 that is exactly a ring lattice where each node
+links to its k//2 nearest neighbors on each side, so we generate the adjacency
+directly in numpy and avoid the networkx dependency.
+
+Execution of a gossip round on TPU does not iterate neighbors: the row-
+stochastic mixing matrix W produced here drives either a dense ``W @ stacked_
+params`` (small n, single chip) or `lax.ppermute` steps over a mesh axis
+(`fedml_tpu.algorithms.decentralized`).
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+
+def ring_lattice_adjacency(n: int, k: int) -> np.ndarray:
+    """Adjacency of watts_strogatz_graph(n, k, p=0): each node connected to the
+    k//2 nearest neighbors on each side (k odd rounds down, per networkx)."""
+    adj = np.zeros((n, n), dtype=np.float32)
+    half = k // 2
+    for offset in range(1, half + 1):
+        for i in range(n):
+            j = (i + offset) % n
+            adj[i, j] = 1.0
+            adj[j, i] = 1.0
+    return adj
+
+
+class BaseTopologyManager(abc.ABC):
+    """SPI parity with base_topology_manager.py:4-24."""
+
+    @abc.abstractmethod
+    def generate_topology(self): ...
+
+    @abc.abstractmethod
+    def get_in_neighbor_idx_list(self, node_index): ...
+
+    @abc.abstractmethod
+    def get_out_neighbor_idx_list(self, node_index): ...
+
+    @abc.abstractmethod
+    def get_in_neighbor_weights(self, node_index): ...
+
+    @abc.abstractmethod
+    def get_out_neighbor_weights(self, node_index): ...
+
+
+class SymmetricTopologyManager(BaseTopologyManager):
+    """Ring + extra symmetric links, row-normalized to a doubly-substochastic
+    mixing matrix (symmetric_topology_manager.py:21-52)."""
+
+    def __init__(self, n: int, neighbor_num: int = 2):
+        self.n = n
+        self.neighbor_num = neighbor_num
+        self.topology = np.zeros((n, n), dtype=np.float32)
+
+    def generate_topology(self):
+        ring = ring_lattice_adjacency(self.n, 2)
+        extra = ring_lattice_adjacency(self.n, int(self.neighbor_num))
+        adj = np.maximum(ring, extra)
+        np.fill_diagonal(adj, 1.0)
+        row_degree = adj.sum(axis=1, keepdims=True)
+        self.topology = adj / row_degree
+        return self.topology
+
+    def get_in_neighbor_weights(self, node_index):
+        if node_index >= self.n:
+            return []
+        return self.topology[node_index]
+
+    def get_out_neighbor_weights(self, node_index):
+        if node_index >= self.n:
+            return []
+        return self.topology[node_index]
+
+    def get_in_neighbor_idx_list(self, node_index):
+        w = self.get_in_neighbor_weights(node_index)
+        return [i for i, wi in enumerate(w) if wi > 0 and i != node_index]
+
+    def get_out_neighbor_idx_list(self, node_index):
+        w = self.get_out_neighbor_weights(node_index)
+        return [i for i, wi in enumerate(w) if wi > 0 and i != node_index]
+
+
+class AsymmetricTopologyManager(BaseTopologyManager):
+    """Symmetric base graph plus randomly added directed links, row-normalized
+    (asymmetric_topology_manager.py:24-75). Rows mix in-neighbors; columns
+    give out-edges."""
+
+    def __init__(self, n: int, undirected_neighbor_num: int = 3,
+                 out_directed_neighbor: int = 3, seed: int | None = None):
+        self.n = n
+        self.undirected_neighbor_num = undirected_neighbor_num
+        self.out_directed_neighbor = out_directed_neighbor
+        self._rng = np.random.RandomState(seed) if seed is not None else np.random
+        self.topology = np.zeros((n, n), dtype=np.float32)
+
+    def generate_topology(self):
+        adj = np.maximum(ring_lattice_adjacency(self.n, 2),
+                         ring_lattice_adjacency(self.n, self.undirected_neighbor_num))
+        np.fill_diagonal(adj, 1.0)
+        # randomly promote some zero entries to directed links, at most once
+        # per (i,j) pair, mirroring the out_link_set bookkeeping in the
+        # reference (asymmetric_topology_manager.py:45-61)
+        out_link_set = set()
+        for i in range(self.n):
+            zeros = [j for j in range(self.n) if adj[i, j] == 0]
+            coin = self._rng.randint(2, size=len(zeros))
+            for flip, j in zip(coin, zeros):
+                if flip == 1 and (j * self.n + i) not in out_link_set:
+                    adj[i, j] = 1.0
+                    out_link_set.add(i * self.n + j)
+        row_degree = adj.sum(axis=1, keepdims=True)
+        self.topology = adj / row_degree
+        return self.topology
+
+    def get_in_neighbor_weights(self, node_index):
+        """In-edges of node i are column i of the row-stochastic matrix
+        (asymmetric_topology_manager.py:76-82)."""
+        if node_index >= self.n:
+            return []
+        return [self.topology[row_idx][node_index] for row_idx in range(self.n)]
+
+    def get_out_neighbor_weights(self, node_index):
+        """Out-edges of node i are row i (asymmetric_topology_manager.py:84-87)."""
+        if node_index >= self.n:
+            return []
+        return self.topology[node_index]
+
+    def get_in_neighbor_idx_list(self, node_index):
+        w = self.get_in_neighbor_weights(node_index)
+        return [i for i, wi in enumerate(w) if wi > 0 and i != node_index]
+
+    def get_out_neighbor_idx_list(self, node_index):
+        w = self.get_out_neighbor_weights(node_index)
+        return [i for i, wi in enumerate(w) if wi > 0 and i != node_index]
+
+
+def ring_topology(n: int) -> SymmetricTopologyManager:
+    """Convenience: plain ring (each node, 2 neighbors)."""
+    mgr = SymmetricTopologyManager(n, 2)
+    mgr.generate_topology()
+    return mgr

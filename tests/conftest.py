@@ -1,0 +1,50 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+This replaces the reference's "multi-node without a cluster" strategy of
+launching N+1 MPI processes on localhost
+(run_fedavg_distributed_pytorch.sh:19) — here the N "processes" are N virtual
+XLA devices inside one pytest process.
+
+The environment may eagerly initialize JAX on a TPU platform before pytest
+even starts (a PJRT plugin imports jax at interpreter startup), so setting
+env vars alone is not enough: we clear any live backend and re-initialize on
+CPU with 8 forced host devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# jax is typically already imported (but not yet initialized) at this point;
+# re-point the platform config at CPU before any backend is created.  Only if
+# something already created a backend do we clear and re-initialize (private
+# API, so guard it — on a jax upgrade the env-var path above still works).
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge
+    if xla_bridge._backends:
+        xla_bridge._clear_backends()
+        xla_bridge.get_backend.cache_clear()
+except (ImportError, AttributeError):
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,90 @@
+import numpy as np
+import pytest
+
+from fedml_tpu.core.partition import (
+    partition_dirichlet, partition_homo, record_data_stats,
+)
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.topology import (
+    SymmetricTopologyManager, AsymmetricTopologyManager, ring_lattice_adjacency,
+)
+
+
+def test_sampling_matches_reference_np_seed():
+    """Reference does np.random.seed(round_idx); np.random.choice(...)
+    (FedAVGAggregator.py:94-96). RandomState(seed) reproduces that sequence."""
+    for round_idx in [0, 1, 7, 123]:
+        np.random.seed(round_idx)
+        want = np.random.choice(range(100), 10, replace=False)
+        got = sample_clients(round_idx, 100, 10)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_sampling_full_participation():
+    got = sample_clients(5, 10, 10)
+    np.testing.assert_array_equal(got, np.arange(10))
+
+
+def test_dirichlet_partition_covers_all_samples():
+    labels = np.random.RandomState(0).randint(0, 10, size=5000)
+    parts = partition_dirichlet(labels, client_num=20, classes=10, alpha=0.5, seed=0)
+    all_idx = np.sort(np.concatenate(list(parts.values())))
+    np.testing.assert_array_equal(all_idx, np.arange(5000))
+    assert min(len(v) for v in parts.values()) >= 10
+
+
+def test_dirichlet_partition_noniid_skew():
+    """Low alpha should concentrate classes within clients."""
+    labels = np.random.RandomState(0).randint(0, 10, size=20000)
+    parts = partition_dirichlet(labels, client_num=10, classes=10, alpha=0.1, seed=1)
+    stats = record_data_stats(labels, parts)
+    # at least one client should be missing at least one class entirely
+    assert any(len(c) < 10 for c in stats.values())
+
+
+def test_homo_partition():
+    parts = partition_homo(1000, 8, seed=0)
+    sizes = [len(v) for v in parts.values()]
+    assert max(sizes) - min(sizes) <= 1
+    all_idx = np.sort(np.concatenate(list(parts.values())))
+    np.testing.assert_array_equal(all_idx, np.arange(1000))
+
+
+def test_segmentation_partition():
+    rng = np.random.RandomState(0)
+    # ragged multi-label lists
+    label_list = [rng.choice(5, size=rng.randint(1, 4), replace=False)
+                  for _ in range(400)]
+    parts = partition_dirichlet(label_list, client_num=4, classes=[0, 1, 2, 3, 4],
+                                alpha=100.0, task="segmentation", seed=0)
+    covered = np.sort(np.concatenate(list(parts.values())))
+    # each sample assigned exactly once (by its first matching category)
+    assert len(covered) == len(set(covered.tolist()))
+
+
+def test_ring_lattice_matches_watts_strogatz_p0():
+    nx = pytest.importorskip("networkx")
+    for n, k in [(6, 2), (10, 4), (7, 3)]:
+        want = nx.to_numpy_array(nx.watts_strogatz_graph(n, k, 0), dtype=np.float32)
+        got = ring_lattice_adjacency(n, k)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_symmetric_topology_row_stochastic():
+    mgr = SymmetricTopologyManager(8, 4)
+    W = mgr.generate_topology()
+    np.testing.assert_allclose(W.sum(axis=1), np.ones(8), rtol=1e-6)
+    np.testing.assert_array_equal((W > 0), (W.T > 0))  # symmetric support
+    assert mgr.get_out_neighbor_idx_list(0) == mgr.get_in_neighbor_idx_list(0)
+
+
+def test_asymmetric_topology_row_stochastic():
+    mgr = AsymmetricTopologyManager(8, 4, seed=0)
+    W = mgr.generate_topology()
+    np.testing.assert_allclose(W.sum(axis=1), np.ones(8), rtol=1e-6)
+    # in-neighbors of i are the support of column i; out-neighbors row i
+    # (asymmetric_topology_manager.py:76-87)
+    ins = mgr.get_in_neighbor_idx_list(2)
+    assert ins and all(W[j, 2] > 0 for j in ins)
+    outs = mgr.get_out_neighbor_idx_list(2)
+    assert outs and all(W[2, j] > 0 for j in outs)
