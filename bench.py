@@ -1,0 +1,134 @@
+"""Benchmark: FedAvg rounds/sec on the FEMNIST-CNN config (the reference's
+headline cross-device benchmark: 2-conv CNN, 10 clients/round, B=20, E=1,
+SGD lr=0.1 — benchmark/README.md:54).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline: the reference publishes no wall-clock numbers (BASELINE.md), so
+the baseline is the reference's own standalone simulator loop measured in
+torch on this host (sequential clients — the loop fedavg_api.py:52-66).  We
+time an equivalent torch CPU round once and report speedup = torch_round_s /
+tpu_round_s.  If torch is unavailable the baseline falls back to a nominal
+1.0 s/round.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _make_data(n_clients=100, samples_per_client=200, batch_size=20):
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(samples_per_client, 28, 28, 1).astype(np.float32)
+          for _ in range(n_clients)]
+    ys = [rng.randint(0, 62, samples_per_client).astype(np.int32)
+          for _ in range(n_clients)]
+    return xs, ys
+
+
+def bench_tpu(rounds=20, clients_per_round=10, batch_size=20):
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.models import CNNOriginalFedAvg
+    from fedml_tpu.trainer.workload import (
+        ClassificationWorkload, make_client_optimizer)
+    from fedml_tpu.trainer.local_sgd import make_local_trainer
+    from fedml_tpu.parallel.cohort import make_cohort_step
+    from fedml_tpu.data.stacking import stack_client_data, gather_cohort
+    from fedml_tpu.core.sampling import sample_clients
+
+    xs, ys = _make_data(batch_size=batch_size)
+    stacked = stack_client_data(xs, ys, batch_size)
+
+    model = CNNOriginalFedAvg(only_digits=False)
+    workload = ClassificationWorkload(model, num_classes=62)
+    opt = make_client_optimizer("sgd", 0.1)
+    local = make_local_trainer(workload, opt, epochs=1)
+    step = make_cohort_step(local)
+
+    params = workload.init(jax.random.key(0), jax.tree.map(
+        lambda v: jnp.asarray(v[0, 0]),
+        {k: stacked[k] for k in ("x", "y", "mask")}))
+    rng = jax.random.key(0)
+
+    def one_round(params, round_idx, rng):
+        ids = sample_clients(round_idx, len(xs), clients_per_round)
+        cohort = gather_cohort(stacked, ids, pad_to=clients_per_round)
+        rng, r = jax.random.split(rng)
+        params, _ = step(params, cohort, r)
+        return params, rng
+
+    # warmup / compile
+    params, rng = one_round(params, 0, rng)
+    jax.block_until_ready(params)
+
+    t0 = time.time()
+    for i in range(1, rounds + 1):
+        params, rng = one_round(params, i, rng)
+    jax.block_until_ready(params)
+    dt = (time.time() - t0) / rounds
+    return dt
+
+
+def bench_torch_baseline(clients_per_round=10, batch_size=20):
+    """One sequential torch-CPU FedAvg round, reference-style (the standalone
+    simulator trains sampled clients one after another)."""
+    try:
+        import torch
+        import torch.nn as nn
+    except Exception:
+        return 1.0
+
+    class CNN(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.c1 = nn.Conv2d(1, 32, 5, padding=2)
+            self.c2 = nn.Conv2d(32, 64, 5, padding=2)
+            self.f1 = nn.Linear(3136, 512)
+            self.f2 = nn.Linear(512, 62)
+            self.pool = nn.MaxPool2d(2, 2)
+
+        def forward(self, x):
+            x = self.pool(torch.relu(self.c1(x)))
+            x = self.pool(torch.relu(self.c2(x)))
+            x = x.flatten(1)
+            return self.f2(torch.relu(self.f1(x)))
+
+    torch.manual_seed(0)
+    model = CNN()
+    crit = nn.CrossEntropyLoss()
+    xs, ys = _make_data(n_clients=clients_per_round, batch_size=batch_size)
+    t0 = time.time()
+    for c in range(clients_per_round):
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        x = torch.from_numpy(xs[c]).permute(0, 3, 1, 2)
+        y = torch.from_numpy(ys[c]).long()
+        for s in range(0, len(x), batch_size):
+            opt.zero_grad()
+            loss = crit(model(x[s:s + batch_size]), y[s:s + batch_size])
+            loss.backward()
+            opt.step()
+    return time.time() - t0
+
+
+def main():
+    if os.environ.get("BENCH_PLATFORM"):  # e.g. cpu smoke runs
+        import jax
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    rounds = int(os.environ.get("BENCH_ROUNDS", "20"))
+    tpu_round_s = bench_tpu(rounds=rounds)
+    baseline_round_s = bench_torch_baseline()
+    out = {
+        "metric": "fedavg_round_time_femnist_cnn",
+        "value": round(1.0 / tpu_round_s, 3),
+        "unit": "rounds/sec",
+        "vs_baseline": round(baseline_round_s / tpu_round_s, 3),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.algorithms.centralized import CentralizedTrainer

@@ -1,0 +1,43 @@
+"""Centralized (non-federated) baseline trainer.
+
+Parity: fedml_api/centralized/centralized_trainer.py:14-104 — train one
+model on the pooled dataset with the same optimizer/loss as the federated
+clients.  Doubles as the oracle side of the CI equivalence test
+(CI-script-fedavg.sh:41-49): full-batch, E=1, full-participation FedAvg must
+match this trainer's trajectory."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from fedml_tpu.trainer.local_sgd import make_local_trainer, make_evaluator
+from fedml_tpu.trainer.workload import Workload, make_client_optimizer
+
+
+class CentralizedTrainer:
+    def __init__(self, workload: Workload, lr: float,
+                 client_optimizer: str = "sgd", wd: float = 0.0,
+                 epochs_per_call: int = 1):
+        self.workload = workload
+        opt = make_client_optimizer(client_optimizer, lr, wd)
+        self.local_train = jax.jit(
+            make_local_trainer(workload, opt, epochs_per_call))
+        self.evaluate = jax.jit(make_evaluator(workload))
+
+    def train_rounds(self, params, data: Dict, rounds: int,
+                     rng: Optional[jax.Array] = None):
+        """``rounds`` sequential optimizer restarts over the same data,
+        mirroring how each FedAvg round restarts the client optimizer."""
+        rng = rng if rng is not None else jax.random.key(0)
+        for _ in range(rounds):
+            rng, r = jax.random.split(rng)
+            params, _ = self.local_train(params, data, r)
+        return params
+
+    def metrics(self, params, data: Dict) -> Dict[str, float]:
+        m = self.evaluate(params, jax.tree.map(jax.numpy.asarray, data))
+        total = max(float(m["total"]), 1.0)
+        return {"acc": float(m["correct"]) / total,
+                "loss": float(m["loss_sum"]) / total}

@@ -96,7 +96,8 @@ def partition_homo(n_samples: int, client_num: int,
     """IID split (cifar10/data_loader.py:119-123): shuffle then array_split."""
     rng = np.random.RandomState(seed) if seed is not None else np.random
     idxs = rng.permutation(n_samples)
-    return {i: np.sort(part).astype(np.int64)
+    # keep the permuted within-client order (the reference does not re-sort)
+    return {i: part.astype(np.int64)
             for i, part in enumerate(np.array_split(idxs, client_num))}
 
 

@@ -76,8 +76,14 @@ def tree_weighted_mean(trees: Sequence[Pytree] | Pytree, weights: jax.Array) -> 
         stacked = trees
 
     def _avg(x):
-        w = norm.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.dtype)
-        return jnp.sum(x * w, axis=0)
+        # accumulate in f32 (exact for int leaves like step counters, and
+        # full-precision normalization for bf16 params), cast back at the end
+        # — matching the reference where float-averaged int tensors are cast
+        # back on load_state_dict
+        acc_dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+        w = norm.reshape((-1,) + (1,) * (x.ndim - 1)).astype(jnp.float32)
+        out = jnp.sum(x.astype(acc_dtype) * w.astype(acc_dtype), axis=0)
+        return out.astype(x.dtype)
 
     return jax.tree.map(_avg, stacked)
 

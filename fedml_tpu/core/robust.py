@@ -70,6 +70,9 @@ def add_gaussian_noise(params: Pytree, key: jax.Array, stddev: float) -> Pytree:
     """Weak-DP Gaussian noise (robust_aggregation.py:51-55)."""
     leaves, treedef = jax.tree.flatten(params)
     keys = jax.random.split(key, len(leaves))
+    # noise only float leaves; integer leaves (step counters, batch-norm
+    # trackers) pass through — the reference perturbs weights only
     noised = [x + stddev * jax.random.normal(k, x.shape, x.dtype)
+              if jnp.issubdtype(x.dtype, jnp.floating) else x
               for x, k in zip(leaves, keys)]
     return jax.tree.unflatten(treedef, noised)

@@ -1,0 +1,3 @@
+from fedml_tpu.data.stacking import (
+    stack_client_data, gather_cohort, batch_global, FederatedData,
+)

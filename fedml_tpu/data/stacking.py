@@ -1,0 +1,113 @@
+"""Host-side cohort staging: ragged per-client data -> padded device arrays.
+
+The reference feeds each client a torch DataLoader over its own tensor list
+(MNIST/data_loader.py:51-75) and the simulator re-points one trainer at a
+different client's loader each round (FedAVGTrainer.update_dataset,
+FedAVGTrainer.py:25-29).  The TPU equivalent (SURVEY.md §2.4): keep ALL
+clients' data in stacked host arrays ``[num_clients, S, B, ...]`` padded to
+a common S, and per round *gather* the sampled cohort's rows and ship one
+contiguous block to device.  Masks keep padded rows out of loss/metrics, so
+sample-weighted aggregation stays exact despite padding.
+
+This is the "process k plays client i" trick turned into an indexed gather —
+no per-round re-staging, no re-jit (cohort shapes are static).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+Array = np.ndarray
+
+
+@dataclasses.dataclass
+class FederatedData:
+    """The uniform dataset contract (TPU-native version of the reference's
+    9-tuple, e.g. main_fedavg.py:118-120).
+
+    train: dict of stacked arrays {x: [N, S, B, ...], y: [N, S, B, ...],
+           mask: [N, S, B], num_samples: [N]} over all N clients.
+    test/global test: same layout (or None).
+    """
+    client_num: int
+    class_num: int
+    train: Dict[str, Array]
+    test: Optional[Dict[str, Array]] = None
+    train_global: Optional[Dict[str, Array]] = None
+    test_global: Optional[Dict[str, Array]] = None
+
+    @property
+    def train_data_num(self) -> int:
+        return int(self.train["num_samples"].sum())
+
+
+def stack_client_data(xs: Sequence[Array], ys: Sequence[Array],
+                      batch_size: int, steps: Optional[int] = None,
+                      shuffle_seed: Optional[int] = None) -> Dict[str, Array]:
+    """Stack ragged per-client (x, y) into [C, S, B, ...] + mask + counts.
+
+    S = ceil(max_i n_i / B) unless given.  Clients with fewer samples get
+    zero-padded batches with mask 0.  With ``shuffle_seed`` each client's
+    samples are shuffled once (the reference shuffles MNIST with fixed seed
+    100, MNIST/data_loader.py:51-56)."""
+    C = len(xs)
+    assert C == len(ys)
+    rng = np.random.RandomState(shuffle_seed) if shuffle_seed is not None else None
+    counts = np.asarray([len(x) for x in xs], dtype=np.int64)
+    if steps is None:
+        steps = int(np.ceil(max(int(counts.max()), 1) / batch_size))
+    cap = steps * batch_size
+
+    x0 = np.asarray(xs[0])
+    sample_shape = x0.shape[1:]
+    x_out = np.zeros((C, steps, batch_size) + sample_shape, dtype=x0.dtype)
+    y0 = np.asarray(ys[0])
+    y_shape = y0.shape[1:]
+    y_dtype = y0.dtype
+    y_out = np.zeros((C, steps, batch_size) + y_shape, dtype=y_dtype)
+    mask = np.zeros((C, steps, batch_size), dtype=np.float32)
+
+    clipped = np.minimum(counts, cap)
+    for c in range(C):
+        n = int(clipped[c])
+        x = np.asarray(xs[c])[:n]
+        y = np.asarray(ys[c])[:n]
+        if rng is not None and n > 1:
+            perm = rng.permutation(n)
+            x, y = x[perm], y[perm]
+        flat_x = x_out[c].reshape((cap,) + sample_shape)
+        flat_y = y_out[c].reshape((cap,) + y_shape)
+        flat_m = mask[c].reshape(cap)
+        flat_x[:n] = x
+        flat_y[:n] = y
+        flat_m[:n] = 1.0
+    return {"x": x_out, "y": y_out, "mask": mask,
+            "num_samples": clipped.astype(np.float32)}
+
+
+def batch_global(x: Array, y: Array, batch_size: int) -> Dict[str, Array]:
+    """Batch one (global) dataset into [S, B, ...] + mask (for centralized
+    training / server-side eval)."""
+    d = stack_client_data([x], [y], batch_size)
+    return {"x": d["x"][0], "y": d["y"][0], "mask": d["mask"][0]}
+
+
+def gather_cohort(stacked: Dict[str, Array], client_ids: Sequence[int],
+                  pad_to: Optional[int] = None) -> Dict[str, Any]:
+    """Select the sampled cohort's rows; optionally pad with weight-0 dummy
+    clients to a static cohort size (kills per-round re-jit, SURVEY.md §7
+    "hard parts" (a))."""
+    ids = np.asarray(client_ids, dtype=np.int64)
+    if pad_to is not None and len(ids) < pad_to:
+        ids = np.concatenate([ids, np.zeros(pad_to - len(ids), np.int64)])
+        live = np.concatenate([np.ones(len(client_ids)), np.zeros(pad_to - len(client_ids))])
+    else:
+        live = np.ones(len(ids))
+    out = {k: jnp.asarray(v[ids]) for k, v in stacked.items()}
+    out["mask"] = out["mask"] * jnp.asarray(live, jnp.float32)[:, None, None]
+    out["num_samples"] = out["num_samples"] * jnp.asarray(live, jnp.float32)
+    return out

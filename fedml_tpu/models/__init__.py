@@ -1,0 +1,3 @@
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.models.cnn import CNNOriginalFedAvg, CNNDropOut
+from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow

@@ -1,0 +1,49 @@
+"""FedAvg-paper CNNs (parity: fedml_api/model/cv/cnn.py:5-152).
+
+NHWC layout (TPU-native; the reference is NCHW torch).  Parameter counts
+match the reference exactly: CNNOriginalFedAvg = 1,663,370 (only_digits),
+CNNDropOut = 1,199,882."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNNOriginalFedAvg(nn.Module):
+    """McMahan'17 CNN (cnn.py:5-72): 2x [5x5 conv same, relu, 2x2 maxpool],
+    dense 512, dense num_classes."""
+    only_digits: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]  # [B, 28, 28] -> NHWC
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(10 if self.only_digits else 62)(x)
+
+
+class CNNDropOut(nn.Module):
+    """Reddi'20 (Adaptive Federated Optimization) CNN (cnn.py:75-152):
+    3x3 convs valid-padded, dropout 0.25/0.5, dense 128."""
+    only_digits: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (3, 3), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10 if self.only_digits else 62)(x)

@@ -1,0 +1,19 @@
+"""Logistic regression (parity: fedml_api/model/linear/lr.py:4-14).
+
+The reference applies a sigmoid to the linear output *and then* feeds it to
+``nn.CrossEntropyLoss`` (MyModelTrainer.train) — i.e. the sigmoid outputs are
+used as logits.  We reproduce that exactly so MNIST-LR accuracy curves match
+(the same mild logit squashing happens in both)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    input_dim: int
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.sigmoid(nn.Dense(self.output_dim)(x))

@@ -1,0 +1,47 @@
+"""LSTM language models (parity: fedml_api/model/nlp/rnn.py:4-70).
+
+Implemented with `flax.linen.RNN` over `OptimizedLSTMCell` — under jit the
+recurrence compiles to a `lax.scan`, which XLA pipelines on TPU.  Zero
+initial hidden state per batch, exactly as the reference notes
+(rnn.py:26-29)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNOriginalFedAvg(nn.Module):
+    """Shakespeare next-char model (rnn.py:4-36): embed(8) -> 2x LSTM(256)
+    -> dense(vocab) on the final hidden state."""
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, input_seq, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.embedding_dim)(input_seq)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(x)
+        x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(x)
+        final_hidden = x[:, -1]
+        return nn.Dense(self.vocab_size)(final_hidden)
+
+
+class RNNStackOverflow(nn.Module):
+    """StackOverflow next-word model (rnn.py:39-70): embed(96) -> LSTM(670)
+    -> dense(96) -> dense(extended_vocab); per-position logits.
+
+    Returns [B, T, V] (time-major logits transposed the torch way is [B, V, T];
+    our loss consumes [B, T, V] directly)."""
+    vocab_size: int = 10000
+    num_oov_buckets: int = 1
+    embedding_size: int = 96
+    latent_size: int = 670
+    num_layers: int = 1
+
+    @nn.compact
+    def __call__(self, input_seq, train: bool = False):
+        extended_vocab = self.vocab_size + 3 + self.num_oov_buckets
+        x = nn.Embed(extended_vocab, self.embedding_size)(input_seq)
+        for _ in range(self.num_layers):
+            x = nn.RNN(nn.OptimizedLSTMCell(self.latent_size))(x)
+        x = nn.Dense(self.embedding_size)(x)
+        return nn.Dense(extended_vocab)(x)

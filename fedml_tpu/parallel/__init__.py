@@ -1,0 +1,2 @@
+from fedml_tpu.parallel.mesh import make_mesh, client_axis_size
+from fedml_tpu.parallel.cohort import make_cohort_step, CohortStep

@@ -1,0 +1,41 @@
+"""Device-mesh construction — the TPU replacement for mpirun + hostfile +
+gpu_mapping.yaml (fedml_api/distributed/utils/gpu_mapping.py:8-37).
+
+The reference assigns one OS process per FL participant and places each on a
+GPU via a YAML table.  Here, placement is a `jax.sharding.Mesh`: the
+``clients`` axis shards the cohort across chips; an optional ``model`` axis
+gives intra-client model sharding (pjit tensor-parallel "for free" — a config
+knob, not an algorithm, per SURVEY.md §2.5).  Multi-host pods initialize with
+`jax.distributed.initialize` and the same code runs unchanged; hierarchical
+FL maps its group tier onto ICI within a slice and its global tier onto DCN
+across slices (two-level mesh axes)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(client_axis: Optional[int] = None, model_axis: int = 1,
+              devices: Optional[Sequence[jax.Device]] = None,
+              axis_names=("clients", "model")) -> Mesh:
+    """Mesh over all (or given) devices: [clients, model].
+
+    Defaults: every device on the clients axis, no model sharding."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if client_axis is None:
+        client_axis = n // model_axis
+    assert client_axis * model_axis == n, (
+        f"mesh {client_axis}x{model_axis} != {n} devices")
+    arr = np.asarray(devices).reshape(client_axis, model_axis)
+    return Mesh(arr, axis_names)
+
+
+def client_axis_size(mesh: Optional[Mesh]) -> int:
+    if mesh is None:
+        return 1
+    return mesh.shape["clients"]

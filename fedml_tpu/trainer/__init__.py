@@ -1,0 +1,5 @@
+from fedml_tpu.trainer.workload import (
+    Workload, ClassificationWorkload, NWPWorkload, TagPredictionWorkload,
+    make_client_optimizer,
+)
+from fedml_tpu.trainer.local_sgd import make_local_trainer, make_evaluator

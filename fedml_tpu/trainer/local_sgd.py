@@ -1,0 +1,93 @@
+"""Local training as one compiled `lax.scan` — the client-side hot loop.
+
+Reference equivalent: the per-client epochs x batches Python loop of
+``MyModelTrainer.train`` (fedml_api/distributed/fedavg/MyModelTrainer.py:19-49).
+Here the whole local run is a single scan over ``epochs * steps`` so XLA
+fuses optimizer updates into the backward pass and the function is
+`vmap`-able over a stacked client axis (the cohort engine's trick).
+
+Parity details preserved:
+* a *fresh* optimizer per local-training call (the reference constructs the
+  optimizer inside ``train`` each round, so Adam moments never persist
+  across rounds);
+* optional global-norm grad clipping at 1.0 (classification trainer,
+  my_model_trainer_classification.py:44);
+* batch-mean loss over valid (non-padded) samples only.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.trainer.workload import Workload
+
+Pytree = Any
+
+
+def make_local_trainer(workload: Workload,
+                       optimizer: optax.GradientTransformation,
+                       epochs: int):
+    """Returns ``train(params, data, rng) -> (new_params, metrics)``.
+
+    ``data`` leaves are [S, B, ...] (S batches of size B) with ``mask``
+    [S, B]; the scan runs epochs*S steps, revisiting the same batches each
+    epoch in order (the reference's DataLoader order is fixed per round)."""
+    clip = (optax.clip_by_global_norm(workload.grad_clip_norm)
+            if workload.grad_clip_norm is not None else None)
+
+    grad_fn = jax.value_and_grad(
+        lambda p, b, r: workload.loss_fn(p, b, r, True), has_aux=True)
+
+    def train(params: Pytree, data: Dict[str, jax.Array], rng: jax.Array
+              ) -> Tuple[Pytree, Dict[str, jax.Array]]:
+        opt_state = optimizer.init(params)
+        clip_state = clip.init(params) if clip is not None else None
+        num_steps = jax.tree.leaves(data)[0].shape[0]
+
+        def step(carry, step_idx):
+            params, opt_state, rng = carry
+            rng, dropout_rng = jax.random.split(rng)
+            batch = jax.tree.map(lambda x: x[step_idx % num_steps], data)
+            (loss, _), grads = grad_fn(params, batch, dropout_rng)
+            if clip is not None:
+                grads, _ = clip.update(grads, clip_state)
+            updates, new_opt_state = optimizer.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # skip the update entirely for fully-padded batches (grads are 0
+            # there anyway for SGD, but Adam's eps would still drift params)
+            got_data = jnp.sum(batch["mask"]) > 0
+            new_params = jax.tree.map(
+                lambda n, o: jnp.where(got_data, n, o), new_params, params)
+            new_opt_state = jax.tree.map(
+                lambda n, o: jnp.where(got_data, n, o), new_opt_state, opt_state)
+            return (new_params, new_opt_state, rng), loss
+
+        total_steps = epochs * num_steps
+        (params, _, _), losses = jax.lax.scan(
+            step, (params, opt_state, rng), jnp.arange(total_steps))
+        return params, {"train_loss_per_step": losses}
+
+    return train
+
+
+def make_evaluator(workload: Workload):
+    """Returns ``evaluate(params, data) -> summed metrics`` over [S, B, ...]
+    batch stacks.  Mirrors ``MyModelTrainer.test`` (MyModelTrainer.py:51-90)
+    but runs as one scan; metrics are sums so they aggregate exactly across
+    clients/devices with a plain psum."""
+
+    def evaluate(params: Pytree, data: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        def step(carry, batch):
+            m = workload.metric_fn(params, batch)
+            return jax.tree.map(jnp.add, carry, m), None
+
+        first = jax.tree.map(lambda x: x[0], data)
+        init = jax.tree.map(jnp.zeros_like, workload.metric_fn(params, first))
+        out, _ = jax.lax.scan(step, init, data)
+        return out
+
+    return evaluate

@@ -1,0 +1,171 @@
+"""Client workload contract — the TPU-native ``ModelTrainer``.
+
+The reference seam is the framework-neutral ``ModelTrainer`` ABC
+(``fedml_core/trainer/model_trainer.py:4-37``: get/set params, train, test).
+Here the seam is *functional*: a `Workload` bundles pure functions
+(init / loss / metrics) over a flax model, so trainers can `jax.grad`,
+`vmap` (stacked clients), and `shard_map` (mesh-sharded cohorts) it.
+
+The three concrete workloads mirror the reference's three trainer flavors
+(fedml_api/standalone/fedavg/my_model_trainer_{classification,nwp,
+tag_prediction}.py):
+
+* `ClassificationWorkload` — softmax CE, top-1 accuracy, grad-clip 1.0
+  (my_model_trainer_classification.py:44).
+* `NWPWorkload` — per-position softmax CE over sequence logits, ignoring
+  padding-id targets (next-word/char prediction).
+* `TagPredictionWorkload` — multi-label: BCE-with-logits, exact-match +
+  precision/recall (my_model_trainer_tag_prediction.py; eval thresholds at
+  0.5 like MyModelTrainer.test, MyModelTrainer.py:76-82).
+
+Batches are dicts ``{"x": [B, ...], "y": [B, ...], "mask": [B]}``; the mask
+makes padded cohort batches exact — a padded row contributes nothing to loss,
+gradient, or metrics, so sample-weighted FedAvg stays bit-honest.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Pytree = Any
+Batch = Dict[str, jax.Array]
+
+
+def make_client_optimizer(name: str, lr: float, wd: float = 0.0) -> optax.GradientTransformation:
+    """Client optimizer parity (my_model_trainer_classification.py:27-31):
+    "sgd" -> plain SGD(lr); anything else -> Adam(lr, weight_decay=wd,
+    amsgrad=True).  Torch couples wd into the gradient before the moment
+    updates, so add_decayed_weights precedes the amsgrad transform."""
+    if name == "sgd":
+        return optax.sgd(lr)
+    return optax.chain(
+        optax.add_decayed_weights(wd),
+        optax.scale_by_amsgrad(),
+        optax.scale(-lr),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """Pure-function training contract.
+
+    loss_fn(params, batch, rng, train) -> (scalar loss, metrics dict).
+    metric_fn(params, batch) -> dict of *summable* metrics
+    (must include "correct", "loss_sum", "total").
+    """
+    model: Any  # flax linen module
+    loss_fn: Callable[[Pytree, Batch, jax.Array, bool], tuple]
+    metric_fn: Callable[[Pytree, Batch], Dict[str, jax.Array]]
+    grad_clip_norm: Optional[float] = None
+
+    def init(self, rng: jax.Array, sample_batch: Batch) -> Pytree:
+        return self.model.init(rng, sample_batch["x"])["params"]
+
+    def apply(self, params: Pytree, x: jax.Array, train: bool = False,
+              rng: Optional[jax.Array] = None) -> jax.Array:
+        kwargs = {}
+        if rng is not None:
+            kwargs["rngs"] = {"dropout": rng}
+        return self.model.apply({"params": params}, x, train=train, **kwargs)
+
+
+def _masked_mean(values: jax.Array, mask: jax.Array) -> jax.Array:
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(values * mask) / denom
+
+
+def ClassificationWorkload(model, num_classes: int,
+                           grad_clip_norm: Optional[float] = 1.0) -> Workload:
+    """Softmax cross-entropy on logits, batch-mean over valid rows (the
+    torch ``nn.CrossEntropyLoss()`` default reduction)."""
+
+    def loss_fn(params, batch, rng, train):
+        kwargs = {"rngs": {"dropout": rng}} if rng is not None else {}
+        logits = model.apply({"params": params}, batch["x"], train=train, **kwargs)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+        loss = _masked_mean(ce, batch["mask"])
+        return loss, {"loss": loss}
+
+    def metric_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"], train=False)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+        pred = jnp.argmax(logits, axis=-1)
+        mask = batch["mask"]
+        return {
+            "correct": jnp.sum((pred == batch["y"]) * mask),
+            "loss_sum": jnp.sum(ce * mask),
+            "total": jnp.sum(mask),
+        }
+
+    return Workload(model=model, loss_fn=loss_fn, metric_fn=metric_fn,
+                    grad_clip_norm=grad_clip_norm)
+
+
+def NWPWorkload(model, pad_id: int = 0,
+                grad_clip_norm: Optional[float] = None) -> Workload:
+    """Next-word/char prediction: model emits [B, T, V] logits; CE averaged
+    over non-pad positions of valid rows (my_model_trainer_nwp.py semantics,
+    where torch CE with [B, V, T] logits means per-position CE)."""
+
+    def _position_mask(batch):
+        tok_valid = (batch["y"] != pad_id).astype(jnp.float32)
+        return tok_valid * batch["mask"][:, None]
+
+    def loss_fn(params, batch, rng, train):
+        logits = model.apply({"params": params}, batch["x"], train=train)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+        m = _position_mask(batch)
+        loss = jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return loss, {"loss": loss}
+
+    def metric_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"], train=False)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+        pred = jnp.argmax(logits, axis=-1)
+        m = _position_mask(batch)
+        return {
+            "correct": jnp.sum((pred == batch["y"]) * m),
+            "loss_sum": jnp.sum(ce * m),
+            "total": jnp.sum(m),
+        }
+
+    return Workload(model=model, loss_fn=loss_fn, metric_fn=metric_fn,
+                    grad_clip_norm=grad_clip_norm)
+
+
+def TagPredictionWorkload(model, grad_clip_norm: Optional[float] = None) -> Workload:
+    """Multi-label tag prediction (stackoverflow_lr): BCE-with-logits loss;
+    eval thresholds sigmoid>0.5 with exact-match accuracy plus summed
+    precision/recall (MyModelTrainer.test, MyModelTrainer.py:76-82)."""
+
+    def loss_fn(params, batch, rng, train):
+        logits = model.apply({"params": params}, batch["x"], train=train)
+        bce = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, batch["y"]), axis=-1)
+        loss = _masked_mean(bce, batch["mask"])
+        return loss, {"loss": loss}
+
+    def metric_fn(params, batch):
+        logits = model.apply({"params": params}, batch["x"], train=False)
+        bce = jnp.mean(optax.sigmoid_binary_cross_entropy(logits, batch["y"]), axis=-1)
+        mask = batch["mask"]
+        pred = (logits > 0.0).astype(jnp.float32)  # sigmoid(z) > .5 <=> z > 0
+        y = batch["y"]
+        exact = jnp.all(pred == y, axis=-1).astype(jnp.float32)
+        tp = jnp.sum(y * pred, axis=-1)
+        precision = tp / (jnp.sum(pred, axis=-1) + 1e-13)
+        recall = tp / (jnp.sum(y, axis=-1) + 1e-13)
+        return {
+            "correct": jnp.sum(exact * mask),
+            "loss_sum": jnp.sum(bce * mask),
+            "total": jnp.sum(mask),
+            "precision_sum": jnp.sum(precision * mask),
+            "recall_sum": jnp.sum(recall * mask),
+        }
+
+    return Workload(model=model, loss_fn=loss_fn, metric_fn=metric_fn,
+                    grad_clip_norm=grad_clip_norm)

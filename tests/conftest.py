@@ -29,6 +29,12 @@ jax.config.update("jax_platforms", "cpu")
 try:
     from jax._src import xla_bridge
     if xla_bridge._backends:
+        if any(p != "cpu" for p in xla_bridge._backends):
+            # clearing a live TPU/axon backend hangs (see
+            # .claude/skills/verify/SKILL.md) — fail fast instead
+            raise RuntimeError(
+                "a non-CPU JAX backend was initialized before conftest ran; "
+                "run pytest in a fresh process without touching jax.devices()")
         xla_bridge._clear_backends()
         xla_bridge.get_backend.cache_clear()
 except (ImportError, AttributeError):

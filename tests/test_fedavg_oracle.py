@@ -1,0 +1,174 @@
+"""The reference's key correctness oracle (CI-script-fedavg.sh:41-49):
+
+    full batch + E=1 + full participation  =>  FedAvg == centralized
+
+exactly (one aggregated FedAvg step equals one pooled-gradient step), plus
+cohort-engine invariants: vmap cohort == sequential clients, single-chip ==
+8-device shard_map, padded dummy clients are no-ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms import FedAvg, FedAvgConfig, CentralizedTrainer
+from fedml_tpu.data.stacking import (
+    stack_client_data, batch_global, gather_cohort, FederatedData,
+)
+from fedml_tpu.models import LogisticRegression
+from fedml_tpu.parallel.cohort import make_cohort_step
+from fedml_tpu.parallel.mesh import make_mesh
+from fedml_tpu.trainer.workload import ClassificationWorkload, make_client_optimizer
+from fedml_tpu.trainer.local_sgd import make_local_trainer
+
+
+def _synthetic_clients(n_clients=8, dim=12, classes=4, seed=0, min_n=6, max_n=20):
+    """Linearly-separable-ish synthetic classification data, ragged sizes."""
+    rng = np.random.RandomState(seed)
+    W = rng.randn(dim, classes)
+    xs, ys = [], []
+    for _ in range(n_clients):
+        n = rng.randint(min_n, max_n + 1)
+        x = rng.randn(n, dim).astype(np.float32)
+        y = np.argmax(x @ W + 0.1 * rng.randn(n, classes), axis=1).astype(np.int32)
+        xs.append(x)
+        ys.append(y)
+    return xs, ys
+
+
+def _make_fed_data(xs, ys, batch_size, classes=4):
+    train = stack_client_data(xs, ys, batch_size)
+    return FederatedData(client_num=len(xs), class_num=classes, train=train,
+                         test=train)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    model = LogisticRegression(input_dim=12, output_dim=4)
+    return ClassificationWorkload(model, num_classes=4, grad_clip_norm=None)
+
+
+def test_fullbatch_fedavg_equals_centralized(workload):
+    xs, ys = _synthetic_clients()
+    data = _make_fed_data(xs, ys, batch_size=32)  # >= max client size: 1 batch
+    cfg = FedAvgConfig(comm_round=3, client_num_per_round=8, epochs=1,
+                       batch_size=32, lr=0.5, frequency_of_the_test=100)
+    fed = FedAvg(workload, data, cfg)
+    params0 = fed.init_params(jax.random.key(7))
+    fed_params = fed.run(params=jax.tree.map(jnp.copy, params0))
+
+    pooled_x = np.concatenate(xs)
+    pooled_y = np.concatenate(ys)
+    central = CentralizedTrainer(workload, lr=0.5)
+    central_data = batch_global(pooled_x, pooled_y, batch_size=len(pooled_x))
+    central_params = central.train_rounds(params0, central_data, rounds=3)
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+        fed_params, central_params)
+
+    fed_acc = fed.evaluate_global(fed_params)["train_acc"]
+    cen_acc = central.metrics(central_params,
+                              {k: central_data[k] for k in ("x", "y", "mask")})
+    assert abs(fed_acc - cen_acc["acc"]) < 1e-3  # the CI script's 3-decimals
+
+
+def test_vmap_cohort_equals_sequential_clients(workload):
+    """One vmap'd cohort step == training each client separately then
+    weighted-averaging (the reference's sequential simulator semantics)."""
+    xs, ys = _synthetic_clients(n_clients=4)
+    train = stack_client_data(xs, ys, batch_size=5)
+    opt = make_client_optimizer("sgd", 0.1)
+    local = make_local_trainer(workload, opt, epochs=2)
+    step = make_cohort_step(local)
+
+    params = workload.init(jax.random.key(0),
+                           jax.tree.map(lambda v: v[0, 0],
+                                        {k: train[k] for k in ("x", "y", "mask")}))
+    rng = jax.random.key(3)
+    cohort = {k: jnp.asarray(v) for k, v in train.items()}
+    agg, _ = step(params, cohort, rng)
+
+    # sequential: same per-client rng assignment as the cohort engine
+    rngs = [jax.random.fold_in(rng, i) for i in range(4)]
+    client_params = []
+    for c in range(4):
+        cdata = {k: jnp.asarray(train[k][c]) for k in ("x", "y", "mask")}
+        p, _ = local(params, cdata, rngs[c])
+        client_params.append(p)
+    from fedml_tpu.core import tree_weighted_mean
+    want = tree_weighted_mean(client_params, jnp.asarray(train["num_samples"]))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+                 agg, want)
+
+
+def test_sharded_cohort_equals_single_chip(workload, devices):
+    """8-device shard_map cohort == single-chip vmap cohort."""
+    xs, ys = _synthetic_clients(n_clients=8)
+    train = stack_client_data(xs, ys, batch_size=5)
+    opt = make_client_optimizer("sgd", 0.1)
+    local = make_local_trainer(workload, opt, epochs=1)
+
+    params = workload.init(jax.random.key(0),
+                           jax.tree.map(lambda v: v[0, 0],
+                                        {k: train[k] for k in ("x", "y", "mask")}))
+    cohort = {k: jnp.asarray(v) for k, v in train.items()}
+    rng = jax.random.key(5)
+
+    single = make_cohort_step(local)
+    mesh = make_mesh(devices=devices, client_axis=8, model_axis=1)
+    sharded = make_cohort_step(local, mesh=mesh)
+
+    got_single, _ = single(params, cohort, rng)
+    got_sharded, _ = sharded(params, cohort, rng)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+                 got_single, got_sharded)
+
+
+def test_padded_dummy_clients_are_noops(workload):
+    """gather_cohort pad_to: dummy clients contribute nothing."""
+    xs, ys = _synthetic_clients(n_clients=5)
+    train = stack_client_data(xs, ys, batch_size=5)
+    opt = make_client_optimizer("sgd", 0.1)
+    local = make_local_trainer(workload, opt, epochs=1)
+    step = make_cohort_step(local)
+
+    params = workload.init(jax.random.key(0),
+                           jax.tree.map(lambda v: v[0, 0],
+                                        {k: train[k] for k in ("x", "y", "mask")}))
+    rng = jax.random.key(1)
+    exact = gather_cohort(train, [1, 3])
+    padded = gather_cohort(train, [1, 3], pad_to=4)
+    got_exact, _ = step(params, exact, rng)
+    # padded run uses a different per-client rng split, but SGD on identical
+    # data is rng-free here (no dropout), so results must match
+    got_padded, _ = step(params, padded, rng)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5),
+                 got_exact, got_padded)
+
+
+def test_adam_optimizer_path(workload):
+    """Adam (amsgrad) client optimizer runs and fully-padded batches do not
+    drift parameters."""
+    xs, ys = _synthetic_clients(n_clients=2, min_n=3, max_n=3)
+    # force steps where client 1 has padded batches: client 0 gets 9 samples
+    xs[0] = np.random.RandomState(1).randn(9, 12).astype(np.float32)
+    ys[0] = np.zeros(9, np.int32)
+    train = stack_client_data(xs, ys, batch_size=3)
+    assert train["x"].shape[1] == 3  # 3 steps; client 1 has 2 fully-padded
+    opt = make_client_optimizer("adam", 1e-2, wd=1e-3)
+    local = make_local_trainer(workload, opt, epochs=1)
+
+    params = workload.init(jax.random.key(0),
+                           jax.tree.map(lambda v: v[0, 0],
+                                        {k: train[k] for k in ("x", "y", "mask")}))
+    cdata1 = {k: jnp.asarray(train[k][1]) for k in ("x", "y", "mask")}
+    p1, _ = local(params, cdata1, jax.random.key(2))
+    # only the first of 3 steps has data; params must still move
+    assert float(jax.numpy.abs(p1["Dense_0"]["kernel"] - params["Dense_0"]["kernel"]).max()) > 0
+
+    # a client with NO data at all: params must come back unchanged
+    empty = jax.tree.map(jnp.zeros_like, cdata1)
+    p_empty, _ = local(params, empty, jax.random.key(3))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=0, atol=0),
+                 p_empty, params)
