@@ -1,0 +1,53 @@
+"""FedAvg-Robust — defense hooks at aggregation time.
+
+Parity with fedml_api/distributed/fedavg_robust/FedAvgRobustAggregator.py:
+norm-diff clipping and weak-DP Gaussian noise applied to each client update
+before averaging (:133, :179-207; defense math in
+fedml_core/robustness/robust_aggregation.py).
+
+Here the defenses are the cohort engine's ``transform_update`` hook, so the
+whole defended round (local training + clip + noise + aggregation) remains
+one jit — on a mesh the defense runs shard-local before the psum.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.core.robust import add_gaussian_noise, clip_update
+from fedml_tpu.parallel.cohort import make_cohort_step
+from fedml_tpu.trainer.local_sgd import make_local_trainer
+from fedml_tpu.trainer.workload import make_client_optimizer
+
+
+@dataclasses.dataclass
+class FedAvgRobustConfig(FedAvgConfig):
+    defense: str = "weak_dp"     # "norm_diff_clipping" | "weak_dp" | "none"
+    norm_bound: float = 5.0
+    stddev: float = 0.025        # reference default for weak DP
+
+
+class FedAvgRobust(FedAvg):
+    DEFENSES = ("norm_diff_clipping", "weak_dp", "none")
+
+    def __init__(self, workload, data, config: FedAvgRobustConfig, mesh=None):
+        super().__init__(workload, data, config, mesh=mesh)
+        cfg = config
+        if cfg.defense not in self.DEFENSES:
+            raise ValueError(f"unknown defense {cfg.defense!r}; "
+                             f"available: {self.DEFENSES}")
+
+        def transform(client_params, global_params, rng):
+            p = client_params
+            if cfg.defense in ("norm_diff_clipping", "weak_dp"):
+                p = clip_update(p, global_params, cfg.norm_bound)
+            if cfg.defense == "weak_dp":
+                p = add_gaussian_noise(p, rng, cfg.stddev)
+            return p
+
+        opt = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
+        local_train = make_local_trainer(workload, opt, cfg.epochs)
+        self.cohort_step = make_cohort_step(
+            local_train, mesh=mesh,
+            transform_update=None if cfg.defense == "none" else transform)

@@ -1,0 +1,84 @@
+"""FedOpt — server-side adaptive optimization (Reddi et al. 2020).
+
+Parity with fedml_api/distributed/fedopt/FedOptAggregator.py:
+the server averages client params, forms the pseudo-gradient
+Δ = w_old − w_avg (``set_model_global_grads``, FedOptAggregator.py:108-122:
+``parameter.grad = parameter.data - new_parameter.data``), and applies a
+torch server optimizer.  The reference resolves optimizers by reflection over
+``torch.optim.Optimizer.__subclasses__()`` (utils/optrepo.py:12); here the
+registry maps names to optax transforms.
+
+TPU design: the server step is pure — (w_old, w_avg, opt_state) →
+(w_new, opt_state') — and jits together with the cohort step, so a whole
+FedOpt round (local SGD on the cohort + psum aggregation + Adam server step)
+is still one compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import optax
+
+from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.core.pytree import tree_sub
+
+Pytree = Any
+
+# name -> factory(lr, momentum) (parity surface of OptRepo: the torch
+# optimizers the reference's experiments actually use)
+SERVER_OPTIMIZERS = {
+    "sgd": lambda lr, momentum: optax.sgd(lr, momentum=momentum or None),
+    "adam": lambda lr, momentum: optax.adam(lr),
+    "adagrad": lambda lr, momentum: optax.adagrad(lr),
+    "adamw": lambda lr, momentum: optax.adamw(lr),
+    "rmsprop": lambda lr, momentum: optax.rmsprop(lr, momentum=momentum),
+    "yogi": lambda lr, momentum: optax.yogi(lr),
+}
+
+
+@dataclasses.dataclass
+class FedOptConfig(FedAvgConfig):
+    """Adds the server flags of main_fedopt.py:54-62."""
+    server_optimizer: str = "sgd"
+    server_lr: float = 0.1
+    server_momentum: float = 0.0
+
+
+class FedOpt(FedAvg):
+    """FedAvg + server optimizer on the pseudo-gradient."""
+
+    def __init__(self, workload, data, config: FedOptConfig, mesh=None):
+        super().__init__(workload, data, config, mesh=mesh)
+        try:
+            factory = SERVER_OPTIMIZERS[config.server_optimizer]
+        except KeyError:
+            raise ValueError(
+                f"unknown server optimizer {config.server_optimizer!r}; "
+                f"available: {sorted(SERVER_OPTIMIZERS)}") from None
+        self.server_opt = factory(config.server_lr, config.server_momentum)
+        self.server_opt_state = None
+
+        base_step = self.cohort_step
+
+        @jax.jit
+        def step(global_params, cohort_data, rng, opt_state):
+            w_avg, metrics = base_step(global_params, cohort_data, rng)
+            delta = tree_sub(global_params, w_avg)  # pseudo-gradient
+            updates, opt_state = self.server_opt.update(
+                delta, opt_state, global_params)
+            new_params = optax.apply_updates(global_params, updates)
+            return new_params, metrics, opt_state
+
+        self._fedopt_step = step
+        # FedAvg.run drives self.cohort_step(params, cohort, rng)
+        self.cohort_step = self._stateful_step
+
+    def _stateful_step(self, params, cohort, rng):
+        if self.server_opt_state is None:
+            self.server_opt_state = self.server_opt.init(params)
+        params, metrics, self.server_opt_state = self._fedopt_step(
+            params, cohort, rng, self.server_opt_state)
+        return params, metrics

@@ -1,0 +1,91 @@
+"""Hierarchical FL — two-tier client -> group (edge) -> global averaging.
+
+Parity with fedml_api/standalone/hierarchical_fl/:
+* random client->group assignment (trainer.py:12-18, ``group_method ==
+  'random'``);
+* per global round: the plain seeded sampler picks clients, which are routed
+  to their groups (trainer.py:32-41);
+* each group runs ``group_comm_round`` FedAvg rounds among its sampled
+  clients (group.py:24-46), then groups average weighted by their sampled
+  clients' sample counts (trainer.py:56-62).
+
+TPU mapping (SURVEY.md §2.5): group tier = ICI within a pod slice, global
+tier = DCN across slices.  In this single-program form each group round is a
+cohort-engine jit; group cohorts are padded to one static bucket so all
+groups share one compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List
+
+import jax
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.data.stacking import gather_cohort
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class HierarchicalConfig(FedAvgConfig):
+    group_num: int = 2
+    group_comm_round: int = 2
+    group_method: str = "random"
+
+
+class HierarchicalFedAvg(FedAvg):
+    def __init__(self, workload, data, config: HierarchicalConfig, mesh=None):
+        super().__init__(workload, data, config, mesh=mesh)
+        cfg = config
+        if cfg.group_method != "random":
+            raise ValueError(f"unknown group_method {cfg.group_method!r}")
+        rng = np.random.RandomState(cfg.seed)
+        self.group_indexes = rng.randint(0, cfg.group_num, data.client_num)
+
+    def _group_clients(self, ids: np.ndarray) -> Dict[int, List[int]]:
+        groups: Dict[int, List[int]] = {}
+        for cid in ids:
+            groups.setdefault(int(self.group_indexes[cid]), []).append(int(cid))
+        return groups
+
+    def run(self, params=None, rng=None):
+        cfg = self.cfg
+        rng = rng if rng is not None else jax.random.key(cfg.seed)
+        if params is None:
+            rng, init_rng = jax.random.split(rng)
+            params = self.workload.init(init_rng, jax.tree.map(
+                lambda v: v[0, 0], {k: self.data.train[k]
+                                    for k in ("x", "y", "mask")}))
+
+        for global_round in range(cfg.comm_round):
+            ids = sample_clients(global_round, self.data.client_num,
+                                 cfg.client_num_per_round)
+            groups = self._group_clients(np.asarray(ids))
+            group_params, group_weights = [], []
+            for gidx in sorted(groups):
+                gids = groups[gidx]
+                w_group = params
+                cohort = gather_cohort(self.data.train, gids,
+                                       pad_to=cfg.client_num_per_round)
+                for group_round in range(cfg.group_comm_round):
+                    rng, rr = jax.random.split(rng)
+                    w_group, _ = self.cohort_step(w_group, cohort, rr)
+                group_params.append(w_group)
+                group_weights.append(
+                    float(self.data.train["num_samples"][gids].sum()))
+            params = tree_weighted_mean(group_params,
+                                        jax.numpy.asarray(group_weights))
+
+            if (global_round % cfg.frequency_of_the_test == 0
+                    or global_round == cfg.comm_round - 1):
+                stats = self.evaluate_global(params)
+                stats["round"] = global_round
+                self.history.append(stats)
+                logger.info("global round %d: %s", global_round, stats)
+        return params

@@ -35,6 +35,28 @@ CohortData = Dict[str, jax.Array]  # leaves [C, S, B, ...]; "num_samples" [C]
 CohortStep = Callable[..., Tuple[Pytree, Dict[str, jax.Array]]]
 
 
+def train_cohort(local_train, params: Pytree, data: CohortData,
+                 rng: jax.Array, index_offset=0, transform_update=None):
+    """vmap ``local_train`` over the stacked client axis.
+
+    Per-client rng = fold_in(rng, global cohort slot), so single-chip and
+    mesh-sharded runs are bit-identical even with dropout.  This is the one
+    shared preamble for every cohort-training algorithm (FedAvg cohort step,
+    FedNova, gossip) — keep rng/num_samples conventions here only."""
+    n_clients = data["num_samples"].shape[0]
+    idx = jnp.arange(n_clients) + index_offset
+    rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(idx)
+    client_batches = {k: v for k, v in data.items() if k != "num_samples"}
+    new_params, metrics = jax.vmap(
+        local_train, in_axes=(None, 0, 0))(params, client_batches, rngs)
+    if transform_update is not None:
+        t_rng = jax.random.fold_in(rng, 0x7FFFFFFF)  # distinct stream
+        t_rngs = jax.vmap(lambda i: jax.random.fold_in(t_rng, i))(idx)
+        new_params = jax.vmap(
+            transform_update, in_axes=(0, None, 0))(new_params, params, t_rngs)
+    return new_params, metrics
+
+
 def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
                      aggregate=tree_weighted_mean,
                      transform_update=None) -> CohortStep:
@@ -52,22 +74,10 @@ def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
     sample-weighted FedAvg mean; FedOpt/FedNova swap in their own.
     """
 
-    def _train_cohort(params: Pytree, data: CohortData, rng: jax.Array,
-                      index_offset=0):
-        # per-client rng = fold_in(rng, global cohort slot) so single-chip and
-        # mesh-sharded runs are bit-identical even with dropout
-        n_clients = data["num_samples"].shape[0]
-        idx = jnp.arange(n_clients) + index_offset
-        rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(idx)
-        client_batches = {k: v for k, v in data.items() if k != "num_samples"}
-        new_params, metrics = jax.vmap(
-            local_train, in_axes=(None, 0, 0))(params, client_batches, rngs)
-        if transform_update is not None:
-            t_rng = jax.random.fold_in(rng, -1)
-            t_rngs = jax.vmap(lambda i: jax.random.fold_in(t_rng, i))(idx)
-            new_params = jax.vmap(
-                transform_update, in_axes=(0, None, 0))(new_params, params, t_rngs)
-        return new_params, metrics
+    def _train_cohort(params, data, rng, index_offset=0):
+        return train_cohort(local_train, params, data, rng,
+                            index_offset=index_offset,
+                            transform_update=transform_update)
 
     if mesh is None:
         def step(global_params, cohort_data, rng):

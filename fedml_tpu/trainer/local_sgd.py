@@ -30,12 +30,19 @@ Pytree = Any
 
 def make_local_trainer(workload: Workload,
                        optimizer: optax.GradientTransformation,
-                       epochs: int):
+                       epochs: int, prox_mu: float = 0.0):
     """Returns ``train(params, data, rng) -> (new_params, metrics)``.
 
     ``data`` leaves are [S, B, ...] (S batches of size B) with ``mask``
     [S, B]; the scan runs epochs*S steps, revisiting the same batches each
-    epoch in order (the reference's DataLoader order is fixed per round)."""
+    epoch in order (the reference's DataLoader order is fixed per round).
+
+    ``prox_mu`` adds the FedProx proximal gradient mu*(w - w_global) each
+    step (w_global = the params this call started from).  NOTE the reference's
+    *distributed fedprox* omits this term entirely (SURVEY.md §2.2 caveat —
+    its trainer is vanilla SGD); we implement the actual algorithm (Li et al.
+    2020), matching the mu usage in the reference's FedNova optimizer
+    (fednova.py:133-136)."""
     clip = (optax.clip_by_global_norm(workload.grad_clip_norm)
             if workload.grad_clip_norm is not None else None)
 
@@ -44,6 +51,7 @@ def make_local_trainer(workload: Workload,
 
     def train(params: Pytree, data: Dict[str, jax.Array], rng: jax.Array
               ) -> Tuple[Pytree, Dict[str, jax.Array]]:
+        init_params = params
         opt_state = optimizer.init(params)
         clip_state = clip.init(params) if clip is not None else None
         num_steps = jax.tree.leaves(data)[0].shape[0]
@@ -53,6 +61,9 @@ def make_local_trainer(workload: Workload,
             rng, dropout_rng = jax.random.split(rng)
             batch = jax.tree.map(lambda x: x[step_idx % num_steps], data)
             (loss, _), grads = grad_fn(params, batch, dropout_rng)
+            if prox_mu:
+                grads = jax.tree.map(lambda g, p, p0: g + prox_mu * (p - p0),
+                                     grads, params, init_params)
             if clip is not None:
                 grads, _ = clip.update(grads, clip_state)
             updates, new_opt_state = optimizer.update(grads, opt_state, params)

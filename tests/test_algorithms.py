@@ -1,0 +1,196 @@
+"""Algorithm-family correctness:
+
+* FedOpt with server sgd lr=1.0, momentum=0  ==  plain FedAvg (the pseudo-
+  gradient step w - 1.0*(w - w_avg) = w_avg);
+* FedProx mu=0  ==  FedAvg; mu>0 keeps client updates closer to global;
+* FedNova with E=1, 1 batch, no momentum  ==  FedAvg (tau_eff degenerates);
+* FedAvgRobust clip bound ~0 pins params to global; huge bound == FedAvg;
+* DecentralizedGossip converges to consensus under full mixing; ring
+  ppermute mesh version matches dense ring mixing;
+* HierarchicalFedAvg with 1 group and group_comm_round=1 == FedAvg.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms import (
+    FedAvg, FedAvgConfig, FedOpt, FedOptConfig, FedProx, FedProxConfig,
+    FedNova, FedNovaConfig, FedAvgRobust, FedAvgRobustConfig,
+    DecentralizedGossip, DecentralizedConfig,
+    HierarchicalFedAvg, HierarchicalConfig,
+)
+from fedml_tpu.data.stacking import stack_client_data, FederatedData
+from fedml_tpu.models import LogisticRegression
+from fedml_tpu.trainer.workload import ClassificationWorkload
+
+
+def _data(n_clients=6, dim=8, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.randn(dim, classes)
+    xs, ys = [], []
+    for _ in range(n_clients):
+        n = rng.randint(10, 25)
+        x = rng.randn(n, dim).astype(np.float32)
+        y = np.argmax(x @ W, axis=1).astype(np.int32)
+        xs.append(x); ys.append(y)
+    train = stack_client_data(xs, ys, batch_size=30)  # 1 full batch each
+    return FederatedData(client_num=n_clients, class_num=classes,
+                         train=train, test=train)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return ClassificationWorkload(LogisticRegression(8, 3), num_classes=3,
+                                  grad_clip_norm=None)
+
+
+def _tree_close(a, b, **kw):
+    jax.tree.map(lambda x, y: np.testing.assert_allclose(x, y, **kw), a, b)
+
+
+def _run(algo_cls, cfg, workload, data, seed=11):
+    algo = algo_cls(workload, data, cfg)
+    p0 = algo.init_params(jax.random.key(seed))
+    return algo.run(params=jax.tree.map(jnp.copy, p0),
+                    rng=jax.random.key(seed + 1)), p0
+
+
+BASE = dict(comm_round=3, client_num_per_round=6, epochs=1, batch_size=30,
+            lr=0.2, frequency_of_the_test=100)
+
+
+def test_fedopt_sgd_lr1_equals_fedavg(workload):
+    data = _data()
+    fa, _ = _run(FedAvg, FedAvgConfig(**BASE), workload, data)
+    fo, _ = _run(FedOpt, FedOptConfig(**BASE, server_optimizer="sgd",
+                                      server_lr=1.0, server_momentum=0.0),
+                 workload, data)
+    _tree_close(fa, fo, rtol=1e-5, atol=1e-6)
+
+
+def test_fedopt_adam_runs_and_differs(workload):
+    data = _data()
+    fa, _ = _run(FedAvg, FedAvgConfig(**BASE), workload, data)
+    fo, _ = _run(FedOpt, FedOptConfig(**BASE, server_optimizer="adam",
+                                      server_lr=0.01), workload, data)
+    diffs = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.abs(a - b).max()), fa, fo))
+    assert max(diffs) > 1e-4
+
+
+def test_fedopt_unknown_optimizer(workload):
+    with pytest.raises(ValueError, match="unknown server optimizer"):
+        FedOpt(workload, _data(), FedOptConfig(**BASE, server_optimizer="nope"))
+
+
+def test_fedprox_mu0_equals_fedavg(workload):
+    data = _data()
+    fa, _ = _run(FedAvg, FedAvgConfig(**BASE), workload, data)
+    fp, _ = _run(FedProx, FedProxConfig(**BASE, mu=0.0), workload, data)
+    _tree_close(fa, fp, rtol=1e-6, atol=1e-7)
+
+
+def test_fedprox_mu_pulls_towards_global(workload):
+    data = _data()
+    cfg = dict(BASE, epochs=5)
+    fa, p0 = _run(FedAvg, FedAvgConfig(**cfg), workload, data)
+    fp, _ = _run(FedProx, FedProxConfig(**cfg, mu=10.0), workload, data)
+    from fedml_tpu.core.pytree import tree_vector_norm
+    assert float(tree_vector_norm(fp, p0)) < float(tree_vector_norm(fa, p0))
+
+
+def test_fednova_degenerate_equals_fedavg(workload):
+    """E=1 with a single full batch: every client takes exactly one SGD step,
+    a_i = 1, tau_eff = 1 => FedNova update == FedAvg weighted average."""
+    data = _data()
+    fa, _ = _run(FedAvg, FedAvgConfig(**BASE), workload, data)
+    fn, _ = _run(FedNova, FedNovaConfig(**BASE), workload, data)
+    _tree_close(fa, fn, rtol=1e-4, atol=1e-5)
+
+
+def test_fednova_momentum_runs(workload):
+    data = _data()
+    fn, p0 = _run(FedNova, FedNovaConfig(**dict(BASE, epochs=3),
+                                         momentum=0.9, gmf=0.5), workload, data)
+    diffs = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.abs(a - b).max()), fn, p0))
+    assert max(diffs) > 1e-3
+    assert all(np.isfinite(x) for leaf in jax.tree.leaves(fn)
+               for x in np.asarray(leaf).ravel())
+
+
+def test_robust_clip_zero_bound_freezes(workload):
+    data = _data()
+    cfg = FedAvgRobustConfig(**BASE, defense="norm_diff_clipping",
+                             norm_bound=1e-9)
+    fr, p0 = _run(FedAvgRobust, cfg, workload, data)
+    _tree_close(fr, p0, rtol=0, atol=1e-6)
+
+
+def test_robust_huge_bound_equals_fedavg(workload):
+    data = _data()
+    fa, _ = _run(FedAvg, FedAvgConfig(**BASE), workload, data)
+    fr, _ = _run(FedAvgRobust, FedAvgRobustConfig(
+        **BASE, defense="norm_diff_clipping", norm_bound=1e9), workload, data)
+    _tree_close(fa, fr, rtol=1e-5, atol=1e-6)
+
+
+def test_robust_weak_dp_noise_moves_params(workload):
+    data = _data()
+    fa, _ = _run(FedAvg, FedAvgConfig(**BASE), workload, data)
+    fr, _ = _run(FedAvgRobust, FedAvgRobustConfig(
+        **BASE, defense="weak_dp", norm_bound=1e9, stddev=0.1), workload, data)
+    diffs = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.abs(a - b).max()), fa, fr))
+    assert max(diffs) > 1e-3
+
+
+def test_gossip_reaches_consensus(workload):
+    data = _data(n_clients=8)
+    cfg = DecentralizedConfig(comm_round=12, epochs=1, batch_size=30, lr=0.05,
+                              neighbor_num=4, frequency_of_the_test=100)
+    g = DecentralizedGossip(workload, data, cfg)
+    stacked = g.run()
+    # all nodes should be close after repeated mixing (row-stochastic W)
+    spread = jax.tree.leaves(jax.tree.map(
+        lambda x: float(jnp.max(jnp.abs(x - x.mean(0, keepdims=True)))),
+        stacked))
+    assert max(spread) < 0.5
+
+
+def test_ring_mesh_gossip_matches_dense(workload, devices):
+    from fedml_tpu.parallel.mesh import make_mesh
+    data = _data(n_clients=8)
+    mesh = make_mesh(devices=devices, client_axis=8, model_axis=1)
+    cfg = DecentralizedConfig(comm_round=3, epochs=1, batch_size=30, lr=0.05,
+                              frequency_of_the_test=100)
+    # dense version with the uniform ring matrix (self + both neighbors @ 1/3)
+    W = np.zeros((8, 8), np.float32)
+    for i in range(8):
+        W[i, i] = W[i, (i - 1) % 8] = W[i, (i + 1) % 8] = 1 / 3
+    g_dense = DecentralizedGossip(workload, data, cfg, topology=W)
+    g_mesh = DecentralizedGossip(workload, data, cfg, mesh=mesh)
+    rng = jax.random.key(0)
+    sd = g_dense.run(rng=rng)
+    sm = g_mesh.run(rng=rng)
+    _tree_close(sd, sm, rtol=1e-4, atol=1e-5)
+
+
+def test_hierarchical_single_group_equals_fedavg(workload):
+    data = _data()
+    fa, _ = _run(FedAvg, FedAvgConfig(**BASE), workload, data)
+    fh, _ = _run(HierarchicalFedAvg, HierarchicalConfig(
+        **BASE, group_num=1, group_comm_round=1), workload, data)
+    _tree_close(fa, fh, rtol=1e-5, atol=1e-6)
+
+
+def test_hierarchical_multi_group_runs(workload):
+    data = _data(n_clients=10)
+    cfg = HierarchicalConfig(comm_round=4, client_num_per_round=6, epochs=1,
+                             batch_size=30, lr=0.2, frequency_of_the_test=2,
+                             group_num=3, group_comm_round=2)
+    algo = HierarchicalFedAvg(workload, data, cfg)
+    algo.run()
+    assert algo.history and np.isfinite(algo.history[-1]["train_acc"])
