@@ -1,0 +1,139 @@
+"""Cross-silo FedAvg: the reference's distributed message choreography on the
+host-edge transport layer.
+
+Reference equivalent: the 5-file MPI pattern of
+``fedml_api/distributed/fedavg/`` — FedAvgServerManager.py:18-95 (init
+broadcast, receive barrier, aggregate, sync), FedAvgClientManager.py:18-75
+(train on init/sync, upload), message_define.py:1-30 (int message types).
+
+On-pod this entire choreography collapses into one jit program
+(`fedml_tpu.parallel.cohort`); these actors exist for *true* cross-silo
+federation — separate hosts/trust domains over gRPC/DCN — where each silo
+trains with its own local jit program and only the global aggregation rides
+messages.  Weights travel as binary array frames, not JSON float lists
+(the reference's transform_tensor_to_list codec, fedavg/utils.py:7-16).
+
+The "process k plays sampled client i" trick (FedAVGTrainer.update_dataset,
+FedAVGTrainer.py:25-29) is preserved: the server sends each silo a
+``client_idx`` each round and the silo re-points its local shard.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from fedml_tpu.comm.actors import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.transport import Transport
+from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.core.sampling import sample_clients
+
+log = logging.getLogger(__name__)
+
+
+class MsgType:
+    """Message-type constants (parity: message_define.py:1-30)."""
+    S2C_INIT = 1          # MSG_TYPE_S2C_INIT_CONFIG
+    S2C_SYNC = 2          # MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
+    C2S_MODEL = 3         # MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+    S2C_FINISH = 4        # shutdown signal (reference uses MPI Abort instead)
+
+
+# a silo-local trainer: (global_params, client_idx, round_idx) ->
+# (new_params, num_samples).  Internally this is expected to be a jit'd
+# local-SGD program (fedml_tpu.trainer.local_sgd) over the silo's shard.
+SiloTrainFn = Callable[[object, int, int], tuple]
+
+
+class FedAvgServerActor(ServerManager):
+    """Rank-0 aggregator actor (reference FedAvgServerManager.py:18-95)."""
+
+    def __init__(self, transport: Transport, init_params,
+                 client_num_in_total: int, client_num_per_round: int,
+                 num_rounds: int,
+                 on_round_done: Optional[Callable[[int, object], None]] = None):
+        super().__init__(0, transport)
+        self.params = init_params
+        self.client_num_in_total = client_num_in_total
+        self.client_num_per_round = client_num_per_round
+        self.num_rounds = num_rounds
+        self.round_idx = 0
+        self.on_round_done = on_round_done
+        self._received: Dict[int, tuple] = {}
+        self._num_silos = 0  # silos contacted this round (= sampled cohort)
+
+    def register_handlers(self) -> None:
+        self.register_handler(MsgType.C2S_MODEL, self._on_model)
+
+    # -- round logic ---------------------------------------------------------
+    def start(self) -> None:
+        """Broadcast initial config (send_init_msg, FedAvgServerManager.py:31-39)."""
+        self._broadcast(MsgType.S2C_INIT)
+
+    def _sampled(self) -> np.ndarray:
+        # deterministic per-round sampling, parity with
+        # FedAVGAggregator.client_sampling:89-97 (np.random.seed(round_idx))
+        return sample_clients(self.round_idx, self.client_num_in_total,
+                              self.client_num_per_round)
+
+    def _broadcast(self, msg_type) -> None:
+        ids = self._sampled()
+        # sample_clients caps the cohort at client_num_in_total, so the
+        # receive barrier must track the actual cohort size, not the config
+        self._num_silos = len(ids)
+        host_params = jax.tree.map(np.asarray, self.params)
+        for silo, client_idx in enumerate(ids, start=1):
+            self.send(msg_type, silo,
+                      **{Message.ARG_MODEL_PARAMS: host_params,
+                         Message.ARG_CLIENT_INDEX: int(client_idx),
+                         Message.ARG_ROUND: self.round_idx})
+
+    def _on_model(self, msg: Message) -> None:
+        # barrier semantics: wait for every sampled silo
+        # (check_whether_all_receive, FedAvgServerManager.py:51)
+        self._received[msg.sender_id] = (
+            msg.get(Message.ARG_MODEL_PARAMS), msg.get(Message.ARG_NUM_SAMPLES))
+        if len(self._received) < self._num_silos:
+            return
+        trees = [self._received[s][0] for s in sorted(self._received)]
+        weights = np.array([self._received[s][1] for s in sorted(self._received)],
+                           dtype=np.float32)
+        self._received.clear()
+        self.params = tree_weighted_mean(trees, weights)
+        if self.on_round_done is not None:
+            self.on_round_done(self.round_idx, self.params)
+        self.round_idx += 1
+        if self.round_idx >= self.num_rounds:
+            for silo in range(1, self._num_silos + 1):
+                self.send(MsgType.S2C_FINISH, silo)
+            self.finish()
+        else:
+            self._broadcast(MsgType.S2C_SYNC)
+
+
+class FedAvgClientActor(ClientManager):
+    """Silo-side trainer actor (reference FedAvgClientManager.py:18-75)."""
+
+    def __init__(self, node_id: int, transport: Transport,
+                 train_fn: SiloTrainFn):
+        super().__init__(node_id, transport)
+        self.train_fn = train_fn
+
+    def register_handlers(self) -> None:
+        self.register_handler(MsgType.S2C_INIT, self._on_sync)
+        self.register_handler(MsgType.S2C_SYNC, self._on_sync)
+        self.register_handler(MsgType.S2C_FINISH, lambda m: self.finish())
+
+    def _on_sync(self, msg: Message) -> None:
+        params = msg.get(Message.ARG_MODEL_PARAMS)
+        client_idx = msg.get(Message.ARG_CLIENT_INDEX)
+        round_idx = msg.get(Message.ARG_ROUND)
+        new_params, num_samples = self.train_fn(params, client_idx, round_idx)
+        self.send(MsgType.C2S_MODEL, 0,
+                  **{Message.ARG_MODEL_PARAMS: jax.tree.map(np.asarray,
+                                                            new_params),
+                     Message.ARG_NUM_SAMPLES: int(num_samples)})

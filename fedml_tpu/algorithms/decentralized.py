@@ -84,6 +84,17 @@ def _ring_weights(W: np.ndarray):
     (w_self, w_left, w_right).  The ppermute mesh path supports exactly this
     structure; other topologies need the dense path."""
     n = W.shape[0]
+    if n == 1:
+        if not np.allclose(W, 1.0, atol=1e-6):
+            raise ValueError("1-node gossip requires W == [[1.0]]")
+        return 1.0, 0.0, 0.0
+    if n == 2:
+        # both ring directions alias the single neighbor, so its weight is
+        # split between the two ppermute arrivals (their sum is what mixes)
+        expect = np.array([[W[0, 0], W[0, 1]], [W[0, 1], W[0, 0]]])
+        if not np.allclose(W, expect, atol=1e-6):
+            raise ValueError("2-node gossip requires a symmetric circulant W")
+        return float(W[0, 0]), float(W[0, 1]) / 2, float(W[0, 1]) / 2
     ring = np.zeros_like(W)
     for i in range(n):
         ring[i, i] = W[0, 0]

@@ -1,0 +1,33 @@
+"""Cross-silo / host-edge communication layer.
+
+On-pod, fedml_tpu has no message passing at all — aggregation is a collective
+inside one jit program (`fedml_tpu.parallel.cohort`).  This package is the
+*edge* of the system: the place where true cross-silo federation (separate
+hosts, separate trust domains, WAN links) still needs an explicit
+message-passing protocol, as in the reference's
+``fedml_core/distributed/communication`` stack.
+
+Differences from the reference, by design:
+
+- Payloads are **binary array frames**, not JSON-encoded nested float lists.
+  The reference serializes every weight tensor through
+  ``transform_tensor_to_list`` → json (fedml_api/distributed/fedavg/utils.py:7-16),
+  a multi-x size and decode overhead; here pytrees are framed as a compact
+  JSON header plus raw ``ndarray`` bytes (`fedml_tpu.comm.message`).
+- The in-process transport is a first-class, deterministic test fixture —
+  the reference references a MOCK backend that does not exist in its tree
+  (fedml_core/distributed/client/client_manager.py:7).
+- The gRPC backend uses grpc's generic bytes-in/bytes-out RPC, no codegen
+  (the reference ships protoc-generated stubs of a string-payload proto,
+  gRPC/proto/grpc_comm_manager.proto:3-16).
+"""
+
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.transport import Observer, Transport
+from fedml_tpu.comm.local import LocalHub, LocalTransport
+from fedml_tpu.comm.actors import NodeManager, ClientManager, ServerManager
+
+__all__ = [
+    "Message", "Observer", "Transport", "LocalHub", "LocalTransport",
+    "NodeManager", "ClientManager", "ServerManager",
+]

@@ -1,0 +1,73 @@
+"""Actor layer: handler-registry node managers for cross-silo federation.
+
+Reference equivalent: ``ClientManager``
+(fedml_core/distributed/client/client_manager.py:13-62) and ``ServerManager``
+(fedml_core/distributed/server/server_manager.py:13-59): an event loop plus a
+``message_handler_dict`` keyed by message type.
+
+Differences: transports are injected (no backend-string switch with hardcoded
+MQTT broker IPs, client_manager.py:20-30); ``finish()`` is a clean transport
+stop, not ``MPI.COMM_WORLD.Abort()`` (server_manager.py:64).  On-pod
+federation never instantiates these — the whole round is one jit program;
+actors exist only for host-edge (cross-silo gRPC / device) deployments.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from typing import Callable, Dict
+
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.transport import Transport
+
+log = logging.getLogger(__name__)
+
+
+class NodeManager(abc.ABC):
+    """Event-loop node with a message-type → handler registry."""
+
+    def __init__(self, node_id: int, transport: Transport):
+        self.node_id = node_id
+        self.transport = transport
+        self.transport.add_observer(self)
+        self._handlers: Dict[object, Callable[[Message], None]] = {}
+
+    # -- registry (reference client_manager.py:58-62) ------------------------
+    def register_handler(self, msg_type, fn: Callable[[Message], None]) -> None:
+        self._handlers[msg_type] = fn
+
+    @abc.abstractmethod
+    def register_handlers(self) -> None:
+        """Subclasses register their message handlers here."""
+
+    # -- observer ------------------------------------------------------------
+    def receive_message(self, msg_type, msg: Message) -> None:
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            log.warning("node %d: no handler for message type %r",
+                        self.node_id, msg_type)
+            return
+        handler(msg)
+
+    # -- lifecycle (reference client_manager.py:34-36) -----------------------
+    def run(self) -> None:
+        self.register_handlers()
+        self.transport.run()
+
+    def send(self, msg_type, receiver_id: int, **params) -> None:
+        msg = Message(msg_type, self.node_id, receiver_id)
+        for k, v in params.items():
+            msg.add(k, v)
+        self.transport.send_message(msg)
+
+    def finish(self) -> None:
+        self.transport.stop()
+
+
+class ClientManager(NodeManager):
+    """Cross-silo client actor (reference ClientManager, client_manager.py:13)."""
+
+
+class ServerManager(NodeManager):
+    """Cross-silo server actor (reference ServerManager, server_manager.py:13)."""

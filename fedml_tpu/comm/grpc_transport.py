@@ -1,0 +1,118 @@
+"""gRPC transport for true cross-silo (WAN / DCN) federation.
+
+Reference equivalent: ``GRPCCommManager``
+(fedml_core/distributed/communication/gRPC/grpc_comm_manager.py:53-97): one
+gRPC server per node at port ``base_port + node_id``, peers resolved from a
+CSV rank→IP table, messages pushed via a unary ``sendMessage`` RPC.
+
+TPU-native redesign:
+
+- **no codegen**: grpc's generic handler API with identity (bytes) serializers
+  replaces the protoc-generated string-payload stubs
+  (gRPC/proto/grpc_comm_manager.proto:3-16) — the wire format is the binary
+  array framing of `fedml_tpu.comm.message`, not JSON-in-a-proto-string.
+- inbound dispatch is a plain blocking queue consumed by ``run()`` — no
+  lock-guarded polling subroutine (grpc_comm_manager.py:86-97).
+- the reference's 100 MB message cap is kept (grpc_comm_manager.py:20-24)
+  but configurable.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+from typing import Dict
+
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.transport import Transport
+
+log = logging.getLogger(__name__)
+
+_SERVICE = "fedml_tpu.Comm"
+_METHOD = "Send"
+_STOP = object()
+
+
+def _ident(x: bytes) -> bytes:
+    return x
+
+
+class GrpcTransport(Transport):
+    """One endpoint of a full gRPC mesh (every node runs a server)."""
+
+    def __init__(self, node_id: int, ip_table: Dict[int, str],
+                 base_port: int = 50000, max_message_mb: int = 1000):
+        super().__init__()
+        import grpc  # deferred: optional at import time of the package
+        self._grpc = grpc
+        self.node_id = node_id
+        self.ip_table = dict(ip_table)
+        self.base_port = base_port
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._channels: Dict[int, object] = {}
+
+        opts = [("grpc.max_send_message_length", max_message_mb * 1024 * 1024),
+                ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024)]
+        inbox = self._inbox
+
+        def _handle_send(request: bytes, context) -> bytes:
+            inbox.put(Message.from_bytes(request))
+            return b""
+
+        rpc = grpc.unary_unary_rpc_method_handler(
+            _handle_send, request_deserializer=_ident,
+            response_serializer=_ident)
+        handler = grpc.method_handlers_generic_handler(_SERVICE, {_METHOD: rpc})
+        import concurrent.futures
+        self._server = grpc.server(
+            concurrent.futures.ThreadPoolExecutor(max_workers=4),
+            handlers=(handler,), options=opts)
+        self._port = self._server.add_insecure_port(
+            f"[::]:{base_port + node_id}")
+        if self._port == 0:
+            raise RuntimeError(
+                f"grpc transport node {node_id}: failed to bind port "
+                f"{base_port + node_id} (already in use?)")
+        self._opts = opts
+        self._server.start()
+        log.info("grpc transport node %d listening on :%d", node_id, self._port)
+
+    def _stub(self, receiver_id: int):
+        if receiver_id not in self._channels:
+            addr = f"{self.ip_table[receiver_id]}:{self.base_port + receiver_id}"
+            channel = self._grpc.insecure_channel(addr, options=self._opts)
+            call = channel.unary_unary(
+                f"/{_SERVICE}/{_METHOD}", request_serializer=_ident,
+                response_deserializer=_ident)
+            self._channels[receiver_id] = (channel, call)
+        return self._channels[receiver_id][1]
+
+    def send_message(self, msg: Message) -> None:
+        self._stub(msg.receiver_id)(msg.to_bytes())
+
+    def run(self) -> None:
+        while True:
+            item = self._inbox.get()
+            if item is _STOP:
+                return
+            self._notify(item)
+
+    def stop(self) -> None:
+        self._inbox.put(_STOP)
+        for channel, _ in self._channels.values():
+            channel.close()
+        self._server.stop(grace=None)
+
+
+def load_ip_table(csv_path: str) -> Dict[int, str]:
+    """Parse the reference's rank→IP CSV (``grpc_ipconfig.csv``; parser at
+    fedml_api/distributed/utils/ip_config_utils.py:4-14)."""
+    table: Dict[int, str] = {}
+    with open(csv_path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line or (i == 0 and not line.split(",")[0].isdigit()):
+                continue  # header row
+            rank, ip = line.split(",")[:2]
+            table[int(rank)] = ip.strip()
+    return table

@@ -1,0 +1,74 @@
+"""MQTT transport bridge for device / IoT federation (parity feature).
+
+Reference equivalent: ``MqttCommManager``
+(fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:47-120):
+pub/sub over a broker with the topic scheme ``fedml_<receiver>`` for
+server→client and ``fedml0_<sender>`` for client→server, JSON payloads.
+
+Differences: broker host/port are constructor args (the reference hardcodes
+a broker IP in ``client_manager.py:23-26``); payloads are the binary array
+frames of `fedml_tpu.comm.message` published as MQTT bytes.  Requires
+``paho-mqtt``, which is optional — import of this module raises a clear
+error if the dependency is absent (the rest of the framework never needs it).
+"""
+
+from __future__ import annotations
+
+import queue
+
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.transport import Transport
+
+try:
+    import paho.mqtt.client as _mqtt
+    HAVE_MQTT = True
+except ImportError:  # pragma: no cover - environment without paho-mqtt
+    _mqtt = None
+    HAVE_MQTT = False
+
+_STOP = object()
+
+
+class MqttTransport(Transport):
+    def __init__(self, node_id: int, broker_host: str, broker_port: int = 1883,
+                 topic_prefix: str = "fedml_tpu"):
+        if not HAVE_MQTT:
+            raise ImportError(
+                "paho-mqtt is not installed; MqttTransport is unavailable. "
+                "Use GrpcTransport or LocalTransport instead.")
+        super().__init__()
+        self.node_id = node_id
+        self.topic_prefix = topic_prefix
+        self._inbox: "queue.Queue" = queue.Queue()
+        cid = f"{topic_prefix}_{node_id}"
+        if hasattr(_mqtt, "CallbackAPIVersion"):  # paho-mqtt >= 2.0
+            self._client = _mqtt.Client(_mqtt.CallbackAPIVersion.VERSION1,
+                                        client_id=cid)
+        else:
+            self._client = _mqtt.Client(client_id=cid)
+        self._client.on_message = self._on_message
+        self._client.connect(broker_host, broker_port)
+        self._client.subscribe(self._topic(node_id), qos=1)
+        self._client.loop_start()
+
+    def _topic(self, node_id: int) -> str:
+        return f"{self.topic_prefix}/{node_id}"
+
+    def _on_message(self, client, userdata, mqtt_msg) -> None:
+        self._inbox.put(Message.from_bytes(mqtt_msg.payload))
+
+    def send_message(self, msg: Message) -> None:
+        self._client.publish(self._topic(msg.receiver_id), msg.to_bytes(),
+                             qos=1)
+
+    def run(self) -> None:
+        while True:
+            item = self._inbox.get()
+            if item is _STOP:
+                return
+            self._notify(item)
+
+    def stop(self) -> None:
+        self._inbox.put(_STOP)
+        self._client.loop_stop()
+        self._client.disconnect()

@@ -1,0 +1,56 @@
+"""Transport SPI — the seam between algorithm choreography and the wire.
+
+Reference equivalent: ``BaseCommunicationManager``
+(fedml_core/distributed/communication/base_com_manager.py:7-27) and
+``Observer`` (observer.py:4-8).  Same contract, two differences:
+
+- `run()` is explicit and blocking (the reference hides a 0.3 s polling loop
+  inside ``handle_receive_message``, mpi/com_manager.py:71-81; our transports
+  block on queues/sockets — no idle polling).
+- transports declare a ``flavor``: ``"p2p"`` for host-edge message passing
+  (local / tcp-grpc / mqtt) — on-pod "transport" does not exist as an object
+  at all, it is `lax.psum` inside the jit program.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Protocol, runtime_checkable
+
+from fedml_tpu.comm.message import Message
+
+
+@runtime_checkable
+class Observer(Protocol):
+    def receive_message(self, msg_type, msg: Message) -> None: ...
+
+
+class Transport(abc.ABC):
+    """Abstract p2p transport: deliver Messages between numbered nodes."""
+
+    flavor = "p2p"
+
+    def __init__(self):
+        self._observers: list[Observer] = []
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def _notify(self, msg: Message) -> None:
+        for obs in self._observers:
+            obs.receive_message(msg.type, msg)
+
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None:
+        """Deliver msg to msg.receiver_id (asynchronously)."""
+
+    @abc.abstractmethod
+    def run(self) -> None:
+        """Block dispatching inbound messages to observers until stopped."""
+
+    @abc.abstractmethod
+    def stop(self) -> None:
+        """Unblock run() and release resources."""
