@@ -1,0 +1,34 @@
+"""Secure aggregation: finite-field MPC primitives + TPU-native masking.
+
+Reference equivalent: the TurboAggregate algorithm family
+(``fedml_api/distributed/turboaggregate/`` and ``standalone/turboaggregate``)
+whose kernel is ``mpc_function.py`` — Lagrange-coded computing (LCC), BGW
+(Shamir) secret sharing, and additive secret shares over a prime field.
+
+Two layers here:
+
+- `fedml_tpu.secure.field` — the exact finite-field toolbox (host-side
+  numpy, vectorized): Shamir/BGW sharing, Lagrange coefficient generation,
+  LCC encode/decode, additive shares, DH-style key agreement.  This is what
+  rides the cross-silo transport between mutually-distrusting silos.
+- `fedml_tpu.secure.secagg` — the TPU-native hot path: pairwise additive
+  masking in the ring Z_2^32 (uint32 wraparound — mod arithmetic for free,
+  the construction of practical SecAgg), so the masked cohort sum is a plain
+  `lax.psum` inside the jit round program; masks cancel exactly.
+"""
+
+from fedml_tpu.secure.field import (
+    mod_inv, mod_div, prod_mod, lagrange_coeffs, bgw_encode, bgw_decode,
+    lcc_encode, lcc_decode, lcc_encode_with_points, lcc_decode_with_points,
+    additive_shares, pk_gen, key_agreement,
+)
+from fedml_tpu.secure.secagg import (
+    quantize, dequantize, pairwise_masks, SecureCohortAggregator,
+)
+
+__all__ = [
+    "mod_inv", "mod_div", "prod_mod", "lagrange_coeffs", "bgw_encode",
+    "bgw_decode", "lcc_encode", "lcc_decode", "lcc_encode_with_points",
+    "lcc_decode_with_points", "additive_shares", "pk_gen", "key_agreement",
+    "quantize", "dequantize", "pairwise_masks", "SecureCohortAggregator",
+]
