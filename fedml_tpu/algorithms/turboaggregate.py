@@ -24,6 +24,7 @@ TPU-native composition:
 from __future__ import annotations
 
 import dataclasses
+import secrets
 from typing import Any, List, Optional
 
 import jax
@@ -52,8 +53,14 @@ class TurboAggregateConfig:
     lr: float = 0.03
     client_optimizer: str = "sgd"
     seed: int = 0
-    quant_scale: float = 2.0**16
+    # clip * scale must stay within the centered field range P//2, or a
+    # saturated element decodes with flipped sign (see __init__ assert)
+    quant_scale: float = 2.0**15
     quant_clip: float = 2.0**14
+    # secret entropy for the LCC masking chunks; None = fresh per instance.
+    # MUST stay secret from share holders — seeding from public values (e.g.
+    # the group index) voids T-privacy entirely.
+    privacy_key: Optional[int] = None
 
 
 class TurboAggregate:
@@ -64,6 +71,12 @@ class TurboAggregate:
         self.workload = workload
         self.data = data
         self.cfg = config
+        assert config.quant_clip * config.quant_scale <= P_DEFAULT // 2, (
+            "quant_clip*quant_scale exceeds the centered field range "
+            f"P//2={P_DEFAULT // 2}: a clipped element at +clip would decode "
+            "with flipped sign on the dropout-recovery path")
+        self._privacy_key = (config.privacy_key if config.privacy_key
+                             is not None else secrets.randbits(63))
         opt = make_client_optimizer(config.client_optimizer, config.lr)
         self._local = jax.jit(jax.vmap(
             make_local_trainer(workload, opt, config.epochs),
@@ -134,8 +147,12 @@ class TurboAggregate:
             assert N - T >= K + T, (
                 f"clients_per_group={N} cannot tolerate T={T} dropouts with "
                 f"K={K} data chunks (need N >= K + 2T = {K + 2 * T})")
-            shares = lcc_encode(q2.T, N, K, T, p=P_DEFAULT,
-                                rng=np.random.RandomState(g))
+            # fresh SECRET randomness per (round, group): the T masking
+            # chunks must be unpredictable to share holders and never reused
+            # across rounds (reuse lets two rounds' shares cancel the mask)
+            share_rng = np.random.RandomState(np.random.MT19937(
+                np.random.SeedSequence([self._privacy_key, round_idx, g])))
+            shares = lcc_encode(q2.T, N, K, T, p=P_DEFAULT, rng=share_rng)
             survivors = list(range(T, N))
             decoded = lcc_decode(shares[survivors], N, K, T, survivors,
                                  p=P_DEFAULT)

@@ -1,3 +1,10 @@
 from fedml_tpu.models.linear import LogisticRegression
 from fedml_tpu.models.cnn import CNNOriginalFedAvg, CNNDropOut
 from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow
+from fedml_tpu.models.norms import Norm
+from fedml_tpu.models.resnet import (
+    CifarResNet, ImageNetResNet, resnet56, resnet110, resnet18_gn)
+from fedml_tpu.models.vgg import VGG, vgg11, vgg13, vgg16
+from fedml_tpu.models.mobilenet import (
+    MobileNetV1, MobileNetV3, mobilenet, mobilenet_v3)
+from fedml_tpu.models.efficientnet import EfficientNet, efficientnet

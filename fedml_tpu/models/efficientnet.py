@@ -1,0 +1,93 @@
+"""EfficientNet B0-B7 (parity: fedml_api/model/cv/efficientnet.py:138 +
+efficientnet_utils.py) — Tan & Le'19 compound-scaled MBConv nets.
+
+The reference carries ~900 LoC of utils (swish autograd hacks, TF-'same'
+padding shims, url loaders); on TPU none of that survives: swish is
+``nn.swish`` (XLA fuses it), 'SAME' padding is native, and pretrained-url
+loading is out of scope.  What remains is the architecture itself:
+stem -> 7 MBConv stages (compound-scaled) -> head -> pool -> classifier.
+
+Drop-connect (stochastic depth) is applied per-sample during training like
+the reference (efficientnet_utils.py drop_connect).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.norms import Norm, conv_kernel_init
+from fedml_tpu.models.mobilenet import InvertedResidual
+
+# (expand_ratio, channels, repeats, stride, kernel) — B0 baseline, Table 1.
+_B0_BLOCKS = (
+    (1, 16, 1, 1, 3), (6, 24, 2, 2, 3), (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3), (6, 112, 3, 1, 5), (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3))
+
+# name -> (width_mult, depth_mult, dropout) (efficientnet_utils.py:
+# efficientnet_params).
+_SCALINGS = {
+    "b0": (1.0, 1.0, 0.2), "b1": (1.0, 1.1, 0.2), "b2": (1.1, 1.2, 0.3),
+    "b3": (1.2, 1.4, 0.3), "b4": (1.4, 1.8, 0.4), "b5": (1.6, 2.2, 0.4),
+    "b6": (1.8, 2.6, 0.5), "b7": (2.0, 3.1, 0.5),
+}
+
+
+def _round_filters(ch: int, width_mult: float, divisor: int = 8) -> int:
+    ch *= width_mult
+    new = max(divisor, int(ch + divisor / 2) // divisor * divisor)
+    if new < 0.9 * ch:
+        new += divisor
+    return int(new)
+
+
+def _round_repeats(r: int, depth_mult: float) -> int:
+    return int(math.ceil(depth_mult * r))
+
+
+class EfficientNet(nn.Module):
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    depth_mult: float = 1.0
+    dropout: float = 0.2
+    drop_connect: float = 0.2
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(_round_filters(32, self.width_mult), (3, 3),
+                    strides=(2, 2), padding="SAME", use_bias=False,
+                    kernel_init=conv_kernel_init)(x)
+        x = nn.swish(Norm(self.norm)(x, train))
+        total = sum(_round_repeats(r, self.depth_mult)
+                    for _, _, r, _, _ in _B0_BLOCKS)
+        idx = 0
+        for expand, ch, repeats, stride, kernel in _B0_BLOCKS:
+            out_ch = _round_filters(ch, self.width_mult)
+            for i in range(_round_repeats(repeats, self.depth_mult)):
+                in_ch = x.shape[-1]
+                x = InvertedResidual(
+                    exp_ch=in_ch * expand, out_ch=out_ch, kernel=kernel,
+                    stride=stride if i == 0 else 1, use_se=True,
+                    use_hs=False, norm=self.norm, activation=nn.swish,
+                    se_reduce_ch=max(1, in_ch // 4),
+                    drop_rate=self.drop_connect * idx / total)(x, train)
+                idx += 1
+        x = nn.Conv(_round_filters(1280, self.width_mult), (1, 1),
+                    use_bias=False, kernel_init=conv_kernel_init)(x)
+        x = nn.swish(Norm(self.norm)(x, train))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def efficientnet(name: str = "b0", num_classes: int = 1000,
+                 norm: str = "group") -> EfficientNet:
+    """``EfficientNet.from_name('efficientnet-b0')`` parity
+    (efficientnet.py:318-322)."""
+    w, d, drop = _SCALINGS[name]
+    return EfficientNet(num_classes=num_classes, width_mult=w, depth_mult=d,
+                        dropout=drop, norm=norm)

@@ -1,0 +1,164 @@
+"""MobileNet V1 and V3 (parity: fedml_api/model/cv/mobilenet.py:60,
+mobilenet_v3.py:137) — the cross-silo CIFAR/CINIC benchmark models.
+
+V1 = Howard'17 depthwise-separable stack; V3 = Howard'19 inverted residuals
+with squeeze-excite and hard-swish, in LARGE and SMALL configs.  Norm is
+switchable (reference uses BatchNorm; GroupNorm default here, models/norms.py).
+NHWC layout.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.norms import Norm, conv_kernel_init
+
+
+def _conv_norm(x, features, kernel, stride, norm, train, act):
+    x = nn.Conv(features, (kernel, kernel), strides=(stride, stride),
+                padding="SAME", use_bias=False,
+                kernel_init=conv_kernel_init)(x)
+    x = Norm(norm)(x, train)
+    return act(x)
+
+
+def _depthwise(x, kernel, stride, norm, train, act):
+    ch = x.shape[-1]
+    x = nn.Conv(ch, (kernel, kernel), strides=(stride, stride),
+                padding="SAME", feature_group_count=ch, use_bias=False,
+                kernel_init=conv_kernel_init)(x)
+    x = Norm(norm)(x, train)
+    return act(x)
+
+
+class MobileNetV1(nn.Module):
+    """13 depthwise-separable blocks (mobilenet.py:60-106)."""
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    norm: str = "group"
+
+    # (out_channels, stride) after the stem conv
+    _blocks: Sequence[Tuple[int, int]] = (
+        (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+        (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+        (1024, 1))
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = lambda c: max(8, int(c * self.width_mult))
+        x = _conv_norm(x, w(32), 3, 2, self.norm, train, nn.relu)
+        for out_ch, stride in self._blocks:
+            x = _depthwise(x, 3, stride, self.norm, train, nn.relu)
+            x = _conv_norm(x, w(out_ch), 1, 1, self.norm, train, nn.relu)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class SqueezeExcite(nn.Module):
+    reduce_ch: int
+
+    @nn.compact
+    def __call__(self, x):
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(self.reduce_ch)(s))
+        s = jax.nn.hard_sigmoid(nn.Dense(x.shape[-1])(s))
+        return x * s[:, None, None, :]
+
+
+class InvertedResidual(nn.Module):
+    """MBConv block (mobilenet_v3.py:55-100): 1x1 expand -> k x k depthwise
+    (+SE) -> 1x1 project, residual when stride 1 and channels match.
+
+    One block serves both MobileNetV3 (relu/hard-swish via ``use_hs``) and
+    EfficientNet (``activation=nn.swish``, ``se_reduce_ch`` from input
+    channels, per-block stochastic-depth ``drop_rate``)."""
+    exp_ch: int
+    out_ch: int
+    kernel: int
+    stride: int
+    use_se: bool
+    use_hs: bool
+    norm: str = "group"
+    activation: Callable | None = None  # overrides the use_hs switch
+    se_reduce_ch: int | None = None     # default: exp_ch // 4
+    drop_rate: float = 0.0              # stochastic depth on the residual
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        act = self.activation or (jax.nn.hard_swish if self.use_hs
+                                  else nn.relu)
+        identity = x
+        h = x
+        if self.exp_ch != x.shape[-1]:
+            h = _conv_norm(h, self.exp_ch, 1, 1, self.norm, train, act)
+        h = _depthwise(h, self.kernel, self.stride, self.norm, train, act)
+        if self.use_se:
+            h = SqueezeExcite(self.se_reduce_ch
+                              or max(8, self.exp_ch // 4))(h)
+        h = nn.Conv(self.out_ch, (1, 1), use_bias=False,
+                    kernel_init=conv_kernel_init)(h)
+        h = Norm(self.norm)(h, train)
+        if self.stride == 1 and x.shape[-1] == self.out_ch:
+            if train and self.drop_rate > 0.0:
+                rng = self.make_rng("dropout")
+                keep = 1.0 - self.drop_rate
+                mask = jax.random.bernoulli(
+                    rng, keep, (h.shape[0],) + (1,) * (h.ndim - 1))
+                h = h * mask / keep
+            h = h + identity
+        return h
+
+
+# (kernel, exp, out, SE, HS, stride) — Howard'19 Tables 1 & 2
+# (mobilenet_v3.py:137-170 mobilenetv3_large / mobilenetv3_small cfgs).
+_V3_LARGE = (
+    (3, 16, 16, False, False, 1), (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1), (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1), (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2), (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1), (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1), (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2), (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1))
+_V3_SMALL = (
+    (3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1), (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2), (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1))
+
+
+class MobileNetV3(nn.Module):
+    num_classes: int = 1000
+    mode: str = "large"          # "large" | "small"
+    norm: str = "group"
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        cfg = _V3_LARGE if self.mode == "large" else _V3_SMALL
+        x = _conv_norm(x, 16, 3, 2, self.norm, train, jax.nn.hard_swish)
+        for k, exp, out, se, hs, s in cfg:
+            x = InvertedResidual(exp, out, k, s, se, hs, self.norm)(x, train)
+        last_exp = cfg[-1][1]
+        x = _conv_norm(x, last_exp, 1, 1, self.norm, train, jax.nn.hard_swish)
+        x = jnp.mean(x, axis=(1, 2))
+        x = jax.nn.hard_swish(nn.Dense(1280 if self.mode == "large" else 1024)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def mobilenet(num_classes: int = 1000, norm: str = "group",
+              width_mult: float = 1.0) -> MobileNetV1:
+    return MobileNetV1(num_classes=num_classes, norm=norm,
+                       width_mult=width_mult)
+
+
+def mobilenet_v3(num_classes: int = 1000, mode: str = "large",
+                 norm: str = "group") -> MobileNetV3:
+    return MobileNetV3(num_classes=num_classes, mode=mode, norm=norm)

@@ -1,0 +1,59 @@
+"""Normalization layers with a batch/group switch.
+
+Parity targets:
+* ``norm2d`` in the reference's GN ResNet (fedml_api/model/cv/resnet_gn.py:26-33)
+  — BatchNorm when ``group_norm == 0`` else GroupNorm with
+  ``num_channels_per_group`` channels per group;
+* the custom ``GroupNorm2d/3d`` (cv/group_normalization.py:56-118) — here just
+  flax ``nn.GroupNorm`` (rank-agnostic: flax normalizes over all non-batch
+  axes already, so no 2d/3d split is needed);
+* ``SynchronizedBatchNorm`` (cv/batchnorm_utils.py) is deliberately ABSENT:
+  under jit + shard_map, cross-device batch stats are one ``lax.pmean`` away
+  and flax's ``axis_name`` argument does exactly that — the reference's
+  master/slave pipe machinery (462 LoC) is obsolete on TPU (SURVEY.md §2.3).
+
+On-pod FL strongly prefers GroupNorm (the reference ships the GN ResNet for
+fed_cifar100 for the same reason: small local batches make BN stats noisy),
+so ``kind="group"`` is the default everywhere.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class Norm(nn.Module):
+    """Channel norm over the trailing axis, switchable batch/group.
+
+    ``zero_init`` zero-initializes the scale — the reference zeroes the last
+    norm of every residual block (resnet_gn.py:142-146) so blocks start as
+    identity; same trick here.
+    """
+    kind: str = "group"          # "group" | "batch" | "none"
+    channels_per_group: int = 32  # norm2d's num_channels_per_group default
+    zero_init: bool = False
+    axis_name: str | None = None  # set to mesh axis for cross-device BN stats
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        scale_init = (nn.initializers.zeros if self.zero_init
+                      else nn.initializers.ones)
+        if self.kind == "none":
+            return x
+        if self.kind == "batch":
+            return nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                scale_init=scale_init, axis_name=self.axis_name)(x)
+        channels = x.shape[-1]
+        groups = max(1, channels // self.channels_per_group)
+        while channels % groups:  # GroupNorm requires groups | channels
+            groups -= 1
+        return nn.GroupNorm(num_groups=groups, epsilon=1e-5,
+                            scale_init=scale_init)(x)
+
+
+# torch's Conv2d default in the reference nets is overridden to
+# kaiming_normal fan_out (resnet.py:160-166, resnet_gn.py:131-134); flax's
+# variance_scaling(2.0, fan_out, truncated_normal) is the same family.
+conv_kernel_init = nn.initializers.variance_scaling(
+    2.0, "fan_out", "truncated_normal")

@@ -1,0 +1,145 @@
+"""ResNets: CIFAR-style resnet56/110 and the ImageNet-style GN resnet18.
+
+Parity targets:
+* ``resnet56/resnet110`` (fedml_api/model/cv/resnet.py:202,225): CIFAR stem
+  (3x3 conv, 16 planes), Bottleneck blocks [6,6,6] / [12,12,12], stages
+  16/32/64 (x4 expansion), used by the cross-silo CIFAR10/100/CINIC10
+  benchmarks.  The reference uses BatchNorm; here the norm is switchable and
+  defaults to GroupNorm (see models/norms.py).
+* ``resnet18`` GN variant (cv/resnet_gn.py:183): ImageNet stem (7x7/2 conv +
+  3x3/2 maxpool), BasicBlock [2,2,2,2], stages 64..512 — the fed_cifar100
+  benchmark model.
+* ``KD=True`` forward returning (features, logits) (resnet.py:193-198) is the
+  ``features`` method here — FedGKT's server net consumes it.
+
+Layout is NHWC throughout (TPU-native; the reference is NCHW torch).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.norms import Norm, conv_kernel_init
+
+
+def _conv(features: int, kernel: int, stride: int = 1) -> nn.Conv:
+    return nn.Conv(features, (kernel, kernel), strides=(stride, stride),
+                   padding="SAME", use_bias=False,
+                   kernel_init=conv_kernel_init)
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (resnet.py:30-67), expansion 1."""
+    planes: int
+    stride: int = 1
+    norm: str = "group"
+    expansion = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        identity = x
+        out = _conv(self.planes, 3, self.stride)(x)
+        out = Norm(self.norm)(out, train)
+        out = nn.relu(out)
+        out = _conv(self.planes, 3)(out)
+        out = Norm(self.norm, zero_init=True)(out, train)
+        if self.stride != 1 or x.shape[-1] != self.planes:
+            identity = _conv(self.planes, 1, self.stride)(x)
+            identity = Norm(self.norm)(identity, train)
+        return nn.relu(out + identity)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 residual block (resnet.py:70-110), expansion 4."""
+    planes: int
+    stride: int = 1
+    norm: str = "group"
+    expansion = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        width = self.planes
+        out_ch = self.planes * self.expansion
+        identity = x
+        out = _conv(width, 1)(x)
+        out = Norm(self.norm)(out, train)
+        out = nn.relu(out)
+        out = _conv(width, 3, self.stride)(out)
+        out = Norm(self.norm)(out, train)
+        out = nn.relu(out)
+        out = _conv(out_ch, 1)(out)
+        out = Norm(self.norm, zero_init=True)(out, train)
+        if self.stride != 1 or x.shape[-1] != out_ch:
+            identity = _conv(out_ch, 1, self.stride)(x)
+            identity = Norm(self.norm)(identity, train)
+        return nn.relu(out + identity)
+
+
+class CifarResNet(nn.Module):
+    """3-stage CIFAR ResNet (resnet.py:113-198)."""
+    layers: Sequence[int]
+    num_classes: int = 10
+    norm: str = "group"
+    block: type = Bottleneck
+
+    @nn.compact
+    def forward_features(self, x, train: bool = False
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """The KD=True forward (resnet.py:193-198): (pooled features, logits)."""
+        x = _conv(16, 3)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.relu(x)
+        for stage, (planes, n_blocks) in enumerate(
+                zip((16, 32, 64), self.layers)):
+            for i in range(n_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = self.block(planes, stride, self.norm)(x, train)
+        feats = jnp.mean(x, axis=(1, 2))  # global average pool
+        logits = nn.Dense(self.num_classes, name="fc")(feats)
+        return feats, logits
+
+    def __call__(self, x, train: bool = False):
+        return self.forward_features(x, train)[1]
+
+
+class ImageNetResNet(nn.Module):
+    """4-stage ImageNet-stem ResNet (resnet_gn.py:108-180)."""
+    layers: Sequence[int]
+    num_classes: int = 1000
+    norm: str = "group"
+    block: type = BasicBlock
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding="SAME",
+                    use_bias=False, kernel_init=conv_kernel_init)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, (planes, n_blocks) in enumerate(
+                zip((64, 128, 256, 512), self.layers)):
+            for i in range(n_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = self.block(planes, stride, self.norm)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="fc")(x)
+
+
+def resnet56(num_classes: int, norm: str = "group") -> CifarResNet:
+    """Bottleneck [6,6,6] (resnet.py:202-222)."""
+    return CifarResNet(layers=(6, 6, 6), num_classes=num_classes, norm=norm)
+
+
+def resnet110(num_classes: int, norm: str = "group") -> CifarResNet:
+    """Bottleneck [12,12,12] (resnet.py:225-246)."""
+    return CifarResNet(layers=(12, 12, 12), num_classes=num_classes, norm=norm)
+
+
+def resnet18_gn(num_classes: int, norm: str = "group") -> ImageNetResNet:
+    """BasicBlock [2,2,2,2] with GroupNorm (resnet_gn.py:183-192) — the
+    fed_cifar100 benchmark model."""
+    return ImageNetResNet(layers=(2, 2, 2, 2), num_classes=num_classes,
+                          norm=norm)

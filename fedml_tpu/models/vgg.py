@@ -1,0 +1,64 @@
+"""VGG 11/13/16 with optional norm (parity: fedml_api/model/cv/vgg.py:13-133).
+
+The reference offers plain and BN variants (``vgg11/13/16`` and
+``vgg11_bn/13_bn/16_bn``); here one ``norm`` switch covers all six
+("none" = plain, "batch"/"group" = normalized).  The reference classifier is
+the torchvision triple-Dense head (512*7*7 -> 4096 -> 4096 -> classes,
+vgg.py:20-28) which assumes 224x224 inputs; for small inputs (CIFAR) the
+features already pool to 1x1 and the head degrades gracefully because we
+flatten whatever spatial extent remains.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+
+from fedml_tpu.models.norms import Norm, conv_kernel_init
+
+# torchvision configs (vgg.py:63-69): numbers = conv widths, "M" = maxpool.
+_CFGS = {
+    "A": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "B": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"),
+    "D": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    cfg: Sequence
+    num_classes: int = 1000
+    norm: str = "none"
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding="SAME",
+                            kernel_init=conv_kernel_init)(x)
+                if self.norm != "none":
+                    x = Norm(self.norm)(x, train)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def vgg11(num_classes: int = 1000, norm: str = "none") -> VGG:
+    return VGG(cfg=_CFGS["A"], num_classes=num_classes, norm=norm)
+
+
+def vgg13(num_classes: int = 1000, norm: str = "none") -> VGG:
+    return VGG(cfg=_CFGS["B"], num_classes=num_classes, norm=norm)
+
+
+def vgg16(num_classes: int = 1000, norm: str = "none") -> VGG:
+    return VGG(cfg=_CFGS["D"], num_classes=num_classes, norm=norm)

@@ -45,42 +45,60 @@ def make_local_trainer(workload: Workload,
     (fednova.py:133-136)."""
     clip = (optax.clip_by_global_norm(workload.grad_clip_norm)
             if workload.grad_clip_norm is not None else None)
+    stateful = workload.stateful
 
-    grad_fn = jax.value_and_grad(
-        lambda p, b, r: workload.loss_fn(p, b, r, True), has_aux=True)
+    # Gradients are taken over the TRAINED collection only.  For stateful
+    # workloads the non-trained collections (BatchNorm running stats) ride
+    # the scan carry beside the optimizer state — never differentiated,
+    # never seen by the optimizer — and the updated stats come back through
+    # the loss aux ("state", workload.py).
+    if stateful:
+        def _loss(trained, state, batch, rng):
+            return workload.loss_fn({"params": trained, **state}, batch, rng,
+                                    True)
+    else:
+        def _loss(trained, state, batch, rng):
+            return workload.loss_fn(trained, batch, rng, True)
+    grad_fn = jax.value_and_grad(_loss, has_aux=True)
 
     def train(params: Pytree, data: Dict[str, jax.Array], rng: jax.Array
               ) -> Tuple[Pytree, Dict[str, jax.Array]]:
-        init_params = params
-        opt_state = optimizer.init(params)
-        clip_state = clip.init(params) if clip is not None else None
+        if stateful:
+            trained = params["params"]
+            state = {k: v for k, v in params.items() if k != "params"}
+        else:
+            trained, state = params, {}
+        init_trained = trained
+        opt_state = optimizer.init(trained)
+        clip_state = clip.init(trained) if clip is not None else None
         num_steps = jax.tree.leaves(data)[0].shape[0]
 
         def step(carry, step_idx):
-            params, opt_state, rng = carry
+            trained, state, opt_state, rng = carry
             rng, dropout_rng = jax.random.split(rng)
             batch = jax.tree.map(lambda x: x[step_idx % num_steps], data)
-            (loss, _), grads = grad_fn(params, batch, dropout_rng)
+            (loss, aux), grads = grad_fn(trained, state, batch, dropout_rng)
             if prox_mu:
                 grads = jax.tree.map(lambda g, p, p0: g + prox_mu * (p - p0),
-                                     grads, params, init_params)
+                                     grads, trained, init_trained)
             if clip is not None:
                 grads, _ = clip.update(grads, clip_state)
-            updates, new_opt_state = optimizer.update(grads, opt_state, params)
-            new_params = optax.apply_updates(params, updates)
+            updates, new_opt_state = optimizer.update(grads, opt_state, trained)
+            new_trained = optax.apply_updates(trained, updates)
+            new_state = aux["state"] if stateful else state
             # skip the update entirely for fully-padded batches (grads are 0
             # there anyway for SGD, but Adam's eps would still drift params)
             got_data = jnp.sum(batch["mask"]) > 0
-            new_params = jax.tree.map(
-                lambda n, o: jnp.where(got_data, n, o), new_params, params)
-            new_opt_state = jax.tree.map(
-                lambda n, o: jnp.where(got_data, n, o), new_opt_state, opt_state)
-            return (new_params, new_opt_state, rng), loss
+            keep = lambda n, o: jax.tree.map(
+                lambda a, b: jnp.where(got_data, a, b), n, o)
+            return (keep(new_trained, trained), keep(new_state, state),
+                    keep(new_opt_state, opt_state), rng), loss
 
         total_steps = epochs * num_steps
-        (params, _, _), losses = jax.lax.scan(
-            step, (params, opt_state, rng), jnp.arange(total_steps))
-        return params, {"train_loss_per_step": losses}
+        (trained, state, _, _), losses = jax.lax.scan(
+            step, (trained, state, opt_state, rng), jnp.arange(total_steps))
+        out = {"params": trained, **state} if stateful else trained
+        return out, {"train_loss_per_step": losses}
 
     return train
 

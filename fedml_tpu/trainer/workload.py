@@ -54,7 +54,14 @@ def make_client_optimizer(name: str, lr: float, wd: float = 0.0) -> optax.Gradie
 class Workload:
     """Pure-function training contract.
 
-    loss_fn(params, batch, rng, train) -> (scalar loss, metrics dict).
+    loss_fn(params, batch, rng, train) -> (scalar loss, aux dict).  For
+    stateful models (BatchNorm running stats) aux carries ``"state"``: the
+    updated non-trained collections, which the local trainer splices back
+    into params after the optimizer step (local_sgd.py).  FedAvg then
+    averages running stats along with weights — exactly what the reference's
+    state_dict averaging does (FedAVGAggregator.py:72-80 iterates ALL
+    state_dict keys, stats included).
+
     metric_fn(params, batch) -> dict of *summable* metrics
     (must include "correct", "loss_sum", "total").
     """
@@ -62,16 +69,25 @@ class Workload:
     loss_fn: Callable[[Pytree, Batch, jax.Array, bool], tuple]
     metric_fn: Callable[[Pytree, Batch], Dict[str, jax.Array]]
     grad_clip_norm: Optional[float] = None
+    stateful: bool = False  # params = full variables dict incl. batch_stats
 
     def init(self, rng: jax.Array, sample_batch: Batch) -> Pytree:
-        return self.model.init(rng, sample_batch["x"])["params"]
+        variables = self.model.init(rng, sample_batch["x"])
+        if self.stateful:
+            return dict(variables)
+        return variables["params"]
 
     def apply(self, params: Pytree, x: jax.Array, train: bool = False,
               rng: Optional[jax.Array] = None) -> jax.Array:
         kwargs = {}
         if rng is not None:
             kwargs["rngs"] = {"dropout": rng}
-        return self.model.apply({"params": params}, x, train=train, **kwargs)
+        variables = params if self.stateful else {"params": params}
+        if self.stateful and train:
+            out, _ = self.model.apply(variables, x, train=True,
+                                      mutable=["batch_stats"], **kwargs)
+            return out
+        return self.model.apply(variables, x, train=train, **kwargs)
 
 
 def _masked_mean(values: jax.Array, mask: jax.Array) -> jax.Array:
@@ -80,19 +96,32 @@ def _masked_mean(values: jax.Array, mask: jax.Array) -> jax.Array:
 
 
 def ClassificationWorkload(model, num_classes: int,
-                           grad_clip_norm: Optional[float] = 1.0) -> Workload:
+                           grad_clip_norm: Optional[float] = 1.0,
+                           stateful: bool = False) -> Workload:
     """Softmax cross-entropy on logits, batch-mean over valid rows (the
-    torch ``nn.CrossEntropyLoss()`` default reduction)."""
+    torch ``nn.CrossEntropyLoss()`` default reduction).  ``stateful=True``
+    for BatchNorm models: params is the full variables dict and updated
+    running stats ride the loss aux (see Workload docstring)."""
 
     def loss_fn(params, batch, rng, train):
         kwargs = {"rngs": {"dropout": rng}} if rng is not None else {}
-        logits = model.apply({"params": params}, batch["x"], train=train, **kwargs)
+        if stateful:
+            logits, new_state = model.apply(
+                params, batch["x"], train=train,
+                mutable=["batch_stats"], **kwargs)
+        else:
+            logits = model.apply({"params": params}, batch["x"],
+                                 train=train, **kwargs)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
         loss = _masked_mean(ce, batch["mask"])
-        return loss, {"loss": loss}
+        aux = {"loss": loss}
+        if stateful:
+            aux["state"] = dict(new_state)
+        return loss, aux
 
     def metric_fn(params, batch):
-        logits = model.apply({"params": params}, batch["x"], train=False)
+        variables = params if stateful else {"params": params}
+        logits = model.apply(variables, batch["x"], train=False)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
         pred = jnp.argmax(logits, axis=-1)
         mask = batch["mask"]
@@ -103,7 +132,7 @@ def ClassificationWorkload(model, num_classes: int,
         }
 
     return Workload(model=model, loss_fn=loss_fn, metric_fn=metric_fn,
-                    grad_clip_norm=grad_clip_norm)
+                    grad_clip_norm=grad_clip_norm, stateful=stateful)
 
 
 def NWPWorkload(model, pad_id: int = 0,
