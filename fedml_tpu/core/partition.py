@@ -91,6 +91,16 @@ def partition_dirichlet(label_list, client_num: int, classes, alpha: float,
     return out
 
 
+def partition_dirichlet_hetero(labels, client_num: int, class_num: int,
+                               alpha: float, seed: int | None = None
+                               ) -> Dict[int, np.ndarray]:
+    """The cifar-style ``hetero`` partition (cifar10/data_loader.py:124-148):
+    same per-class Dirichlet + capacity cap as the LDA partitioner, with the
+    min-size-10 retry loop."""
+    return partition_dirichlet(labels, client_num, class_num, alpha,
+                               task="classification", seed=seed)
+
+
 def partition_homo(n_samples: int, client_num: int,
                    seed: int | None = None) -> Dict[int, np.ndarray]:
     """IID split (cifar10/data_loader.py:119-123): shuffle then array_split."""

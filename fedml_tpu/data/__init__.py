@@ -1,3 +1,8 @@
 from fedml_tpu.data.stacking import (
     stack_client_data, gather_cohort, batch_global, FederatedData,
 )
+from fedml_tpu.data.registry import load_data, dataset_names, register_dataset
+from fedml_tpu.data.synthetic import (
+    load_synthetic, synthetic_federated_dataset,
+    generate_synthetic_alpha_beta,
+)

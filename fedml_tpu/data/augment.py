@@ -1,0 +1,153 @@
+"""On-device, jittable data augmentation.
+
+The reference augments on the host CPU through torchvision transforms —
+RandomCrop(32, padding=4), RandomHorizontalFlip, Normalize, Cutout(16)
+(``fedml_api/data_preprocessing/cifar10/data_loader.py:57-99``).  On TPU,
+host-side per-image Python transforms would serialize the input pipeline; the
+TPU-native design applies the same augmentations *inside the jit'd train step*
+as vectorized gather/where ops keyed by a `jax.random` key, so they fuse with
+the forward pass and cost ~zero HBM round-trips.
+
+All functions take `x` of shape [..., H, W, C] (any leading batch dims) and a
+key, and are shape-polymorphic under vmap.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def normalize(x: jnp.ndarray, mean: Sequence[float], std: Sequence[float]
+              ) -> jnp.ndarray:
+    """Channelwise (x - mean) / std (cifar10/data_loader.py:82-88)."""
+    mean = jnp.asarray(mean, x.dtype)
+    std = jnp.asarray(std, x.dtype)
+    return (x - mean) / std
+
+
+def random_flip(key: jax.Array, x: jnp.ndarray) -> jnp.ndarray:
+    """Horizontal flip with p=0.5, independently per image (leading dims)."""
+    batch_shape = x.shape[:-3]
+    flip = jax.random.bernoulli(key, 0.5, batch_shape)
+    return jnp.where(flip[..., None, None, None], jnp.flip(x, axis=-2), x)
+
+
+def _shifted_crop(x: jnp.ndarray, dy: jnp.ndarray, dx: jnp.ndarray,
+                  pad: int) -> jnp.ndarray:
+    """Crop an H×W window at offset (dy, dx) out of the zero-padded image.
+    Implemented as a roll + static slice so shapes stay static under jit."""
+    H, W = x.shape[-3], x.shape[-2]
+    xp = jnp.pad(x, [(0, 0)] * (x.ndim - 3) + [(pad, pad), (pad, pad), (0, 0)])
+    xp = jnp.roll(xp, shift=(-dy, -dx), axis=(-3, -2))
+    return jax.lax.slice_in_dim(
+        jax.lax.slice_in_dim(xp, 0, H, axis=x.ndim - 3), 0, W, axis=x.ndim - 2)
+
+
+def random_crop(key: jax.Array, x: jnp.ndarray, padding: int = 4
+                ) -> jnp.ndarray:
+    """RandomCrop(H, padding) — pad `padding` on each side, crop back to H×W
+    at a uniform offset, per image."""
+    batch_shape = x.shape[:-3]
+    kdy, kdx = jax.random.split(key)
+    dy = jax.random.randint(kdy, batch_shape, 0, 2 * padding + 1)
+    dx = jax.random.randint(kdx, batch_shape, 0, 2 * padding + 1)
+    if batch_shape:
+        flat_x = x.reshape((-1,) + x.shape[-3:])
+        out = jax.vmap(lambda xi, yi, xi2: _shifted_crop(xi, yi, xi2, padding)
+                       )(flat_x, dy.reshape(-1), dx.reshape(-1))
+        return out.reshape(x.shape)
+    return _shifted_crop(x, dy, dx, padding)
+
+
+def cutout(key: jax.Array, x: jnp.ndarray, length: int = 16) -> jnp.ndarray:
+    """Cutout: zero a length×length square at a uniform center, clipped to the
+    image (cifar10/data_loader.py:57-76 — the mask is clipped, so edge squares
+    are smaller, exactly as np.clip does there)."""
+    H, W = x.shape[-3], x.shape[-2]
+    batch_shape = x.shape[:-3]
+    ky, kx = jax.random.split(key)
+    cy = jax.random.randint(ky, batch_shape + (1, 1), 0, H)
+    cx = jax.random.randint(kx, batch_shape + (1, 1), 0, W)
+    rows = jnp.arange(H)[:, None]
+    cols = jnp.arange(W)[None, :]
+    inside = ((rows >= cy - length // 2) & (rows < cy + length // 2)
+              & (cols >= cx - length // 2) & (cols < cx + length // 2))
+    return x * (1.0 - inside[..., None].astype(x.dtype))
+
+
+def cifar_train_augment(key: jax.Array, x: jnp.ndarray,
+                        mean: Sequence[float], std: Sequence[float],
+                        crop_padding: int = 4, cutout_length: int = 16
+                        ) -> jnp.ndarray:
+    """The full CIFAR train transform pipeline (crop → flip → normalize →
+    cutout), one fused on-device pass.  Matches the order in
+    cifar10/data_loader.py:79-92 (Cutout is appended after ToTensor/Normalize).
+    """
+    k1, k2, k3 = jax.random.split(key, 3)
+    x = random_crop(k1, x, crop_padding)
+    x = random_flip(k2, x)
+    x = normalize(x, mean, std)
+    return cutout(k3, x, cutout_length)
+
+
+def center_crop(x: jnp.ndarray, size: int) -> jnp.ndarray:
+    """CenterCrop(size) — the reference's fed_cifar100 *test* transform
+    (fed_cifar100/utils.py:19-24)."""
+    H, W = x.shape[-3], x.shape[-2]
+    top, left = (H - size) // 2, (W - size) // 2
+    out = jax.lax.slice_in_dim(x, top, top + size, axis=x.ndim - 3)
+    return jax.lax.slice_in_dim(out, left, left + size, axis=x.ndim - 2)
+
+
+def random_crop_to(key: jax.Array, x: jnp.ndarray, size: int) -> jnp.ndarray:
+    """RandomCrop(size) with size < H — cuts a size×size window at a uniform
+    offset (the fed_cifar100 24×24 train crop, fed_cifar100/utils.py:11-17).
+    Output is smaller than the input, unlike `random_crop` which pads first."""
+    H, W = x.shape[-3], x.shape[-2]
+    batch_shape = x.shape[:-3]
+    kdy, kdx = jax.random.split(key)
+    dy = jax.random.randint(kdy, batch_shape, 0, H - size + 1)
+    dx = jax.random.randint(kdx, batch_shape, 0, W - size + 1)
+
+    def crop_one(xi, yi, xi2):
+        rolled = jnp.roll(xi, shift=(-yi, -xi2), axis=(-3, -2))
+        out = jax.lax.slice_in_dim(rolled, 0, size, axis=rolled.ndim - 3)
+        return jax.lax.slice_in_dim(out, 0, size, axis=rolled.ndim - 2)
+
+    if batch_shape:
+        flat = x.reshape((-1,) + x.shape[-3:])
+        out = jax.vmap(crop_one)(flat, dy.reshape(-1), dx.reshape(-1))
+        return out.reshape(batch_shape + out.shape[1:])
+    return crop_one(x, dy, dx)
+
+
+def fed_cifar100_train_augment(key: jax.Array, x: jnp.ndarray,
+                               mean: Sequence[float], std: Sequence[float],
+                               crop_size: int = 24) -> jnp.ndarray:
+    """fed_cifar100 train pipeline: RandomCrop(24) → flip → normalize
+    (fed_cifar100/utils.py:11-17)."""
+    k1, k2 = jax.random.split(key)
+    x = random_crop_to(k1, x, crop_size)
+    x = random_flip(k2, x)
+    return normalize(x, mean, std)
+
+
+def fed_cifar100_eval_transform(x: jnp.ndarray, mean: Sequence[float],
+                                std: Sequence[float], crop_size: int = 24
+                                ) -> jnp.ndarray:
+    """fed_cifar100 test pipeline: CenterCrop(24) → normalize."""
+    return normalize(center_crop(x, crop_size), mean, std)
+
+
+# Channel stats from the reference (cifar10/data_loader.py:80-81 etc.)
+CIFAR10_MEAN = (0.49139968, 0.48215827, 0.44653124)
+CIFAR10_STD = (0.24703233, 0.24348505, 0.26158768)
+CIFAR100_MEAN = (0.5071, 0.4865, 0.4409)
+CIFAR100_STD = (0.2673, 0.2564, 0.2762)
+CINIC10_MEAN = (0.47889522, 0.47227842, 0.43047404)
+CINIC10_STD = (0.24205776, 0.23828046, 0.25874835)
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)

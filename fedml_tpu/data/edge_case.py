@@ -1,0 +1,84 @@
+"""Edge-case / backdoor example sets for robust-FL evaluation.
+
+The reference ships loaders for externally-downloaded poison sets — Southwest
+airliner images relabeled "truck" for CIFAR10, ARDIS digits relabeled "7" for
+(E)MNIST, plus pixel-pattern triggers — and mixes a poisoned client into the
+cohort while tracking "targetted task" accuracy
+(``edge_case_examples/data_loader.py:223-330``,
+``fedavg_robust/FedAvgRobustAggregator.py:117-136, 270``).
+
+Poison construction is data math, not IO, so the core here is generic:
+``apply_pixel_trigger`` stamps a corner pattern and relabels (the classic
+badnets trigger), ``make_poisoned_dataset`` blends a poison set into one
+client's shard, and ``load_external_poison`` reads the reference's pickled
+edge-case sets when present.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+
+def apply_pixel_trigger(x: np.ndarray, target_label: int,
+                        trigger_size: int = 3, value: float = 1.0
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Stamp a trigger_size² bright square in the bottom-right corner of each
+    [N, H, W, C] image and relabel everything to ``target_label``."""
+    x = x.copy()
+    x[..., -trigger_size:, -trigger_size:, :] = value
+    y = np.full(len(x), target_label, dtype=np.int32)
+    return x, y
+
+
+def make_poisoned_dataset(x_clean: np.ndarray, y_clean: np.ndarray,
+                          x_poison: np.ndarray, y_poison: np.ndarray,
+                          poison_frac: float = 0.5, seed: int = 0
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+    """Blend poison into a clean shard (attacker's local dataset): keep all
+    clean samples, append round(poison_frac * n_clean) poison samples,
+    shuffle (the reference's attacker datasets are similar fixed blends)."""
+    rng = np.random.RandomState(seed)
+    n_poison = min(len(y_poison), int(round(poison_frac * len(y_clean))))
+    sel = rng.choice(len(y_poison), n_poison, replace=False)
+    x = np.concatenate([x_clean, x_poison[sel]])
+    y = np.concatenate([y_clean, y_poison[sel]])
+    perm = rng.permutation(len(y))
+    return x[perm], y[perm]
+
+
+def load_external_poison(path: str, target_label: int
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Read a pickled image array (e.g. southwest_images_new_train.pkl) and
+    relabel to the attack target — target 9 ("truck") for southwest, 7 for
+    ARDIS (edge_case_examples/data_loader.py:283-330)."""
+    with open(path, "rb") as f:
+        imgs = pickle.load(f)
+    x = np.asarray(imgs, dtype=np.float32)
+    if x.max() > 1.5:
+        x = x / 255.0
+    y = np.full(len(x), target_label, dtype=np.int32)
+    return x, y
+
+
+def targeted_task_eval_set(dataset: str, data_dir: Optional[str] = None,
+                           image_shape: Tuple[int, ...] = (32, 32, 3),
+                           target_label: int = 9, n: int = 64,
+                           seed: int = 0) -> Dict[str, np.ndarray]:
+    """The "targetted task" test set: external poison images when the
+    reference's pickles are on disk, otherwise trigger-stamped noise images
+    (hermetic).  Accuracy on this set measures backdoor persistence."""
+    if data_dir:
+        for fname in ("southwest_images_new_test.pkl",
+                      "ardis_test_dataset.pt"):
+            p = os.path.join(data_dir, fname)
+            if os.path.exists(p) and fname.endswith(".pkl"):
+                x, y = load_external_poison(p, target_label)
+                return {"x": x, "y": y}
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, *image_shape).astype(np.float32)
+    x, y = apply_pixel_trigger(x, target_label)
+    return {"x": x, "y": y}

@@ -1,0 +1,126 @@
+"""Synthetic federated datasets (in-memory, no downloads).
+
+Two generators:
+
+* ``generate_synthetic_alpha_beta`` — the LEAF synthetic_(α,β) logistic task
+  (``data/synthetic_0.5_0.5/generate_synthetic.py:16-70``): per-user weight
+  matrices W_i ~ N(u_i, 1) with u_i ~ N(0, α), per-user feature means
+  v_i ~ N(B_i, 1) with B_i ~ N(0, β), features x ~ N(v_i, Σ) with
+  Σ_jj = j^-1.2, labels y = argmax softmax(xW + b).  α controls model
+  heterogeneity, β feature heterogeneity; iid=True shares one global (W, b).
+* ``synthetic_federated_dataset`` — a generic stand-in that mimics any real
+  loader's shapes (image / sequence / tabular) so every pipeline in the
+  framework is testable hermetically (the reference's CI downloads real data,
+  CI-install.sh:40-86 — we do not have that luxury on an air-gapped TPU host).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .stacking import FederatedData, stack_client_data, batch_global
+
+
+def generate_synthetic_alpha_beta(
+        alpha: float = 0.5, beta: float = 0.5, iid: bool = False,
+        num_users: int = 30, dimension: int = 60, num_classes: int = 10,
+        seed: int = 0, min_samples: int = 50
+        ) -> Tuple[list, list]:
+    """Per-user (X, y) lists; sample counts ~ lognormal(4, 2) + min_samples
+    (generate_synthetic.py:19-21)."""
+    rng = np.random.RandomState(seed)
+    samples_per_user = rng.lognormal(4, 2, num_users).astype(int) + min_samples
+
+    mean_W = rng.normal(0, alpha, num_users)
+    B = rng.normal(0, beta, num_users)
+    cov_x = np.diag(np.power(np.arange(1, dimension + 1), -1.2))
+
+    mean_x = np.zeros((num_users, dimension))
+    for i in range(num_users):
+        mean_x[i] = B[i] if iid else rng.normal(B[i], 1, dimension)
+
+    if iid:
+        W_g = rng.normal(0, 1, (dimension, num_classes))
+        b_g = rng.normal(0, 1, num_classes)
+
+    X_split, y_split = [], []
+    for i in range(num_users):
+        W = W_g if iid else rng.normal(mean_W[i], 1, (dimension, num_classes))
+        b = b_g if iid else rng.normal(mean_W[i], 1, num_classes)
+        xx = rng.multivariate_normal(mean_x[i], cov_x, samples_per_user[i])
+        yy = np.argmax(xx @ W + b, axis=1)
+        X_split.append(xx.astype(np.float32))
+        y_split.append(yy.astype(np.int32))
+    return X_split, y_split
+
+
+def load_synthetic(alpha: float = 0.5, beta: float = 0.5, iid: bool = False,
+                   num_users: int = 30, batch_size: int = 10,
+                   train_frac: float = 0.9, seed: int = 0) -> FederatedData:
+    """synthetic_(α,β) as FederatedData with a 90/10 train/test split per user
+    (generate_synthetic.py main: num_samples * 0.9)."""
+    X, y = generate_synthetic_alpha_beta(alpha, beta, iid, num_users, seed=seed)
+    xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+    for xi, yi in zip(X, y):
+        n_tr = int(len(yi) * train_frac)
+        xs_tr.append(xi[:n_tr])
+        ys_tr.append(yi[:n_tr])
+        xs_te.append(xi[n_tr:])
+        ys_te.append(yi[n_tr:])
+    train = stack_client_data(xs_tr, ys_tr, batch_size)
+    test = stack_client_data(xs_te, ys_te, batch_size)
+    return FederatedData(
+        client_num=num_users, class_num=10, train=train, test=test,
+        train_global=batch_global(np.concatenate(xs_tr),
+                                  np.concatenate(ys_tr), batch_size),
+        test_global=batch_global(np.concatenate(xs_te),
+                                 np.concatenate(ys_te), batch_size))
+
+
+def synthetic_federated_dataset(
+        num_clients: int = 8, samples_per_client: int = 32,
+        sample_shape: Sequence[int] = (28, 28, 1), class_num: int = 10,
+        batch_size: int = 8, seed: int = 0,
+        x_dtype=np.float32, sequence_vocab: Optional[int] = None,
+        multilabel: bool = False, heterogeneous_sizes: bool = True
+        ) -> FederatedData:
+    """Shape-compatible stand-in for any real loader.
+
+    * image/tabular: x ~ N(0,1) in ``sample_shape``, y uniform in class_num
+    * ``sequence_vocab`` set: x int32 ids in [0, vocab), y = shifted ids
+      (language-model layout, like fed_shakespeare)
+    * ``multilabel``: y is a float multi-hot of width class_num (like
+      stackoverflow_lr)
+    """
+    rng = np.random.RandomState(seed)
+    xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+    for c in range(num_clients):
+        n = samples_per_client
+        if heterogeneous_sizes:
+            n = max(2, int(samples_per_client * rng.uniform(0.4, 1.6)))
+        n_te = max(1, n // 5)
+        for xs, ys, m in ((xs_tr, ys_tr, n), (xs_te, ys_te, n_te)):
+            if sequence_vocab is not None:
+                seq = rng.randint(0, sequence_vocab,
+                                  (m,) + tuple(sample_shape)).astype(np.int32)
+                xs.append(seq)
+                ys.append(np.concatenate(
+                    [seq[:, 1:], seq[:, :1]], axis=1).astype(np.int32))
+            else:
+                xs.append(rng.randn(*((m,) + tuple(sample_shape)))
+                          .astype(x_dtype))
+                if multilabel:
+                    ys.append((rng.rand(m, class_num) < 0.05)
+                              .astype(np.float32))
+                else:
+                    ys.append(rng.randint(0, class_num, m).astype(np.int32))
+    train = stack_client_data(xs_tr, ys_tr, batch_size)
+    test = stack_client_data(xs_te, ys_te, batch_size)
+    return FederatedData(
+        client_num=num_clients, class_num=class_num, train=train, test=test,
+        train_global=batch_global(np.concatenate(xs_tr),
+                                  np.concatenate(ys_tr), batch_size),
+        test_global=batch_global(np.concatenate(xs_te),
+                                 np.concatenate(ys_te), batch_size))

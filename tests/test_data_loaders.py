@@ -1,0 +1,288 @@
+"""Data-layer tests: format readers (against hermetic fixtures written in
+the real on-disk formats), text encodings, partition plumbing, on-device
+augmentation, and the dataset registry."""
+
+import json
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fedml_tpu.data import load_data, dataset_names, FederatedData
+from fedml_tpu.data import leaf, text, tff_h5, uci, tabular, edge_case
+from fedml_tpu.data.augment import (
+    cifar_train_augment, random_crop, random_flip, cutout, normalize,
+    CIFAR10_MEAN, CIFAR10_STD)
+from fedml_tpu.data.cifar import load_cifar_partitioned
+from fedml_tpu.data.synthetic import (load_synthetic,
+                                      synthetic_federated_dataset)
+
+
+# --- LEAF json -------------------------------------------------------------
+
+def _write_leaf_mnist(root, num_users=5, n=12, seed=0):
+    rng = np.random.RandomState(seed)
+    for split, m in (("train", n), ("test", max(2, n // 4))):
+        d = os.path.join(root, split)
+        os.makedirs(d, exist_ok=True)
+        users = [f"f_{i:05d}" for i in range(num_users)]
+        user_data = {u: {"x": rng.rand(m, 784).tolist(),
+                         "y": rng.randint(0, 10, m).tolist()}
+                     for u in users}
+        with open(os.path.join(d, "all_data.json"), "w") as f:
+            json.dump({"users": users, "num_samples": [m] * num_users,
+                       "user_data": user_data}, f)
+
+
+def test_leaf_mnist_loader(tmp_path):
+    _write_leaf_mnist(str(tmp_path))
+    fd = leaf.load_mnist(str(tmp_path), batch_size=4)
+    assert fd.client_num == 5 and fd.class_num == 10
+    assert fd.train["x"].shape[0] == 5
+    assert fd.train["x"].shape[2] == 4          # batch dim
+    assert fd.train_data_num == 5 * 12
+    # masks match per-client counts
+    np.testing.assert_allclose(fd.train["mask"].sum((1, 2)),
+                               fd.train["num_samples"])
+
+
+# --- text encodings --------------------------------------------------------
+
+def test_char_vocab_roundtrip_and_windows():
+    v = text.CharVocab()
+    assert v.vocab_size == 90                    # matches reference VOCAB 90
+    wins = v.encode_snippet("to be or not to be", seq_len=8)
+    assert all(w.shape == (9,) for w in wins)
+    assert wins[0][0] == v.bos
+    flat = np.concatenate(wins)
+    assert v.eos in flat
+    d = text.split_next_word(np.stack(wins))
+    np.testing.assert_array_equal(d["x"][0][1:], d["y"][0][:-1])
+
+
+def test_word_vocab_sentence_framing(tmp_path):
+    p = tmp_path / "wc"
+    p.write_text("".join(f"w{i} {100-i}\n" for i in range(20)))
+    v = text.WordVocab.from_word_count_file(str(p), vocab_size=10)
+    ids = v.encode_sentence("w0 w1 w999", seq_len=5)
+    assert ids.shape == (6,)
+    assert ids[0] == v.bos and ids[1] == 1       # w0 is first vocab word
+    assert ids[3] >= v.vocab_size - v.num_oov_buckets  # w999 hashed to oov
+    assert ids[4] == v.eos                        # shorter than seq_len
+    assert ids[5] == v.pad
+
+
+def test_bag_of_words_and_tags():
+    vocab = {"a": 0, "b": 1}
+    x = text.bag_of_words(["a a b", "c c"], vocab)
+    np.testing.assert_allclose(x[0], [2 / 3, 1 / 3])
+    np.testing.assert_allclose(x[1], [0, 0])
+    y = text.multi_hot_tags(["t0|t1", "t1"], {"t0": 0, "t1": 1})
+    np.testing.assert_array_equal(y, [[1, 1], [0, 1]])
+
+
+# --- TFF h5 ----------------------------------------------------------------
+
+def test_femnist_h5(tmp_path):
+    tff_h5.fake_femnist_h5(str(tmp_path), num_clients=3, samples=8)
+    fd = tff_h5.load_federated_emnist(str(tmp_path), batch_size=4)
+    assert fd.client_num == 3 and fd.class_num == 62
+    assert fd.train["x"].shape[-3:] == (28, 28, 1)
+    assert fd.train_data_num == 24
+
+
+def test_fed_cifar100_h5(tmp_path):
+    tff_h5.fake_fed_cifar100_h5(str(tmp_path), num_clients=2, samples=6)
+    fd = tff_h5.load_fed_cifar100(str(tmp_path), batch_size=3)
+    assert fd.class_num == 100
+    assert fd.train["x"].shape[-3:] == (32, 32, 3)
+    assert 0.0 <= fd.train["x"].min() and fd.train["x"].max() <= 1.0
+
+
+def test_fed_shakespeare_h5(tmp_path):
+    tff_h5.fake_fed_shakespeare_h5(str(tmp_path))
+    fd = tff_h5.load_fed_shakespeare(str(tmp_path), batch_size=2)
+    assert fd.class_num == 90
+    assert fd.train["x"].shape[-1] == 80
+    # y is x shifted by one within every window
+    x, y = fd.train["x"], fd.train["y"]
+    m = fd.train["mask"][..., None]
+    np.testing.assert_array_equal((x[..., 1:] * m), (y[..., :-1] * m))
+
+
+def test_stackoverflow_h5(tmp_path):
+    tff_h5.fake_stackoverflow_h5(str(tmp_path))
+    nwp = tff_h5.load_stackoverflow_nwp(str(tmp_path), batch_size=2,
+                                        vocab_size=50)
+    assert nwp.train["x"].shape[-1] == 20
+    assert nwp.class_num == 50 + 4
+    lr = tff_h5.load_stackoverflow_lr(str(tmp_path), batch_size=2,
+                                      vocab_size=50, tag_size=8)
+    assert lr.train["x"].shape[-1] == 50
+    assert lr.train["y"].shape[-1] == 8
+    assert set(np.unique(lr.train["y"])) <= {0.0, 1.0}
+
+
+# --- cifar partition path --------------------------------------------------
+
+def _fake_cifar_arrays(n_tr=200, n_te=40, classes=10, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.rand(n_tr, 32, 32, 3).astype(np.float32),
+            rng.randint(0, classes, n_tr),
+            rng.rand(n_te, 32, 32, 3).astype(np.float32),
+            rng.randint(0, classes, n_te))
+
+
+@pytest.mark.parametrize("method", ["homo", "hetero"])
+def test_cifar_partitioned(method):
+    fd = load_cifar_partitioned("cifar10", data_dir="", client_num=4,
+                                partition_method=method, partition_alpha=0.5,
+                                batch_size=16, seed=3,
+                                arrays=_fake_cifar_arrays())
+    assert fd.client_num == 4
+    assert fd.train_data_num == 200
+    if method == "hetero":
+        counts = fd.train["num_samples"]
+        assert counts.min() >= 10                # min-size retry floor
+
+
+# --- on-device augmentation ------------------------------------------------
+
+def test_augment_shapes_and_determinism():
+    key = jax.random.key(0)
+    x = jnp.asarray(np.random.RandomState(0).rand(4, 32, 32, 3), jnp.float32)
+    out = jax.jit(lambda k, v: cifar_train_augment(
+        k, v, CIFAR10_MEAN, CIFAR10_STD))(key, x)
+    assert out.shape == x.shape
+    out2 = jax.jit(lambda k, v: cifar_train_augment(
+        k, v, CIFAR10_MEAN, CIFAR10_STD))(key, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2))
+
+
+def test_random_crop_preserves_content_distribution():
+    key = jax.random.key(1)
+    x = jnp.ones((2, 16, 16, 3))
+    out = random_crop(key, x, padding=2)
+    assert out.shape == x.shape
+    # every output pixel is 0 (from padding) or 1
+    vals = set(np.unique(np.asarray(out)))
+    assert vals <= {0.0, 1.0}
+
+
+def test_cutout_zeroes_a_window():
+    key = jax.random.key(2)
+    x = jnp.ones((8, 8, 1))
+    out = cutout(key, x, length=4)
+    z = float((np.asarray(out) == 0).sum())
+    assert 0 < z <= 16                            # clipped square
+
+
+def test_flip_flips_exactly_width_axis():
+    x = jnp.asarray(np.arange(2 * 4 * 4 * 1, dtype=np.float32)
+                    .reshape(2, 4, 4, 1))
+    for s in range(20):
+        out = np.asarray(random_flip(jax.random.key(s), x))
+        for i in range(2):
+            ok_same = np.array_equal(out[i], np.asarray(x[i]))
+            ok_flip = np.array_equal(out[i], np.asarray(x[i])[:, ::-1])
+            assert ok_same or ok_flip
+
+
+# --- streaming UCI ---------------------------------------------------------
+
+def test_streaming_split_and_arrays():
+    stream = uci.synthetic_stream(num_clients=4, total=100, beta=0.3)
+    assert set(stream) == {0, 1, 2, 3}
+    assert sum(len(v) for v in stream.values()) == 100
+    x, y, m = uci.streaming_to_arrays(stream)
+    assert x.shape[0] == 4 and m.sum() == 100
+
+
+# --- VFL tabular -----------------------------------------------------------
+
+def test_synthetic_vfl_contract():
+    train, test = tabular.synthetic_vfl_parties(
+        n_samples=100, feature_dims=(6, 10))
+    Xa, Xb, y = train
+    assert Xa.shape == (80, 6) and Xb.shape == (80, 10)
+    assert y.shape == (80, 1)
+    assert len(test[0]) == 20
+
+
+# --- edge-case poison ------------------------------------------------------
+
+def test_pixel_trigger_and_blend():
+    rng = np.random.RandomState(0)
+    xc = rng.rand(20, 8, 8, 3).astype(np.float32)
+    yc = rng.randint(0, 10, 20).astype(np.int32)
+    xp, yp = edge_case.apply_pixel_trigger(xc[:10], target_label=9)
+    assert (xp[:, -3:, -3:, :] == 1.0).all()
+    assert (yp == 9).all()
+    x, y = edge_case.make_poisoned_dataset(xc, yc, xp, yp, poison_frac=0.5)
+    assert len(y) == 30
+    ts = edge_case.targeted_task_eval_set("cifar10", n=16)
+    assert ts["x"].shape[0] == 16 and (ts["y"] == 9).all()
+
+
+# --- registry --------------------------------------------------------------
+
+def test_registry_synthetic_fallbacks():
+    names = dataset_names()
+    for required in ("mnist", "femnist", "fed_cifar100", "cifar10",
+                     "stackoverflow_nwp", "stackoverflow_lr",
+                     "fed_shakespeare", "shakespeare", "synthetic",
+                     "gld23k", "ilsvrc2012"):
+        assert required in names
+    fd = load_data("femnist", num_clients=3, samples_per_client=10)
+    assert isinstance(fd, FederatedData)
+    assert fd.train["x"].shape[-3:] == (28, 28, 1)
+    fd = load_data("synthetic", num_users=5)
+    assert fd.client_num == 5
+    with pytest.raises(FileNotFoundError):
+        load_data("mnist", data_dir="/nonexistent", synthetic_ok=False)
+
+
+def test_registry_real_loader_dispatch(tmp_path):
+    tff_h5.fake_femnist_h5(str(tmp_path), num_clients=2, samples=6)
+    fd = load_data("femnist", data_dir=str(tmp_path), batch_size=3)
+    assert fd.client_num == 2 and fd.class_num == 62
+
+
+def test_fed_cifar100_augment_pipeline():
+    key = jax.random.key(5)
+    x = jnp.asarray(np.random.RandomState(1).rand(3, 32, 32, 3), jnp.float32)
+    from fedml_tpu.data.augment import (fed_cifar100_train_augment,
+                                        fed_cifar100_eval_transform,
+                                        CIFAR100_MEAN, CIFAR100_STD)
+    tr = jax.jit(lambda k, v: fed_cifar100_train_augment(
+        k, v, CIFAR100_MEAN, CIFAR100_STD))(key, x)
+    assert tr.shape == (3, 24, 24, 3)
+    ev = fed_cifar100_eval_transform(x, CIFAR100_MEAN, CIFAR100_STD)
+    assert ev.shape == (3, 24, 24, 3)
+    # center crop really is the center window
+    ref = normalize(x[:, 4:28, 4:28, :], CIFAR100_MEAN, CIFAR100_STD)
+    np.testing.assert_allclose(np.asarray(ev), np.asarray(ref), atol=1e-6)
+
+
+def test_registry_twin_ignores_loader_only_kwargs():
+    fd = load_data("femnist", max_clients=100, num_clients=3)
+    assert fd.client_num == 3
+    with pytest.raises(FileNotFoundError):
+        load_data("cifar10", data_dir="/typo/path")  # explicit dir must raise
+
+
+def test_kmeans_small_adversarial_prefix():
+    stream = uci.synthetic_stream(num_clients=16, total=100, beta=0.05)
+    assert sum(len(v) for v in stream.values()) == 100
+
+
+def test_word_vocab_oov_stable_hash(tmp_path):
+    p = tmp_path / "wc"
+    p.write_text("a 5\nb 4\n")
+    v = text.WordVocab.from_word_count_file(str(p), vocab_size=2,
+                                            num_oov_buckets=4)
+    import zlib
+    expect = zlib.crc32(b"zzz") % 4 + 2 + 3
+    assert v.word_id("zzz") == expect
