@@ -10,3 +10,11 @@ from fedml_tpu.algorithms.decentralized import (
 from fedml_tpu.algorithms.hierarchical import (
     HierarchicalFedAvg, HierarchicalConfig,
 )
+from fedml_tpu.algorithms.split_nn import (
+    SplitModel, SplitNNConfig, SplitNNSimulator,
+    SplitNNClientActor, SplitNNServerActor,
+)
+from fedml_tpu.algorithms.fedgkt import FedGKT, FedGKTConfig, kd_kl_loss
+from fedml_tpu.algorithms.vertical_fl import (
+    VerticalFL, VFLConfig, VFLGuest, VFLHost, run_vfl_protocol,
+)

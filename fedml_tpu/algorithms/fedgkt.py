@@ -1,0 +1,212 @@
+"""FedGKT — group knowledge transfer (client CNN ⇄ server ResNet).
+
+Reference choreography (``fedml_api/distributed/fedgkt/``):
+
+1. each client trains its small CNN for ``epochs_client`` epochs with
+   CE + α·KL(client ∥ server-logits) when server logits exist
+   (GKTClientTrainer.py:67-78);
+2. the client then runs feature extraction over its WHOLE dataset and ships
+   (feature maps, client logits, labels) to the server
+   (GKTClientTrainer.py:83-120);
+3. the server trains its large net on the received features for
+   ``epochs_server`` epochs with CE + α·KL(server ∥ client-logits)
+   (GKTServerTrainer.train_and_eval via :101-130), then returns per-client
+   server logits for the next round's distillation.
+
+KL term parity (fedgkt/utils.py KL_Loss:75-89):
+``T² · KL(softmax(teacher/T) ∥ log_softmax(student/T))`` with the teacher
+softmax floored at 1e-7.
+
+TPU-native design: client training is ONE vmap'd jit over the stacked client
+cohort (every client's small CNN trains in parallel on the MXU, instead of
+N sequential processes); feature extraction is a second vmap'd jit; the
+server phase is a standard scanned SGD over the pooled feature dataset.
+No per-batch wire: features move host<->device once per round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class FedGKTConfig:
+    rounds: int = 10
+    epochs_client: int = 1
+    epochs_server: int = 1
+    lr_client: float = 0.01
+    lr_server: float = 0.01
+    temperature: float = 3.0     # --temperature default (main_fedgkt)
+    alpha: float = 1.0           # KD weight (GKTClientTrainer.py:78)
+    seed: int = 0
+
+
+def kd_kl_loss(student_logits: jnp.ndarray, teacher_logits: jnp.ndarray,
+               temperature: float) -> jnp.ndarray:
+    """T²-scaled distillation KL, teacher floored at 1e-7 per batch-mean
+    (fedgkt/utils.py:75-89)."""
+    T = temperature
+    log_p = jax.nn.log_softmax(student_logits / T, axis=-1)
+    q = jax.nn.softmax(teacher_logits / T, axis=-1) + 1e-7
+    return T * T * jnp.sum(q * (jnp.log(q) - log_p), axis=-1)
+
+
+class FedGKT:
+    """client_model: flax module -> (logits, feature maps);
+    server_model: flax module feature maps -> logits."""
+
+    def __init__(self, client_model, server_model, cfg: FedGKTConfig):
+        self.client_model = client_model
+        self.server_model = server_model
+        self.cfg = cfg
+        self.client_opt = optax.sgd(cfg.lr_client, momentum=0.9)
+        self.server_opt = optax.sgd(cfg.lr_server, momentum=0.9)
+        self._build()
+
+    def _build(self):
+        cfg = self.cfg
+
+        def client_loss(cp, batch, server_logits, use_kd):
+            logits, _ = self.client_model.apply({"params": cp}, batch["x"])
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"])
+            kd = kd_kl_loss(logits, server_logits, cfg.temperature)
+            per_row = ce + cfg.alpha * use_kd * kd
+            m = batch["mask"]
+            return jnp.sum(per_row * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+        def client_epoch(cp, opt_state, data, server_logits, use_kd):
+            """scan over one client's batches; server_logits [S, B, C]."""
+            def step(carry, xs):
+                cp, opt_state = carry
+                batch, s_logits = xs
+                loss, g = jax.value_and_grad(client_loss)(
+                    cp, batch, s_logits, use_kd)
+                updates, opt_state = self.client_opt.update(g, opt_state, cp)
+                return (optax.apply_updates(cp, updates), opt_state), loss
+
+            (cp, opt_state), losses = jax.lax.scan(
+                step, (cp, opt_state), (data, server_logits))
+            return cp, opt_state, jnp.mean(losses)
+
+        def client_round(cp, opt_state, data, server_logits, use_kd):
+            for _ in range(cfg.epochs_client):
+                cp, opt_state, loss = client_epoch(
+                    cp, opt_state, data, server_logits, use_kd)
+            # phase 2: extract features + logits over the whole local set
+            logits, feats = self.client_model.apply(
+                {"params": cp},
+                data["x"].reshape((-1,) + data["x"].shape[2:]))
+            return cp, opt_state, loss, feats, logits
+
+        # vmap across the stacked client axis: every client trains at once
+        self._clients_round = jax.jit(jax.vmap(
+            client_round, in_axes=(0, 0, 0, 0, None)))
+
+        def server_loss(sp, feats, labels, client_logits, mask):
+            logits = self.server_model.apply({"params": sp}, feats)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+            kd = kd_kl_loss(logits, client_logits, cfg.temperature)
+            per_row = ce + cfg.alpha * kd
+            return jnp.sum(per_row * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        def server_epoch(sp, opt_state, feats, labels, client_logits, mask):
+            def step(carry, xs):
+                sp, opt_state = carry
+                f, y, cl, m = xs
+                loss, g = jax.value_and_grad(server_loss)(sp, f, y, cl, m)
+                updates, opt_state = self.server_opt.update(g, opt_state, sp)
+                return (optax.apply_updates(sp, updates), opt_state), loss
+
+            (sp, opt_state), losses = jax.lax.scan(
+                step, (sp, opt_state), (feats, labels, client_logits, mask))
+            return sp, opt_state, jnp.mean(losses)
+
+        self._server_epoch = jax.jit(server_epoch)
+
+        def server_infer(sp, feats):
+            return self.server_model.apply({"params": sp}, feats)
+
+        self._server_infer = jax.jit(server_infer)
+
+    def init(self, rng: jax.Array, cohort: Dict[str, jnp.ndarray]
+             ) -> Tuple[Pytree, Pytree, Pytree, Pytree]:
+        """cohort: stacked {"x": [C, S, B, ...], "y", "mask"}.  Per-client
+        client params (each client keeps its own small net, GKT never
+        averages them) + one server net."""
+        C = cohort["x"].shape[0]
+        rngs = jax.random.split(rng, C + 1)
+        sample_x = cohort["x"][0, 0]
+        cp0 = self.client_model.init(rngs[0], sample_x)["params"]
+        client_params = jax.vmap(
+            lambda r: self.client_model.init(r, sample_x)["params"]
+        )(rngs[:C])
+        _, feats = self.client_model.apply({"params": cp0}, sample_x)
+        server_params = self.server_model.init(rngs[C], feats)["params"]
+        return (client_params,
+                jax.vmap(self.client_opt.init)(client_params),
+                server_params, self.server_opt.init(server_params))
+
+    def run(self, cohort: Dict[str, jnp.ndarray],
+            rng: Optional[jax.Array] = None) -> Dict[str, Any]:
+        cfg = self.cfg
+        rng = rng if rng is not None else jax.random.key(cfg.seed)
+        client_params, client_opt, server_params, server_opt = self.init(
+            rng, cohort)
+        C, S, B = cohort["x"].shape[:3]
+        num_classes = self.client_model.num_classes
+        server_logits = jnp.zeros((C, S, B, num_classes))
+        history: List[Dict[str, float]] = []
+
+        for rnd in range(cfg.rounds):
+            use_kd = jnp.asarray(0.0 if rnd == 0 else 1.0)
+            client_params, client_opt, c_loss, feats, c_logits = \
+                self._clients_round(client_params, client_opt,
+                                    {k: cohort[k] for k in ("x", "y", "mask")},
+                                    server_logits, use_kd)
+            # pool all clients' extracted features into one server dataset
+            fs = feats.reshape((C * S, B) + feats.shape[2:])
+            ys = cohort["y"].reshape(C * S, B)
+            cls = c_logits.reshape(C * S, B, num_classes)
+            ms = cohort["mask"].reshape(C * S, B)
+            for _ in range(cfg.epochs_server):
+                server_params, server_opt, s_loss = self._server_epoch(
+                    server_params, server_opt, fs, ys, cls, ms)
+            # distill back: per-client server logits for next round
+            s_logits = self._server_infer(
+                server_params, fs.reshape((-1,) + fs.shape[2:]))
+            server_logits = s_logits.reshape(C, S, B, num_classes)
+            history.append({"round": rnd,
+                            "client_loss": float(jnp.mean(c_loss)),
+                            "server_loss": float(s_loss)})
+        return {"client_params": client_params,
+                "server_params": server_params, "history": history}
+
+    def evaluate(self, client_params, server_params,
+                 cohort: Dict[str, jnp.ndarray]) -> Dict[str, float]:
+        """End-to-end accuracy: client features -> server logits (the
+        deployed GKT pipeline; GKTServerTrainer eval path)."""
+        @jax.jit
+        def fwd(cp, sp, x):
+            _, feats = self.client_model.apply({"params": cp}, x)
+            return self.server_model.apply({"params": sp}, feats)
+
+        correct, total = 0.0, 0.0
+        C, S = cohort["x"].shape[:2]
+        for c in range(C):
+            cp = jax.tree.map(lambda v: v[c], client_params)
+            for s in range(S):
+                logits = fwd(cp, server_params, cohort["x"][c, s])
+                pred = jnp.argmax(logits, -1)
+                m = cohort["mask"][c, s]
+                correct += float(jnp.sum((pred == cohort["y"][c, s]) * m))
+                total += float(jnp.sum(m))
+        return {"acc": correct / max(total, 1.0)}

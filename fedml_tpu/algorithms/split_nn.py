@@ -1,0 +1,271 @@
+"""SplitNN — split learning across a client body and a server head.
+
+Reference choreography (``fedml_api/distributed/split_nn/``): the model is
+cut into a client lower half and a server upper half; every batch, the active
+client sends activations + labels up (client.py:24-29), the server runs the
+head, computes CE loss, backprops to the activation boundary and returns the
+activation gradient (server.py:40-60); clients take turns being active,
+advancing round-robin each epoch (server.py:70-71).  The process boundary is
+crossed EVERY batch — the latency-critical path (SURVEY.md §3.3).
+
+TPU-native inversion: on-chip, the "activation exchange" is just function
+composition — ``head(body(x))`` differentiates end-to-end inside ONE jit
+program, and XLA places the boundary; there is no wire, so the per-batch
+round-trip cost collapses to zero.  The split is kept *architecturally* (two
+parameter trees, two optimizers, the server never sees ``x`` and the client
+never sees the loss internals) so the privacy/topology semantics match.  For
+a true cross-silo wire, `SplitNNClientActor`/`SplitNNServerActor` run the
+same two halves over the message layer with per-batch activation/grad
+messages, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.comm.actors import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+
+Pytree = Any
+
+MSG_ACTS = "splitnn.acts"          # client -> server: activations + labels
+MSG_GRADS = "splitnn.grads"        # server -> client: dL/dacts
+MSG_DONE = "splitnn.done"
+
+
+@dataclasses.dataclass
+class SplitNNConfig:
+    epochs_per_client: int = 1     # MAX_EPOCH_PER_NODE (client.py:16)
+    rounds: int = 1                # full round-robin sweeps over clients
+    client_lr: float = 0.1         # optim.SGD(lr=0.1, momentum=0.9, wd=5e-4)
+    server_lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 5e-4
+
+
+def _sgd(lr, momentum, wd):
+    return optax.chain(optax.add_decayed_weights(wd),
+                       optax.sgd(lr, momentum=momentum))
+
+
+class SplitModel:
+    """The split pair: ``body`` (client half) maps x -> activations, ``head``
+    (server half) maps activations -> logits."""
+
+    def __init__(self, body, head):
+        self.body = body
+        self.head = head
+
+    def init(self, rng: jax.Array, sample_x: jax.Array) -> Tuple[Pytree, Pytree]:
+        rb, rh = jax.random.split(rng)
+        body_params = self.body.init(rb, sample_x)["params"]
+        acts = self.body.apply({"params": body_params}, sample_x)
+        head_params = self.head.init(rh, acts)["params"]
+        return body_params, head_params
+
+    def forward_body(self, body_params, x):
+        return self.body.apply({"params": body_params}, x)
+
+    def forward_head(self, head_params, acts):
+        return self.head.apply({"params": head_params}, acts)
+
+
+class SplitNNSimulator:
+    """On-chip split learning: one jit'd step trains both halves end-to-end;
+    round-robin client activation matches server.py:70-71."""
+
+    def __init__(self, split: SplitModel, cfg: SplitNNConfig):
+        self.split = split
+        self.cfg = cfg
+        self.client_opt = _sgd(cfg.client_lr, cfg.momentum, cfg.weight_decay)
+        self.server_opt = _sgd(cfg.server_lr, cfg.momentum, cfg.weight_decay)
+
+        def loss_fn(body_params, head_params, batch):
+            acts = self.split.forward_body(body_params, batch["x"])
+            logits = self.split.forward_head(head_params, acts)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"])
+            m = batch["mask"]
+            loss = jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+            correct = jnp.sum((jnp.argmax(logits, -1) == batch["y"]) * m)
+            return loss, correct
+
+        def epoch_step(body_params, head_params, body_opt, head_opt, data):
+            """One client's epoch: scan over its batches."""
+            def step(carry, batch):
+                bp, hp, bo, ho = carry
+                (loss, correct), grads = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(bp, hp, batch)
+                gb, gh = grads
+                ub, bo = self.client_opt.update(gb, bo, bp)
+                uh, ho = self.server_opt.update(gh, ho, hp)
+                return ((optax.apply_updates(bp, ub),
+                         optax.apply_updates(hp, uh), bo, ho),
+                        {"loss": loss, "correct": correct,
+                         "total": jnp.sum(batch["mask"])})
+
+            (bp, hp, bo, ho), ms = jax.lax.scan(
+                step, (body_params, head_params, body_opt, head_opt), data)
+            return bp, hp, bo, ho, ms
+
+        self._epoch_step = jax.jit(epoch_step)
+        self._eval_loss = jax.jit(loss_fn)
+
+    def run(self, client_data: List[Dict[str, jnp.ndarray]],
+            rng: jax.Array) -> Dict[str, Any]:
+        """client_data: per-client {"x": [S, B, ...], "y": [S, B], "mask"}.
+        Each client holds its own body params (the reference gives each
+        client a copy it trains while active, passing it along the ring via
+        the server; we model the canonical variant where the active client's
+        trained body is handed to the next client, client.py:12-13
+        node_left/node_right semantics)."""
+        cfg = self.cfg
+        sample_x = jax.tree.map(lambda v: v[0], client_data[0]["x"])
+        body_params, head_params = self.split.init(rng, sample_x)
+        body_opt = self.client_opt.init(body_params)
+        head_opt = self.server_opt.init(head_params)
+        history = []
+        for sweep in range(cfg.rounds):
+            for ci, data in enumerate(client_data):
+                for _ in range(cfg.epochs_per_client):
+                    body_params, head_params, body_opt, head_opt, ms = \
+                        self._epoch_step(body_params, head_params,
+                                         body_opt, head_opt, data)
+                    history.append({
+                        "sweep": sweep, "client": ci,
+                        "loss": float(np.mean(np.asarray(ms["loss"]))),
+                        "acc": float(np.sum(np.asarray(ms["correct"]))
+                                     / max(1.0, float(np.sum(np.asarray(ms["total"])))))})
+        return {"body_params": body_params, "head_params": head_params,
+                "history": history}
+
+    def evaluate(self, body_params, head_params,
+                 data: Dict[str, jnp.ndarray]) -> Dict[str, float]:
+        total_loss, total_correct, total = 0.0, 0.0, 0.0
+        for s in range(data["x"].shape[0]):
+            batch = {k: data[k][s] for k in ("x", "y", "mask")}
+            loss, correct = self._eval_loss(body_params, head_params, batch)
+            n = float(np.sum(np.asarray(batch["mask"])))
+            total_loss += float(loss) * n
+            total_correct += float(correct)
+            total += n
+        return {"loss": total_loss / max(total, 1.0),
+                "acc": total_correct / max(total, 1.0)}
+
+
+# ---------------------------------------------------------------------------
+# Cross-silo wire variant: explicit per-batch activation/grad messages.
+
+class SplitNNServerActor(ServerManager):
+    """Holds the head; answers every MSG_ACTS with MSG_GRADS
+    (server.py forward_pass/backward_pass)."""
+
+    def __init__(self, node_id, transport, split: SplitModel,
+                 head_params, cfg: SplitNNConfig):
+        super().__init__(node_id, transport)
+        self.split = split
+        self.cfg = cfg
+        self.head_params = head_params
+        self.opt = _sgd(cfg.server_lr, cfg.momentum, cfg.weight_decay)
+        self.opt_state = self.opt.init(head_params)
+        self.metrics = {"correct": 0.0, "total": 0.0, "loss_sum": 0.0}
+
+        def step(head_params, opt_state, acts, y, mask):
+            def loss_fn(hp, a):
+                logits = self.split.forward_head(hp, a)
+                ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+                loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+                correct = jnp.sum((jnp.argmax(logits, -1) == y) * mask)
+                return loss, correct
+
+            (loss, correct), (g_hp, g_acts) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(head_params, acts)
+            updates, opt_state = self.opt.update(g_hp, opt_state, head_params)
+            return (optax.apply_updates(head_params, updates), opt_state,
+                    g_acts, loss, correct)
+
+        self._step = jax.jit(step)
+
+    def register_handlers(self):
+        self.register_handler(MSG_ACTS, self._on_acts)
+        self.register_handler(MSG_DONE, lambda m: self.finish())
+
+    def _on_acts(self, msg: Message):
+        acts = jnp.asarray(msg.get("acts"))
+        y = jnp.asarray(msg.get("y"))
+        mask = jnp.asarray(msg.get("mask"))
+        self.head_params, self.opt_state, g_acts, loss, correct = self._step(
+            self.head_params, self.opt_state, acts, y, mask)
+        self.metrics["correct"] += float(correct)
+        self.metrics["total"] += float(np.sum(np.asarray(mask)))
+        self.metrics["loss_sum"] += float(loss) * float(np.sum(np.asarray(mask)))
+        self.send(MSG_GRADS, msg.sender_id, grads=np.asarray(g_acts))
+
+
+class SplitNNClientActor(ClientManager):
+    """Holds the body; streams its batches, applying returned grads
+    (client.py forward_pass/backward_pass)."""
+
+    def __init__(self, node_id, transport, split: SplitModel, body_params,
+                 data: Dict[str, np.ndarray], server_id: int,
+                 cfg: SplitNNConfig):
+        super().__init__(node_id, transport)
+        self.split = split
+        self.cfg = cfg
+        self.body_params = body_params
+        self.data = data
+        self.server_id = server_id
+        self.opt = _sgd(cfg.client_lr, cfg.momentum, cfg.weight_decay)
+        self.opt_state = self.opt.init(body_params)
+        self._batch_idx = 0
+        self._epoch = 0
+
+        def fwd(body_params, x):
+            return self.split.forward_body(body_params, x)
+
+        def bwd(body_params, opt_state, x, g_acts):
+            _, vjp = jax.vjp(lambda bp: fwd(bp, x), body_params)
+            (g_bp,) = vjp(g_acts)
+            updates, opt_state = self.opt.update(g_bp, opt_state, body_params)
+            return optax.apply_updates(body_params, updates), opt_state
+
+        self._fwd = jax.jit(fwd)
+        self._bwd = jax.jit(bwd)
+
+    def register_handlers(self):
+        self.register_handler(MSG_GRADS, self._on_grads)
+
+    def start_epoch(self):
+        self._batch_idx = 0
+        self._send_next_batch()
+
+    def _current_batch(self):
+        return {k: jnp.asarray(self.data[k][self._batch_idx])
+                for k in ("x", "y", "mask")}
+
+    def _send_next_batch(self):
+        b = self._current_batch()
+        self._last_x = b["x"]
+        acts = self._fwd(self.body_params, b["x"])
+        self.send(MSG_ACTS, self.server_id, acts=np.asarray(acts),
+                  y=np.asarray(b["y"]), mask=np.asarray(b["mask"]))
+
+    def _on_grads(self, msg: Message):
+        g_acts = jnp.asarray(msg.get("grads"))
+        self.body_params, self.opt_state = self._bwd(
+            self.body_params, self.opt_state, self._last_x, g_acts)
+        self._batch_idx += 1
+        if self._batch_idx < self.data["x"].shape[0]:
+            self._send_next_batch()
+        else:
+            self._epoch += 1
+            if self._epoch < self.cfg.epochs_per_client:
+                self.start_epoch()
+            else:
+                self.send(MSG_DONE, self.server_id)

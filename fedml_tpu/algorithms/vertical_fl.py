@@ -1,0 +1,213 @@
+"""Classical vertical FL — guest (labels) + hosts (feature shards).
+
+Reference choreography (``fedml_api/distributed/classical_vertical_fl/``):
+per round the guest takes one minibatch, computes its own logits, ADDS the
+hosts' logits for the same rows, computes sigmoid-BCE loss against its
+labels, takes d(loss)/d(total logits) and sends that gradient back to every
+host; each party then backprops through its local classifier + feature
+extractor (guest_trainer.py:73-126, host_trainer.py; vfl_api.py:16-41).
+Batches advance cyclically (batch_idx wraps, guest_trainer.py:75-83).
+
+TPU-native design: the logits-sum boundary is a *linear* point of the chain
+rule, so the whole multi-party step differentiates as ONE jit program —
+``jax.grad`` over sum(party_logits) produces exactly the gradients the wire
+protocol ships (d total_logits is broadcast to every party, then each party
+VJPs it locally).  Party feature shards can additionally be sharded over a
+mesh axis via pjit PartitionSpec (feature-dim TP, SURVEY.md §2.5).  The
+standalone fixture semantics (vfl_fixture.py) are `VerticalFL.fit`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class VFLConfig:
+    rounds: int = 100            # reference drives by comm rounds, 1 batch each
+    batch_size: int = 256
+    lr: float = 0.01
+    momentum: float = 0.9
+    weight_decay: float = 0.01   # DenseModel SGD defaults (vfl_models_standalone.py:13)
+    frequency_of_the_test: int = 10
+
+
+def _cyclic_batch(rnd: int, batch_size: int, n: int) -> np.ndarray:
+    """Always-full cyclic minibatch (guest_trainer.py:75-83 wraps batch_idx
+    so every round serves batch_size rows).  Full batches keep the jit'd
+    step at ONE static shape — ragged tails would recompile per size."""
+    return np.arange(rnd * batch_size, rnd * batch_size + batch_size) % max(1, n)
+
+
+class VerticalFL:
+    """``party_models``: one flax module per party (guest first); each maps
+    its feature shard to a [B, 1] logit contribution."""
+
+    def __init__(self, party_models: Sequence[Any], cfg: VFLConfig):
+        self.party_models = list(party_models)
+        self.cfg = cfg
+        self.opt = optax.chain(
+            optax.add_decayed_weights(cfg.weight_decay),
+            optax.sgd(cfg.lr, momentum=cfg.momentum))
+        self._build()
+
+    def _build(self):
+        def total_logits(params_list, xs):
+            out = 0.0
+            for model, p, x in zip(self.party_models, params_list, xs):
+                out = out + model.apply({"params": p}, x)
+            return out
+
+        def loss_fn(params_list, xs, y):
+            logits = total_logits(params_list, xs)
+            # guest loss: sigmoid BCE (criterion = BCEWithLogitsLoss).
+            # Labels may arrive as {-1,+1} (NUS-WIDE neg_label=-1) or {0,1};
+            # binarize so BCE targets are always valid probabilities.
+            y01 = (y > 0).astype(logits.dtype)
+            return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, y01))
+
+        def step(params_list, opt_states, xs, y):
+            loss, grads = jax.value_and_grad(loss_fn)(params_list, xs, y)
+            new_params, new_opts = [], []
+            for p, s, g in zip(params_list, opt_states, grads):
+                u, s = self.opt.update(g, s, p)
+                new_params.append(optax.apply_updates(p, u))
+                new_opts.append(s)
+            return new_params, new_opts, loss
+
+        self._step = jax.jit(step)
+        self._predict = jax.jit(total_logits)
+
+    def init(self, rng: jax.Array, xs: Sequence[np.ndarray]):
+        rngs = jax.random.split(rng, len(self.party_models))
+        params = [m.init(r, jnp.asarray(x[:1]))["params"]
+                  for m, r, x in zip(self.party_models, rngs, xs)]
+        return params, [self.opt.init(p) for p in params]
+
+    def fit(self, train: Sequence[np.ndarray], test: Sequence[np.ndarray],
+            rng: Optional[jax.Array] = None) -> Dict[str, Any]:
+        """train/test: [Xa, Xb, ..., y] (the loaders' contract,
+        lending_club_dataset.py:162)."""
+        cfg = self.cfg
+        rng = rng if rng is not None else jax.random.key(0)
+        xs_all, y_all = train[:-1], np.asarray(train[-1], np.float32)
+        params, opt_states = self.init(rng, xs_all)
+        n = len(y_all)
+        history: List[Dict[str, float]] = []
+        for rnd in range(cfg.rounds):
+            idx = _cyclic_batch(rnd, cfg.batch_size, n)
+            xs = [jnp.asarray(x[idx]) for x in xs_all]
+            y = jnp.asarray(y_all[idx])
+            params, opt_states, loss = self._step(params, opt_states, xs, y)
+            if (rnd + 1) % cfg.frequency_of_the_test == 0 or rnd == cfg.rounds - 1:
+                m = self.evaluate(params, test)
+                m.update({"round": rnd, "train_loss": float(loss)})
+                history.append(m)
+        return {"params": params, "history": history}
+
+    def evaluate(self, params, test: Sequence[np.ndarray]) -> Dict[str, float]:
+        xs = [jnp.asarray(x) for x in test[:-1]]
+        y = np.asarray(test[-1], np.float32)
+        logits = np.asarray(self._predict(params, xs))
+        pred = (logits > 0).astype(np.float32)
+        # the reference evaluates accuracy/auc on 0/1-ized labels
+        y01 = (y > 0).astype(np.float32)
+        return {"test_acc": float((pred == y01).mean())}
+
+
+# ---------------------------------------------------------------------------
+# Explicit message-protocol parity (cross-silo wire): the guest/host split.
+
+class VFLHost:
+    """Host party: logits up, gradient down (host_trainer semantics)."""
+
+    def __init__(self, model, x: np.ndarray, cfg: VFLConfig):
+        self.model = model
+        self.x = x
+        self.cfg = cfg
+        self.opt = optax.chain(
+            optax.add_decayed_weights(cfg.weight_decay),
+            optax.sgd(cfg.lr, momentum=cfg.momentum))
+
+        def fwd(p, x):
+            return model.apply({"params": p}, x)
+
+        def bwd(p, opt_state, x, g_logits):
+            _, vjp = jax.vjp(lambda q: fwd(q, x), p)
+            (g_p,) = vjp(g_logits)
+            u, opt_state = self.opt.update(g_p, opt_state, p)
+            return optax.apply_updates(p, u), opt_state
+
+        self._fwd = jax.jit(fwd)
+        self._bwd = jax.jit(bwd)
+
+    def init(self, rng):
+        self.params = self.model.init(rng, jnp.asarray(self.x[:1]))["params"]
+        self.opt_state = self.opt.init(self.params)
+
+    def compute_logits(self, idx: np.ndarray) -> np.ndarray:
+        self._batch = jnp.asarray(self.x[idx])
+        return np.asarray(self._fwd(self.params, self._batch))
+
+    def apply_gradients(self, g_logits: np.ndarray) -> None:
+        self.params, self.opt_state = self._bwd(
+            self.params, self.opt_state, self._batch,
+            jnp.asarray(g_logits))
+
+
+class VFLGuest(VFLHost):
+    """Guest = host + labels + loss; produces the gradient it sends to all
+    hosts (guest_trainer.py:94-105: d loss / d total_logits)."""
+
+    def __init__(self, model, x: np.ndarray, y: np.ndarray, cfg: VFLConfig):
+        super().__init__(model, x, cfg)
+        self.y = np.asarray(y, np.float32)
+
+        def loss_and_grad(logits_total, y):
+            def f(l):
+                y01 = (y > 0).astype(l.dtype)
+                return jnp.mean(optax.sigmoid_binary_cross_entropy(l, y01))
+            return jax.value_and_grad(f)(logits_total)
+
+        self._loss_and_grad = jax.jit(loss_and_grad)
+
+    def guest_step(self, host_logits: List[np.ndarray], idx: np.ndarray
+                   ) -> np.ndarray:
+        guest_logits = self.compute_logits(idx)
+        total = jnp.asarray(sum(host_logits, guest_logits))
+        loss, g = self._loss_and_grad(total, jnp.asarray(self.y[idx]))
+        self.last_loss = float(loss)
+        g = np.asarray(g)
+        self.apply_gradients(g)       # guest backprops its own stack too
+        return g                      # broadcast to hosts
+
+
+def run_vfl_protocol(guest: VFLGuest, hosts: List[VFLHost],
+                     rounds: int, batch_size: int,
+                     rng: Optional[jax.Array] = None) -> List[float]:
+    """Drives the wire choreography end-to-end (vfl_api.py:16-41).  Returns
+    per-round guest losses.  Numerically identical to `VerticalFL.fit` —
+    the test suite asserts it."""
+    rng = rng if rng is not None else jax.random.key(0)
+    rngs = jax.random.split(rng, len(hosts) + 1)
+    guest.init(rngs[0])
+    for h, r in zip(hosts, rngs[1:]):
+        h.init(r)
+    n = len(guest.y)
+    losses = []
+    for rnd in range(rounds):
+        idx = _cyclic_batch(rnd, batch_size, n)
+        host_logits = [h.compute_logits(idx) for h in hosts]
+        g = guest.guest_step(host_logits, idx)
+        for h in hosts:
+            h.apply_gradients(g)
+        losses.append(guest.last_loss)
+    return losses

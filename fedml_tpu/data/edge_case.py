@@ -75,9 +75,19 @@ def targeted_task_eval_set(dataset: str, data_dir: Optional[str] = None,
         for fname in ("southwest_images_new_test.pkl",
                       "ardis_test_dataset.pt"):
             p = os.path.join(data_dir, fname)
-            if os.path.exists(p) and fname.endswith(".pkl"):
+            if not os.path.exists(p):
+                continue
+            if fname.endswith(".pkl"):
                 x, y = load_external_poison(p, target_label)
-                return {"x": x, "y": y}
+            else:  # torch-pickled ARDIS TensorDataset (data_loader.py:320)
+                import torch
+                obj = torch.load(p, map_location="cpu", weights_only=False)
+                tensors = getattr(obj, "tensors", obj)
+                x = np.asarray(tensors[0], dtype=np.float32)
+                if x.max() > 1.5:
+                    x = x / 255.0
+                y = np.full(len(x), target_label, dtype=np.int32)
+            return {"x": x, "y": y}
     rng = np.random.RandomState(seed)
     x = rng.rand(n, *image_shape).astype(np.float32)
     x, y = apply_pixel_trigger(x, target_label)

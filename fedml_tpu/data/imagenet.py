@@ -64,15 +64,18 @@ def landmarks_image_path(data_dir: str, image_id: str) -> str:
 def materialize_clients(index: Dict, decode: Callable[[object], Tuple],
                         client_ids: Sequence, batch_size: int,
                         class_num: int,
-                        test_index: Optional[Dict] = None) -> FederatedData:
+                        test_index: Optional[Dict] = None,
+                        image_size: int = 224) -> FederatedData:
     """Stage a subset of clients into stacked arrays.  ``decode`` maps one
     index entry to (x, y)."""
+    empty_shape = (0, image_size, image_size, 3)
+
     def stage(table, cids):
         xs, ys = [], []
         for cid in cids:
             pairs = [decode(e) for e in table.get(cid, [])]
             xs.append(np.stack([p[0] for p in pairs]) if pairs
-                      else np.zeros((0, 224, 224, 3), np.float32))
+                      else np.zeros(empty_shape, np.float32))
             ys.append(np.asarray([p[1] for p in pairs], np.int32))
         return xs, ys
 
@@ -98,14 +101,18 @@ def load_landmarks(data_dir: str, mapping_csv: str, batch_size: int = 20,
                    max_clients: Optional[int] = None,
                    image_size: int = 224) -> FederatedData:
     """gld23k (233 clients / 203 classes) or gld160k (1262 / 2028), chosen by
-    which mapping csv is passed (Landmarks/data_loader.py docstring)."""
+    which mapping csv is passed (Landmarks/data_loader.py docstring).
+    A relative ``mapping_csv`` resolves against ``data_dir``."""
+    if not os.path.isabs(mapping_csv):
+        mapping_csv = os.path.join(data_dir, mapping_csv)
     mapping = read_landmarks_mapping(mapping_csv)
     cids = sorted(mapping)[:max_clients]
     class_num = 1 + max(c for entries in mapping.values()
                         for _, c in entries)
     decode = lambda e: (_decode_image(landmarks_image_path(data_dir, e[0]),
                                       image_size), e[1])
-    return materialize_clients(mapping, decode, cids, batch_size, class_num)
+    return materialize_clients(mapping, decode, cids, batch_size, class_num,
+                               image_size=image_size)
 
 
 def load_imagenet(data_dir: str, batch_size: int = 32,
@@ -116,4 +123,5 @@ def load_imagenet(data_dir: str, batch_size: int = 32,
     # entry = (path, class); rebuild table with labels attached
     table = {c: [(p, c) for p in train_idx[c]] for c in cids}
     decode = lambda e: (_decode_image(e[0], image_size), e[1])
-    return materialize_clients(table, decode, cids, batch_size, class_num)
+    return materialize_clients(table, decode, cids, batch_size, class_num,
+                               image_size=image_size)

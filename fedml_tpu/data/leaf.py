@@ -123,8 +123,7 @@ def load_shakespeare_leaf(data_dir: str, batch_size: int = 4) -> FederatedData:
 
 
 def load_synthetic_leaf(data_dir: str, batch_size: int = 10,
-                        dimension: int = 60, class_num: int = 10
-                        ) -> FederatedData:
+                        class_num: int = 10) -> FederatedData:
     """LEAF synthetic_(a,b) json produced by generate_synthetic.py
     (data/synthetic_0.5_0.5/generate_synthetic.py:73-…)."""
     users, _, train_data, test_data = read_leaf_dirs(

@@ -108,10 +108,14 @@ def _register_all() -> None:
     from . import imagenet
     register_dataset("ilsvrc2012", imagenet.load_imagenet,
                      img_twin((224, 224, 3), 1000))
-    register_dataset("gld23k", imagenet.load_landmarks,
-                     img_twin((224, 224, 3), 203))
-    register_dataset("gld160k", imagenet.load_landmarks,
-                     img_twin((224, 224, 3), 2028))
+    # per-name mapping-csv defaults (Landmarks/data_loader.py docstring:
+    # data_user_dict/gld{23k,160k}_user_dict_train.csv under the data root)
+    register_dataset(
+        "gld23k", imagenet.load_landmarks, img_twin((224, 224, 3), 203),
+        mapping_csv="data_user_dict/gld23k_user_dict_train.csv")
+    register_dataset(
+        "gld160k", imagenet.load_landmarks, img_twin((224, 224, 3), 2028),
+        mapping_csv="data_user_dict/gld160k_user_dict_train.csv")
 
 
 _register_all()

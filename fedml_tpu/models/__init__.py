@@ -8,3 +8,6 @@ from fedml_tpu.models.vgg import VGG, vgg11, vgg13, vgg16
 from fedml_tpu.models.mobilenet import (
     MobileNetV1, MobileNetV3, mobilenet, mobilenet_v3)
 from fedml_tpu.models.efficientnet import EfficientNet, efficientnet
+from fedml_tpu.models.resnet_gkt import GKTClientResNet, GKTServerResNet
+from fedml_tpu.models.vfl import (
+    VFLFeatureExtractor, VFLClassifier, VFLPartyNet)

@@ -1,0 +1,64 @@
+"""Split ResNets for FedGKT (group knowledge transfer).
+
+Parity targets (``fedml_api/model/cv/resnet56_gkt/``):
+
+* client net ``resnet8_56`` (resnet_client.py:230): CIFAR stem (3x3 conv,
+  16 planes) + layer1 only (BasicBlocks at 16 planes) + avgpool + fc.
+  Its forward returns ``(logits, extracted_features)`` where the features
+  are the PRE-POOL conv maps [B, 32, 32, 16] (resnet_client.py:189-203) —
+  those maps are what travels to the server.
+* server net ``resnet55/49`` (resnet_server.py): consumes the feature maps
+  and runs the remaining stages layer2 (32 planes, stride 2) + layer3
+  (64 planes, stride 2) + avgpool + fc.
+
+Norm defaults to GroupNorm (TPU-friendly; see models/norms.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.norms import Norm, conv_kernel_init
+from fedml_tpu.models.resnet import BasicBlock, Bottleneck, _conv
+
+
+class GKTClientResNet(nn.Module):
+    """Edge-side small net: stem + stage-1 blocks; emits (logits, feature
+    maps).  ``blocks=3`` ≈ resnet8_56."""
+    blocks: int = 3
+    num_classes: int = 10
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = _conv(16, 3)(x)
+        x = Norm(self.norm)(x, train)
+        x = nn.relu(x)
+        for _ in range(self.blocks):
+            x = BasicBlock(16, 1, self.norm)(x, train)
+        feats = x                                  # [B, H, W, 16] to server
+        pooled = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes, name="fc")(pooled)
+        return logits, feats
+
+
+class GKTServerResNet(nn.Module):
+    """Server-side large net on received feature maps: stages 2-3 + head.
+    ``layers=(9, 9)`` with BasicBlock ≈ the resnet55 server half."""
+    layers: Sequence[int] = (9, 9)
+    num_classes: int = 10
+    norm: str = "group"
+    block: type = BasicBlock
+
+    @nn.compact
+    def __call__(self, feats, train: bool = False) -> jnp.ndarray:
+        x = feats
+        for planes, n_blocks in zip((32, 64), self.layers):
+            for i in range(n_blocks):
+                x = self.block(planes, 2 if i == 0 else 1, self.norm)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="fc")(x)

@@ -1,0 +1,44 @@
+"""Vertical-FL party models (finance stack).
+
+Parity targets (``fedml_api/model/finance/``): ``VFLFeatureExtractor`` —
+Linear+ReLU over a party's feature shard (vfl_feature_extractor.py:4-14);
+``VFLClassifier``/``DenseModel`` — a single Linear producing the party's
+logit contribution (vfl_classifier.py:4-12, vfl_models_standalone.py:6-33).
+Hosts run extractor→dense; the guest additionally owns the label-side loss.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VFLFeatureExtractor(nn.Module):
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.relu(nn.Dense(self.output_dim)(x))
+
+
+class VFLClassifier(nn.Module):
+    """Party logit head; output_dim 1 for the binary finance tasks."""
+    output_dim: int = 1
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(self.output_dim, use_bias=self.use_bias)(x)
+
+
+class VFLPartyNet(nn.Module):
+    """extractor -> dense head: one party's full local stack
+    (host_trainer / guest_trainer both compose these two,
+    classical_vertical_fl/guest_trainer.py:79-80)."""
+    hidden_dim: int
+    output_dim: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = VFLFeatureExtractor(self.hidden_dim)(x)
+        return VFLClassifier(self.output_dim)(h)

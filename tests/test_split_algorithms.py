@@ -1,0 +1,182 @@
+"""Split-computation family: SplitNN, FedGKT, vertical FL.
+
+Key oracles:
+* SplitNN on-chip step must equal training the composed model end-to-end
+  (the split is architecture, not math).
+* The VFL wire protocol (logits up / grads down) must match the single-jit
+  joint-gradient implementation batch for batch — proving the jit program
+  computes exactly what the reference's message choreography computes.
+* FedGKT's KL term matches the reference formula; training reduces loss and
+  the client/server exchange shapes line up.
+"""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms import (
+    SplitModel, SplitNNConfig, SplitNNSimulator,
+    SplitNNClientActor, SplitNNServerActor,
+    FedGKT, FedGKTConfig, kd_kl_loss,
+    VerticalFL, VFLConfig, VFLGuest, VFLHost, run_vfl_protocol,
+)
+from fedml_tpu.comm.local import LocalHub
+from fedml_tpu.data.stacking import stack_client_data
+from fedml_tpu.data.tabular import synthetic_vfl_parties
+from fedml_tpu.models import GKTClientResNet, GKTServerResNet, VFLPartyNet
+
+
+class _Body(nn.Module):
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.relu(nn.Dense(16)(x.reshape(x.shape[0], -1)))
+
+
+class _Head(nn.Module):
+    classes: int = 5
+
+    @nn.compact
+    def __call__(self, a, train=False):
+        return nn.Dense(self.classes)(a)
+
+
+def _client_batches(n_clients=3, steps=4, bs=8, dim=12, classes=5, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n_clients):
+        out.append({
+            "x": jnp.asarray(rng.randn(steps, bs, dim).astype(np.float32)),
+            "y": jnp.asarray(rng.randint(0, classes, (steps, bs))),
+            "mask": jnp.ones((steps, bs), jnp.float32)})
+    return out
+
+
+def test_splitnn_simulator_learns_and_round_robins():
+    split = SplitModel(_Body(), _Head())
+    cfg = SplitNNConfig(epochs_per_client=2, rounds=2, client_lr=0.05,
+                        server_lr=0.05)
+    sim = SplitNNSimulator(split, cfg)
+    data = _client_batches()
+    out = sim.run(data, jax.random.key(0))
+    hist = out["history"]
+    # round-robin order: c0,c0,c1,c1,c2,c2 then again (epochs_per_client=2)
+    assert [h["client"] for h in hist[:6]] == [0, 0, 1, 1, 2, 2]
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    m = sim.evaluate(out["body_params"], out["head_params"], data[0])
+    assert 0.0 <= m["acc"] <= 1.0
+
+
+def test_splitnn_wire_matches_onchip_single_client():
+    """One client's epoch over the actor wire == the fused jit epoch."""
+    split = SplitModel(_Body(), _Head())
+    cfg = SplitNNConfig(epochs_per_client=1, rounds=1, client_lr=0.05,
+                        server_lr=0.05, momentum=0.0, weight_decay=0.0)
+    data = _client_batches(n_clients=1)[0]
+    body0, head0 = split.init(jax.random.key(1), data["x"][0])
+
+    # on-chip fused epoch
+    sim = SplitNNSimulator(split, cfg)
+    bo = sim.client_opt.init(body0)
+    ho = sim.server_opt.init(head0)
+    body_ref, head_ref, *_ = sim._epoch_step(body0, head0, bo, ho, data)
+
+    # wire epoch
+    hub = LocalHub()
+    np_data = {k: np.asarray(v) for k, v in data.items()}
+    server = SplitNNServerActor(0, hub.transport(0), split, head0, cfg)
+    client = SplitNNClientActor(1, hub.transport(1), split, body0, np_data,
+                                server_id=0, cfg=cfg)
+    server.register_handlers()
+    client.register_handlers()
+    client.start_epoch()
+    hub.pump()
+    for a, b in zip(jax.tree.leaves(body_ref),
+                    jax.tree.leaves(client.body_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(head_ref),
+                    jax.tree.leaves(server.head_params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_kd_kl_loss_matches_reference_formula():
+    rng = np.random.RandomState(0)
+    s = jnp.asarray(rng.randn(4, 7).astype(np.float32))
+    t = jnp.asarray(rng.randn(4, 7).astype(np.float32))
+    T = 3.0
+    got = kd_kl_loss(s, t, T)
+    # reference: -T^2 * sum(softmax(t/T)+1e-7 floored) * log_softmax(s/T)) / B
+    # ... as a KL it also carries the teacher-entropy term; check against a
+    # direct computation of T^2 * KL(q || p)
+    q = jax.nn.softmax(t / T) + 1e-7
+    logp = jax.nn.log_softmax(s / T)
+    want = T * T * jnp.sum(q * (jnp.log(q) - logp), axis=-1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+    # KL >= 0 up to the epsilon floor
+    assert float(got.min()) > -1e-3
+
+
+def test_fedgkt_end_to_end_tiny():
+    client = GKTClientResNet(blocks=1, num_classes=4)
+    server = GKTServerResNet(layers=(1, 1), num_classes=4)
+    rng = np.random.RandomState(0)
+    C, S, B = 3, 2, 4
+    cohort = {
+        "x": jnp.asarray(rng.rand(C, S, B, 8, 8, 3).astype(np.float32)),
+        "y": jnp.asarray(rng.randint(0, 4, (C, S, B))),
+        "mask": jnp.ones((C, S, B), jnp.float32)}
+    gkt = FedGKT(client, server, FedGKTConfig(
+        rounds=3, epochs_client=1, epochs_server=1,
+        lr_client=0.05, lr_server=0.05, temperature=3.0, alpha=1.0))
+    out = gkt.run(cohort)
+    hist = out["history"]
+    assert len(hist) == 3
+    assert hist[-1]["server_loss"] < hist[0]["server_loss"] * 1.5
+    m = gkt.evaluate(out["client_params"], out["server_params"], cohort)
+    assert 0.0 <= m["acc"] <= 1.0
+    # per-client nets stay distinct (GKT never averages them)
+    leaves = jax.tree.leaves(out["client_params"])
+    assert leaves[0].shape[0] == C
+    assert not np.allclose(np.asarray(leaves[-1][0]), np.asarray(leaves[-1][1]))
+
+
+def test_vfl_joint_fit_learns():
+    train, test = synthetic_vfl_parties(n_samples=400, feature_dims=(6, 10),
+                                        seed=1)
+    models = [VFLPartyNet(hidden_dim=8), VFLPartyNet(hidden_dim=8)]
+    vfl = VerticalFL(models, VFLConfig(rounds=60, batch_size=64, lr=0.1,
+                                       frequency_of_the_test=20))
+    out = vfl.fit(train, test, jax.random.key(0))
+    accs = [h["test_acc"] for h in out["history"]]
+    assert accs[-1] > 0.75
+
+
+def test_vfl_wire_protocol_matches_joint_grad():
+    """Message choreography == one jit joint gradient, step for step."""
+    train, _ = synthetic_vfl_parties(n_samples=128, feature_dims=(5, 7),
+                                     seed=2)
+    Xa, Xb, y = train
+    cfg = VFLConfig(rounds=5, batch_size=32, lr=0.05, momentum=0.9,
+                    weight_decay=0.01)
+    models = [VFLPartyNet(hidden_dim=6), VFLPartyNet(hidden_dim=6)]
+
+    vfl = VerticalFL(models, cfg)
+    params, opts = vfl.init(jax.random.key(7), [Xa, Xb])
+    joint_losses = []
+    n = len(y)
+    from fedml_tpu.algorithms.vertical_fl import _cyclic_batch
+    for rnd in range(cfg.rounds):
+        idx = _cyclic_batch(rnd, cfg.batch_size, n)
+        xs = [jnp.asarray(Xa[idx]), jnp.asarray(Xb[idx])]
+        params, opts, loss = vfl._step(params, opts, xs, jnp.asarray(y[idx]))
+        joint_losses.append(float(loss))
+
+    guest = VFLGuest(models[0], Xa, y, cfg)
+    host = VFLHost(models[1], Xb, cfg)
+    wire_losses = run_vfl_protocol(guest, host and [host], cfg.rounds,
+                                   cfg.batch_size, jax.random.key(7))
+    np.testing.assert_allclose(joint_losses, wire_losses, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(params[0]),
+                    jax.tree.leaves(guest.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
