@@ -18,3 +18,4 @@ from fedml_tpu.algorithms.fedgkt import FedGKT, FedGKTConfig, kd_kl_loss
 from fedml_tpu.algorithms.vertical_fl import (
     VerticalFL, VFLConfig, VFLGuest, VFLHost, run_vfl_protocol,
 )
+from fedml_tpu.algorithms.fednas import FedNAS, FedNASConfig

@@ -48,6 +48,11 @@ class FedGKTConfig:
     alpha: float = 1.0           # KD weight (GKTClientTrainer.py:78)
     seed: int = 0
 
+    def __post_init__(self):
+        if self.epochs_client < 1 or self.epochs_server < 1:
+            raise ValueError("FedGKT requires epochs_client >= 1 and "
+                             "epochs_server >= 1 (both phases must run)")
+
 
 def kd_kl_loss(student_logits: jnp.ndarray, teacher_logits: jnp.ndarray,
                temperature: float) -> jnp.ndarray:

@@ -1,0 +1,181 @@
+"""FedNAS — federated neural architecture search over the DARTS space.
+
+Reference choreography (``fedml_api/distributed/fednas/``):
+
+* **search phase**: each client alternates a weight step on its train split
+  with an architecture step on its validation split
+  (FedNASTrainer.local_search:82-120).  The α gradient is the reference's
+  ``Architect.step_v2`` (darts/architect.py:58-99): ∇α L_val + λ·∇α L_train
+  — both first-order, no unrolled second-order term.
+* **aggregation**: the server sample-weight-averages BOTH the network
+  weights (FedNASAggregator.py:71-93) and the α tensors (:95-113), then
+  decodes and logs the global genotype each round
+  (record_model_global_architecture :173).
+* **train phase**: after search, the decoded genotype builds the discrete
+  net and plain FedAvg trains it (FedNASTrainer.train).
+
+TPU-native design: one jit'd ``search_round`` per client runs the
+alternating w/α scan; the cohort is vmapped so all clients search in
+parallel; aggregation is the same weighted pytree mean used everywhere
+(α is just another pytree leaf pair).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.models.darts import (DARTSSearchNetwork, Genotype,
+                                    init_alphas, parse_genotype)
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class FedNASConfig:
+    rounds: int = 5
+    epochs: int = 1               # local search epochs per round
+    w_lr: float = 0.025           # --learning_rate (main_fednas.py)
+    w_momentum: float = 0.9
+    w_weight_decay: float = 3e-4
+    arch_lr: float = 3e-4         # --arch_learning_rate
+    arch_weight_decay: float = 1e-3
+    lambda_train_regularizer: float = 1.0   # step_v2 λ (main_fednas.py:91)
+    grad_clip: float = 5.0        # --grad_clip
+    seed: int = 0
+
+
+class FedNAS:
+    def __init__(self, model: DARTSSearchNetwork, cfg: FedNASConfig):
+        self.model = model
+        self.cfg = cfg
+        self.w_opt = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.add_decayed_weights(cfg.w_weight_decay),
+            optax.sgd(cfg.w_lr, momentum=cfg.w_momentum))
+        # Architect optimizer: Adam(arch_lr, betas=(0.5, 0.999), wd)
+        # (darts/architect.py:15-30)
+        self.a_opt = optax.chain(
+            optax.add_decayed_weights(cfg.arch_weight_decay),
+            optax.adam(cfg.arch_lr, b1=0.5, b2=0.999))
+        self._build()
+
+    def _build(self):
+        cfg = self.cfg
+
+        def loss_fn(params, alphas, batch):
+            logits = self.model.apply({"params": params}, batch["x"], alphas,
+                                      train=True)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, batch["y"])
+            m = batch["mask"]
+            return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+        def search_step(carry, xs):
+            """One (α step on valid, w step on train) pair — the loop body
+            of local_search (FedNASTrainer.py:87-120)."""
+            params, alphas, w_state, a_state = carry
+            train_batch, valid_batch = xs
+
+            # architect step_v2: ∇α L_val + λ ∇α L_train (first-order)
+            g_val = jax.grad(loss_fn, argnums=1)(params, alphas, valid_batch)
+            g_train = jax.grad(loss_fn, argnums=1)(params, alphas, train_batch)
+            g_alpha = jax.tree.map(
+                lambda gv, gt: gv + cfg.lambda_train_regularizer * gt,
+                g_val, g_train)
+            a_updates, a_state = self.a_opt.update(g_alpha, a_state, alphas)
+            alphas = optax.apply_updates(alphas, a_updates)
+
+            # weight step on the train batch (grad-clip 5 in w_opt chain)
+            loss, g_w = jax.value_and_grad(loss_fn)(params, alphas, train_batch)
+            w_updates, w_state = self.w_opt.update(g_w, w_state, params)
+            params = optax.apply_updates(params, w_updates)
+            return (params, alphas, w_state, a_state), loss
+
+        def search_round(params, alphas, w_state, a_state, train, valid):
+            """E epochs of alternating steps over one client's batches."""
+            carry = (params, alphas, w_state, a_state)
+            for _ in range(cfg.epochs):
+                carry, losses = jax.lax.scan(search_step, carry,
+                                             (train, valid))
+            return carry + (jnp.mean(losses),)
+
+        # all sampled clients search in parallel (vs N MPI processes)
+        self._cohort_search = jax.jit(jax.vmap(
+            search_round, in_axes=(None, None, None, None, 0, 0)))
+
+        def metrics(params, alphas, batch):
+            logits = self.model.apply({"params": params}, batch["x"], alphas)
+            pred = jnp.argmax(logits, -1)
+            m = batch["mask"]
+            return {"correct": jnp.sum((pred == batch["y"]) * m),
+                    "total": jnp.sum(m)}
+
+        self._metrics = jax.jit(metrics)
+
+    def init(self, rng: jax.Array, sample_x: jnp.ndarray):
+        ra, rp = jax.random.split(rng)
+        alphas = init_alphas(ra, self.model.steps)
+        params = self.model.init(rp, sample_x, alphas)["params"]
+        return params, alphas
+
+    def run(self, train_cohort: Dict[str, jnp.ndarray],
+            valid_cohort: Dict[str, jnp.ndarray],
+            rng: Optional[jax.Array] = None) -> Dict[str, Any]:
+        """cohorts: stacked {"x": [C, S, B, ...], "y", "mask"}; valid is each
+        client's local search/validation split (local_search draws val
+        batches alongside train batches, FedNASTrainer.py:98-101)."""
+        cfg = self.cfg
+        rng = rng if rng is not None else jax.random.key(cfg.seed)
+        params, alphas = self.init(rng, train_cohort["x"][0, 0])
+        w_state = self.w_opt.init(params)
+        a_state = self.a_opt.init(alphas)
+        history: List[Dict[str, Any]] = []
+        weights = train_cohort["num_samples"] if "num_samples" in train_cohort \
+            else jnp.sum(train_cohort["mask"], axis=(1, 2))
+
+        for rnd in range(cfg.rounds):
+            c_params, c_alphas, w_state_c, a_state_c, losses = \
+                self._cohort_search(params, alphas, w_state, a_state,
+                                    {k: train_cohort[k]
+                                     for k in ("x", "y", "mask")},
+                                    {k: valid_cohort[k]
+                                     for k in ("x", "y", "mask")})
+            # server aggregates BOTH weights and α, sample-weighted.
+            # (tuple roots — α pairs, optax namedtuple states — are wrapped
+            # in a dict so tree_weighted_mean sees ONE stacked pytree, not a
+            # sequence of separate trees)
+            wrap = lambda t: tree_weighted_mean({"t": t}, weights)["t"]
+            params = tree_weighted_mean(c_params, weights)
+            alphas = wrap(c_alphas)
+            # optimizer state mean keeps momentum continuity across rounds
+            w_state = wrap(w_state_c)
+            a_state = wrap(a_state_c)
+            genotype = self.genotype(alphas)
+            history.append({"round": rnd,
+                            "search_loss": float(jnp.mean(losses)),
+                            "genotype": genotype})
+        return {"params": params, "alphas": alphas, "history": history}
+
+    def genotype(self, alphas) -> Genotype:
+        """Decode the global architecture
+        (FedNASAggregator.record_model_global_architecture:173)."""
+        an, ar = alphas
+        return parse_genotype(np.asarray(an), np.asarray(ar),
+                              self.model.steps, self.model.multiplier)
+
+    def evaluate(self, params, alphas, data: Dict[str, jnp.ndarray]
+                 ) -> Dict[str, float]:
+        correct = total = 0.0
+        for s in range(data["x"].shape[0]):
+            m = self._metrics(params, alphas,
+                              {k: data[k][s] for k in ("x", "y", "mask")})
+            correct += float(m["correct"])
+            total += float(m["total"])
+        return {"acc": correct / max(total, 1.0)}

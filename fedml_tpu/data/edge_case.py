@@ -86,6 +86,12 @@ def targeted_task_eval_set(dataset: str, data_dir: Optional[str] = None,
                 x = np.asarray(tensors[0], dtype=np.float32)
                 if x.max() > 1.5:
                     x = x / 255.0
+                # torch ships NCHW (or [N, H, W]); everything here is NHWC
+                if x.ndim == 3:
+                    x = x[..., None]
+                elif x.ndim == 4 and x.shape[1] in (1, 3) \
+                        and x.shape[-1] not in (1, 3):
+                    x = x.transpose(0, 2, 3, 1)
                 y = np.full(len(x), target_label, dtype=np.int32)
             return {"x": x, "y": y}
     rng = np.random.RandomState(seed)

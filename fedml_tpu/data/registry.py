@@ -58,7 +58,8 @@ def load_data(name: str, data_dir: Optional[str] = None,
             raise FileNotFoundError(
                 f"dataset {name!r}: data_dir {data_dir!r} does not exist")
         merged = {**entry["defaults"], **kw}
-        return entry["loader"](data_dir=data_dir, **merged)
+        return entry["loader"](
+            data_dir=data_dir, **_accepted_kwargs(entry["loader"], merged))
     if synthetic_ok and entry["twin"] is not None:
         return entry["twin"](**_accepted_kwargs(entry["twin"], kw))
     raise FileNotFoundError(

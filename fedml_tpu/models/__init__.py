@@ -11,3 +11,7 @@ from fedml_tpu.models.efficientnet import EfficientNet, efficientnet
 from fedml_tpu.models.resnet_gkt import GKTClientResNet, GKTServerResNet
 from fedml_tpu.models.vfl import (
     VFLFeatureExtractor, VFLClassifier, VFLPartyNet)
+from fedml_tpu.models.darts import (
+    DARTSSearchNetwork, DARTSEvalNetwork, Genotype, PRIMITIVES,
+    init_alphas, parse_genotype,
+)

@@ -1,0 +1,330 @@
+"""DARTS search space — the FedNAS engine (flax, TPU-native).
+
+Parity targets (``fedml_api/model/cv/darts/``):
+
+* 8 primitives (genotypes.py:5-14): none / max_pool_3x3 / avg_pool_3x3 /
+  skip_connect / sep_conv_{3,5} / dil_conv_{3,5} (operations.py:4-20);
+* ``MixedOp`` — softmax(α)-weighted sum of all candidate ops on an edge
+  (model_search.py:10-23);
+* ``Cell`` — 2 input states + ``steps`` intermediate nodes, every node the
+  sum of mixed-ops over all previous states; output = concat of the last
+  ``multiplier`` states (model_search.py:26-59);
+* ``Network`` — 3C stem, reduction cells at layers//3 and 2·layers//3,
+  global pool + linear head (model_search.py:172-231);
+* genotype decode — per node keep the top-2 incoming edges ranked by their
+  best non-'none' op weight (model_search.py:258-291);
+* the discrete evaluation network built from a decoded genotype (model.py).
+
+TPU-native notes: α lives OUTSIDE the flax params as an explicit
+``(alphas_normal, alphas_reduce)`` pytree passed to ``__call__`` — the
+weight/α bilevel split is then two `jax.grad` argnums instead of parameter
+filtering (FedNASTrainer.py:38-49 does it by id() set membership).  All ops
+run for every edge and the softmax mixes them — dense but static-shaped,
+exactly what XLA wants; norms default to GroupNorm (BN affine=False in the
+reference search net; GN is the TPU-stable equivalent).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.models.norms import Norm, conv_kernel_init
+
+Genotype = collections.namedtuple(
+    "Genotype", "normal normal_concat reduce reduce_concat")
+
+PRIMITIVES = (
+    "none", "max_pool_3x3", "avg_pool_3x3", "skip_connect",
+    "sep_conv_3x3", "sep_conv_5x5", "dil_conv_3x3", "dil_conv_5x5")
+
+
+def _conv(C_out, kernel, stride=1, dilation=1, groups=1):
+    return nn.Conv(C_out, (kernel, kernel), strides=(stride, stride),
+                   kernel_dilation=(dilation, dilation),
+                   feature_group_count=groups, padding="SAME",
+                   use_bias=False, kernel_init=conv_kernel_init)
+
+
+def _avg_pool_nopad(x, stride):
+    """AvgPool2d(3, count_include_pad=False): divide by the number of REAL
+    elements in each window, not the fixed 9."""
+    s = nn.avg_pool(x, (3, 3), strides=(stride, stride), padding="SAME")
+    ones = jnp.ones_like(x[..., :1])
+    frac = nn.avg_pool(ones, (3, 3), strides=(stride, stride), padding="SAME")
+    return s / frac
+
+
+class ReLUConvNorm(nn.Module):
+    C_out: int
+    kernel: int = 1
+    stride: int = 1
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.relu(x)
+        x = _conv(self.C_out, self.kernel, self.stride)(x)
+        return Norm(self.norm)(x, train)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 channel-preserving reduce: two offset 1x1/2 convs concat'd
+    (operations.py FactorizedReduce)."""
+    C_out: int
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.relu(x)
+        a = _conv(self.C_out // 2, 1, 2)(x)
+        b = _conv(self.C_out - self.C_out // 2, 1, 2)(x[:, 1:, 1:, :])
+        out = jnp.concatenate([a, b], axis=-1)
+        return Norm(self.norm)(out, train)
+
+
+class SepConv(nn.Module):
+    """relu-sepconv-1x1-norm twice (operations.py SepConv)."""
+    C: int
+    kernel: int
+    stride: int
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        for i, stride in enumerate((self.stride, 1)):
+            x = nn.relu(x)
+            x = _conv(self.C, self.kernel, stride, groups=self.C)(x)
+            x = _conv(self.C, 1)(x)
+            x = Norm(self.norm)(x, train)
+        return x
+
+
+class DilConv(nn.Module):
+    """relu - dilated depthwise - 1x1 - norm (operations.py DilConv)."""
+    C: int
+    kernel: int
+    stride: int
+    dilation: int = 2
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.relu(x)
+        x = _conv(self.C, self.kernel, self.stride, self.dilation,
+                  groups=self.C)(x)
+        x = _conv(self.C, 1)(x)
+        return Norm(self.norm)(x, train)
+
+
+class _Op(nn.Module):
+    """One primitive on one edge."""
+    op_name: str  # `name` is reserved by flax
+    C: int
+    stride: int
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        n, C, s = self.op_name, self.C, self.stride
+        if n == "none":
+            if s > 1:
+                x = x[:, ::s, ::s, :]
+            return jnp.zeros_like(x)
+        if n == "max_pool_3x3":
+            return nn.max_pool(x, (3, 3), strides=(s, s), padding="SAME")
+        if n == "avg_pool_3x3":
+            return _avg_pool_nopad(x, s)
+        if n == "skip_connect":
+            return x if s == 1 else FactorizedReduce(C, self.norm)(x, train)
+        if n == "sep_conv_3x3":
+            return SepConv(C, 3, s, self.norm)(x, train)
+        if n == "sep_conv_5x5":
+            return SepConv(C, 5, s, self.norm)(x, train)
+        if n == "dil_conv_3x3":
+            return DilConv(C, 3, s, 2, self.norm)(x, train)
+        if n == "dil_conv_5x5":
+            return DilConv(C, 5, s, 2, self.norm)(x, train)
+        raise ValueError(f"unknown primitive {n!r}")
+
+
+class MixedOp(nn.Module):
+    """All primitives on an edge, mixed by the edge's softmaxed α row
+    (model_search.py:10-23)."""
+    C: int
+    stride: int
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, weights, train=False):
+        outs = [_Op(p, self.C, self.stride, self.norm)(x, train)
+                for p in PRIMITIVES]
+        return sum(w * o for w, o in zip(weights, outs))
+
+
+def num_edges(steps: int) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class SearchCell(nn.Module):
+    """model_search.py:26-59.  ``weights``: [num_edges, num_ops]."""
+    steps: int
+    multiplier: int
+    C: int
+    reduction: bool
+    reduction_prev: bool
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, s0, s1, weights, train=False):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C, self.norm)(s0, train)
+        else:
+            s0 = ReLUConvNorm(self.C, 1, 1, self.norm)(s0, train)
+        s1 = ReLUConvNorm(self.C, 1, 1, self.norm)(s1, train)
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            s = sum(MixedOp(self.C, 2 if self.reduction and j < 2 else 1,
+                            self.norm)(h, weights[offset + j], train)
+                    for j, h in enumerate(states))
+            offset += len(states)
+            states.append(s)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+class DARTSSearchNetwork(nn.Module):
+    """model_search.py:172-231; __call__(x, alphas=(normal, reduce))."""
+    C: int = 16
+    num_classes: int = 10
+    layers: int = 8
+    steps: int = 4
+    multiplier: int = 4
+    stem_multiplier: int = 3
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, alphas, train: bool = False):
+        alphas_normal, alphas_reduce = alphas
+        w_normal = jax.nn.softmax(alphas_normal, axis=-1)
+        w_reduce = jax.nn.softmax(alphas_reduce, axis=-1)
+        x = _conv(self.stem_multiplier * self.C, 3)(x)
+        s0 = s1 = Norm(self.norm)(x, train)
+        C_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            cell = SearchCell(self.steps, self.multiplier, C_curr,
+                              reduction, reduction_prev, self.norm)
+            s0, s1 = s1, cell(s0, s1,
+                              w_reduce if reduction else w_normal, train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="classifier")(out)
+
+
+def init_alphas(rng: jax.Array, steps: int = 4
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """1e-3 · N(0,1) init (model_search.py _initialize_alphas)."""
+    k = num_edges(steps)
+    rn, rr = jax.random.split(rng)
+    shape = (k, len(PRIMITIVES))
+    return (1e-3 * jax.random.normal(rn, shape),
+            1e-3 * jax.random.normal(rr, shape))
+
+
+def parse_genotype(alphas_normal: np.ndarray, alphas_reduce: np.ndarray,
+                   steps: int = 4, multiplier: int = 4) -> Genotype:
+    """Decode α -> discrete genotype (model_search.py:258-291): softmax the
+    rows, then per node keep the 2 incoming edges with the largest best
+    non-'none' weight, each edge keeping its best non-'none' op."""
+    none_idx = PRIMITIVES.index("none")
+
+    def _softmax(a):
+        e = np.exp(a - a.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def _parse(weights):
+        gene = []
+        start, n = 0, 2
+        for i in range(steps):
+            W = weights[start:start + n]
+            edges = sorted(
+                range(i + 2),
+                key=lambda x: -max(W[x][k] for k in range(len(W[x]))
+                                   if k != none_idx))[:2]
+            for j in edges:
+                k_best = max((k for k in range(W.shape[1]) if k != none_idx),
+                             key=lambda k: W[j][k])
+                gene.append((PRIMITIVES[k_best], j))
+            start += n
+            n += 1
+        return gene
+
+    concat = list(range(2 + steps - multiplier, steps + 2))
+    return Genotype(normal=_parse(_softmax(np.asarray(alphas_normal))),
+                    normal_concat=concat,
+                    reduce=_parse(_softmax(np.asarray(alphas_reduce))),
+                    reduce_concat=concat)
+
+
+class EvalCell(nn.Module):
+    """Discrete cell from a decoded genotype (darts/model.py Cell)."""
+    genotype: Genotype
+    C: int
+    reduction: bool
+    reduction_prev: bool
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, s0, s1, train=False):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C, self.norm)(s0, train)
+        else:
+            s0 = ReLUConvNorm(self.C, 1, 1, self.norm)(s0, train)
+        s1 = ReLUConvNorm(self.C, 1, 1, self.norm)(s1, train)
+        gene = self.genotype.reduce if self.reduction else self.genotype.normal
+        concat = (self.genotype.reduce_concat if self.reduction
+                  else self.genotype.normal_concat)
+        states = [s0, s1]
+        for i in range(len(gene) // 2):
+            outs = []
+            for (op_name, j) in gene[2 * i:2 * i + 2]:
+                stride = 2 if self.reduction and j < 2 else 1
+                outs.append(_Op(op_name, self.C, stride, self.norm)(
+                    states[j], train))
+            states.append(outs[0] + outs[1])
+        return jnp.concatenate([states[k] for k in concat], axis=-1)
+
+
+class DARTSEvalNetwork(nn.Module):
+    """Discrete network from a genotype (darts/model.py NetworkCIFAR)."""
+    genotype: Genotype
+    C: int = 36
+    num_classes: int = 10
+    layers: int = 8
+    stem_multiplier: int = 3
+    norm: str = "group"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _conv(self.stem_multiplier * self.C, 3)(x)
+        s0 = s1 = Norm(self.norm)(x, train)
+        C_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                C_curr *= 2
+            s0, s1 = s1, EvalCell(self.genotype, C_curr, reduction,
+                                  reduction_prev, self.norm)(s0, s1, train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes, name="classifier")(out)

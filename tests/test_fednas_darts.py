@@ -1,0 +1,96 @@
+"""DARTS search space + FedNAS federated architecture search."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms import FedNAS, FedNASConfig
+from fedml_tpu.models import (DARTSSearchNetwork, DARTSEvalNetwork,
+                              PRIMITIVES, init_alphas, parse_genotype)
+from fedml_tpu.models.darts import num_edges, MixedOp
+
+
+def _tiny_net():
+    # layers=3 so the net has both normal (i=0,1) and reduction (i=2) cells
+    # (reduction at layers//3=1... for layers=3: i in (1, 2))
+    return DARTSSearchNetwork(C=4, num_classes=3, layers=3, steps=2,
+                              multiplier=2, stem_multiplier=1)
+
+
+def test_search_network_shapes_and_alpha_grad():
+    net = _tiny_net()
+    rng = jax.random.key(0)
+    alphas = init_alphas(rng, steps=2)
+    assert alphas[0].shape == (num_edges(2), len(PRIMITIVES)) == (5, 8)
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 16, 16, 3), np.float32)
+    params = net.init(rng, x, alphas)["params"]
+    logits = net.apply({"params": params}, x, alphas)
+    assert logits.shape == (2, 3)
+
+    # α must receive gradient through the mixed ops
+    def loss(a):
+        out = net.apply({"params": params}, x, a)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(alphas)
+    assert float(jnp.abs(g[0]).sum()) > 0
+    assert float(jnp.abs(g[1]).sum()) > 0
+
+
+def test_parse_genotype_topology():
+    steps, mult = 4, 4
+    k = num_edges(steps)
+    rng = np.random.RandomState(0)
+    g = parse_genotype(rng.randn(k, 8), rng.randn(k, 8), steps, mult)
+    # 2 ops per node
+    assert len(g.normal) == 2 * steps and len(g.reduce) == 2 * steps
+    assert list(g.normal_concat) == [2, 3, 4, 5]
+    # 'none' is never selected; input indices are valid
+    for i, (op, j) in enumerate(g.normal):
+        assert op != "none" and op in PRIMITIVES
+        assert 0 <= j < (i // 2) + 2
+
+
+def test_parse_genotype_prefers_heavy_edges():
+    """An α that strongly favors sep_conv on edge 0 must decode to it."""
+    steps, mult = 2, 2
+    k = num_edges(steps)
+    a = np.full((k, 8), -5.0)
+    a[:, PRIMITIVES.index("skip_connect")] = 0.0
+    a[0, PRIMITIVES.index("sep_conv_3x3")] = 5.0
+    g = parse_genotype(a, a, steps, mult)
+    assert ("sep_conv_3x3", 0) in g.normal
+
+
+def test_eval_network_from_genotype():
+    rng = np.random.RandomState(1)
+    k = num_edges(2)
+    g = parse_genotype(rng.randn(k, 8), rng.randn(k, 8), 2, 2)
+    net = DARTSEvalNetwork(genotype=g, C=4, num_classes=3, layers=2,
+                           stem_multiplier=1)
+    x = jnp.asarray(rng.rand(2, 16, 16, 3), np.float32)
+    params = net.init(jax.random.key(0), x)["params"]
+    out = jax.jit(lambda p, v: net.apply({"params": p}, v))(params, x)
+    assert out.shape == (2, 3)
+
+
+def test_fednas_search_rounds():
+    rng = np.random.RandomState(0)
+    C, S, B = 2, 2, 4
+    mk = lambda: {
+        "x": jnp.asarray(rng.rand(C, S, B, 8, 8, 3).astype(np.float32)),
+        "y": jnp.asarray(rng.randint(0, 3, (C, S, B))),
+        "mask": jnp.ones((C, S, B), jnp.float32)}
+    train, valid = mk(), mk()
+    nas = FedNAS(_tiny_net(), FedNASConfig(rounds=2, epochs=1))
+    out = nas.run(train, valid)
+    assert len(out["history"]) == 2
+    gen = out["history"][-1]["genotype"]
+    assert len(gen.normal) == 4                 # steps=2 -> 2 ops/node
+    # α moved away from init and aggregation kept shapes
+    an, ar = out["alphas"]
+    assert an.shape == (num_edges(2), len(PRIMITIVES))
+    assert float(jnp.abs(an).max()) > 1e-3
+    m = nas.evaluate(out["params"], out["alphas"], {
+        k: train[k][0] for k in ("x", "y", "mask")})
+    assert 0.0 <= m["acc"] <= 1.0
