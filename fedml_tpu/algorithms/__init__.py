@@ -19,3 +19,9 @@ from fedml_tpu.algorithms.vertical_fl import (
     VerticalFL, VFLConfig, VFLGuest, VFLHost, run_vfl_protocol,
 )
 from fedml_tpu.algorithms.fednas import FedNAS, FedNASConfig
+from fedml_tpu.algorithms.fedgan import (
+    FedGan, FedGanConfig, AsDGan, AsDGanConfig)
+from fedml_tpu.algorithms.fedseg import (
+    SegmentationWorkload, EvaluationMetricsKeeper, evaluate_segmentation,
+    segmentation_ce, segmentation_focal, confusion_matrix,
+    metrics_from_confusion)

@@ -1,0 +1,241 @@
+"""Federated GANs: FedGan (FedAvg over G+D) and AsDGan (split G/D).
+
+Reference choreography:
+
+* **FedGan** (``fedml_api/distributed/fedgan/``): every client runs local
+  adversarial training (alternating D and G steps on its own data); the
+  server sample-weight-averages the COMBINED G+D parameters exactly like
+  FedAvg (FedGanAggregator.aggregate:72-100).
+* **AsDGan** (``fedml_api/distributed/asdgan/``): asymmetric split — the
+  SERVER owns the generator; each CLIENT owns a private discriminator and
+  its private real data.  Per iteration the server generates fake images
+  from conditioning inputs and routes each fake to the client whose real
+  sample conditioned it (AsDGanAggregator.forward_G:124-157); clients train
+  D on (real, fake) and return ∂L_G/∂fake (AsDGanClientManager /
+  add_local_grad:190-196); the server scatters the sample-weighted grads
+  back into the batch and applies them to G
+  (AsDGanAggregator.backward_G:159-187).
+
+TPU-native design: AsDGan's grad round-trip is the chain rule split at
+``fake_B`` — on-chip it is ONE jit program: G forward, per-client D losses
+via vmap over stacked private D params, and ``jax.grad`` w.r.t. G params
+computes exactly the scatter-aggregated gradient the wire protocol builds by
+hand.  D updates stay per-client (vmapped, never averaged), preserving the
+privacy topology.  FedGan reuses the cohort machinery: local adversarial
+scan, weighted pytree mean of (G, D).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core.pytree import tree_weighted_mean
+
+Pytree = Any
+
+
+def bce_logits(logits: jnp.ndarray, target: float) -> jnp.ndarray:
+    """GAN BCE against a constant real/fake target."""
+    t = jnp.full_like(logits, target)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, t))
+
+
+@dataclasses.dataclass
+class FedGanConfig:
+    rounds: int = 5
+    local_epochs: int = 1
+    lr_g: float = 2e-4
+    lr_d: float = 2e-4
+    seed: int = 0
+
+
+class FedGan:
+    """FedAvg over the (G, D) pair; local loop = alternating D/G steps."""
+
+    def __init__(self, generator, discriminator, cfg: FedGanConfig):
+        self.G = generator
+        self.D = discriminator
+        self.cfg = cfg
+        self.g_opt = optax.adam(cfg.lr_g, b1=0.5)
+        self.d_opt = optax.adam(cfg.lr_d, b1=0.5)
+        self._build()
+
+    def _build(self):
+        cfg = self.cfg
+
+        def d_loss_fn(dp, gp, real, rng):
+            z = jax.random.normal(rng, (real.shape[0], self.G.z_dim))
+            fake = self.G.apply({"params": gp}, z)
+            d_real = self.D.apply({"params": dp}, real)
+            d_fake = self.D.apply({"params": dp}, fake)
+            return bce_logits(d_real, 1.0) + bce_logits(d_fake, 0.0)
+
+        def g_loss_fn(gp, dp, batch_size, rng):
+            z = jax.random.normal(rng, (batch_size, self.G.z_dim))
+            fake = self.G.apply({"params": gp}, z)
+            return bce_logits(self.D.apply({"params": dp}, fake), 1.0)
+
+        def local_train(params, data, rng):
+            """One client's adversarial epoch(s); params = {"g","d"}."""
+            gp, dp = params["g"], params["d"]
+            g_state = self.g_opt.init(gp)
+            d_state = self.d_opt.init(dp)
+
+            def step(carry, xs):
+                gp, dp, gs, ds = carry
+                batch, step_rng = xs
+                r1, r2 = jax.random.split(step_rng)
+                dl, g_d = jax.value_and_grad(d_loss_fn)(dp, gp, batch["x"], r1)
+                du, ds = self.d_opt.update(g_d, ds, dp)
+                dp = optax.apply_updates(dp, du)
+                gl, g_g = jax.value_and_grad(g_loss_fn)(
+                    gp, dp, batch["x"].shape[0], r2)
+                gu, gs = self.g_opt.update(g_g, gs, gp)
+                gp = optax.apply_updates(gp, gu)
+                return (gp, dp, gs, ds), {"d_loss": dl, "g_loss": gl}
+
+            S = data["x"].shape[0]
+            carry = (gp, dp, g_state, d_state)
+            for _ in range(cfg.local_epochs):
+                rng, ep_rng = jax.random.split(rng)
+                carry, ms = jax.lax.scan(
+                    step, carry, ({"x": data["x"]},
+                                  jax.random.split(ep_rng, S)))
+            gp, dp, _, _ = carry
+            return {"g": gp, "d": dp}, ms
+
+        self._cohort_train = jax.jit(jax.vmap(
+            local_train, in_axes=(None, 0, 0)))
+
+    def init(self, rng: jax.Array, sample_x: jnp.ndarray) -> Dict[str, Pytree]:
+        rg, rd = jax.random.split(rng)
+        z = jnp.zeros((1, self.G.z_dim))
+        return {"g": self.G.init(rg, z)["params"],
+                "d": self.D.init(rd, sample_x[:1])["params"]}
+
+    def run(self, cohort: Dict[str, jnp.ndarray],
+            rng: Optional[jax.Array] = None) -> Dict[str, Any]:
+        """cohort: {"x": [C, S, B, H, W, ch], "num_samples": [C]}."""
+        cfg = self.cfg
+        rng = rng if rng is not None else jax.random.key(cfg.seed)
+        rng, init_rng = jax.random.split(rng)
+        params = self.init(init_rng, cohort["x"][0, 0])
+        C = cohort["x"].shape[0]
+        weights = cohort.get("num_samples",
+                             jnp.ones((C,), jnp.float32))
+        history: List[Dict[str, float]] = []
+        for rnd in range(cfg.rounds):
+            rng, r = jax.random.split(rng)
+            client_params, ms = self._cohort_train(
+                params, {"x": cohort["x"]}, jax.random.split(r, C))
+            params = tree_weighted_mean(client_params, weights)
+            history.append({"round": rnd,
+                            "d_loss": float(jnp.mean(ms["d_loss"])),
+                            "g_loss": float(jnp.mean(ms["g_loss"]))})
+        return {"params": params, "history": history}
+
+    def sample(self, params: Dict[str, Pytree], rng: jax.Array, n: int):
+        z = jax.random.normal(rng, (n, self.G.z_dim))
+        return self.G.apply({"params": params["g"]}, z)
+
+
+@dataclasses.dataclass
+class AsDGanConfig:
+    epochs: int = 5
+    lr_g: float = 2e-4
+    lr_d: float = 2e-4
+    sample_method: str = "balance"   # 'balance' weights grads by n_c
+    seed: int = 0
+
+
+class AsDGan:
+    """Server generator vs. per-client private discriminators."""
+
+    def __init__(self, generator, discriminator, cfg: AsDGanConfig):
+        self.G = generator
+        self.D = discriminator
+        self.cfg = cfg
+        self.g_opt = optax.adam(cfg.lr_g, b1=0.5)
+        self.d_opt = optax.adam(cfg.lr_d, b1=0.5)
+        self._build()
+
+    def _build(self):
+        cfg = self.cfg
+
+        def d_step(dp, ds, gp, a, real):
+            """One client's D update on (real, G(a)) — client-side."""
+            fake = jax.lax.stop_gradient(self.G.apply({"params": gp}, a))
+
+            def loss(dp):
+                return (bce_logits(self.D.apply({"params": dp}, real), 1.0)
+                        + bce_logits(self.D.apply({"params": dp}, fake), 0.0))
+
+            dl, g = jax.value_and_grad(loss)(dp)
+            du, ds = self.d_opt.update(g, ds, dp)
+            return optax.apply_updates(dp, du), ds, dl
+
+        def g_step(gp, gs, dps, a, weights):
+            """Server G update: the weighted per-client ∂L_G/∂fake grads,
+            aggregated through the chain rule in one jax.grad
+            (= backward_G's hand-built scatter, AsDGanAggregator.py:159-187).
+            a: [C, B, H, W, ch]; dps: stacked per-client D params."""
+
+            def loss(gp):
+                fake = self.G.apply({"params": gp},
+                                    a.reshape((-1,) + a.shape[2:]))
+                fake = fake.reshape(a.shape[:2] + fake.shape[1:])
+
+                def per_client(dp, f):
+                    return bce_logits(self.D.apply({"params": dp}, f), 1.0)
+
+                losses = jax.vmap(per_client)(dps, fake)
+                w = weights / jnp.maximum(jnp.sum(weights), 1e-8)
+                return jnp.sum(losses * w)
+
+            gl, g = jax.value_and_grad(loss)(gp)
+            gu, gs = self.g_opt.update(g, gs, gp)
+            return optax.apply_updates(gp, gu), gs, gl
+
+        self._d_steps = jax.jit(jax.vmap(d_step,
+                                         in_axes=(0, 0, None, 0, 0)))
+        self._g_step = jax.jit(g_step)
+
+    def run(self, data: Dict[str, jnp.ndarray],
+            rng: Optional[jax.Array] = None) -> Dict[str, Any]:
+        """data: {"a": [C, S, B, H, W, ca] conditioning, "b": [C, S, B, H,
+        W, cb] private real images, "num_samples": [C]}."""
+        cfg = self.cfg
+        rng = rng if rng is not None else jax.random.key(cfg.seed)
+        C, S = data["a"].shape[:2]
+        rg, rd = jax.random.split(rng)
+        gp = self.G.init(rg, data["a"][0, 0])["params"]
+        dp0 = self.D.init(rd, data["b"][0, 0])["params"]
+        dps = jax.tree.map(lambda v: jnp.broadcast_to(v, (C,) + v.shape), dp0)
+        gs = self.g_opt.init(gp)
+        dss = jax.vmap(self.d_opt.init)(dps)
+        weights = (data.get("num_samples", jnp.ones((C,), jnp.float32))
+                   if cfg.sample_method == "balance"
+                   else jnp.ones((C,), jnp.float32))
+        history: List[Dict[str, float]] = []
+        for epoch in range(cfg.epochs):
+            d_losses, g_losses = [], []
+            for s in range(S):
+                a, b = data["a"][:, s], data["b"][:, s]
+                dps, dss, dl = self._d_steps(dps, dss, gp, a, b)
+                gp, gs, gl = self._g_step(gp, gs, dps, a, weights)
+                # keep device scalars async; host-sync once per epoch
+                d_losses.append(jnp.mean(dl))
+                g_losses.append(gl)
+            history.append({"epoch": epoch,
+                            "d_loss": float(np.mean(jax.device_get(d_losses))),
+                            "g_loss": float(np.mean(jax.device_get(g_losses)))})
+        return {"g_params": gp, "d_params": dps, "history": history}
+
+    def generate(self, g_params, a: jnp.ndarray) -> jnp.ndarray:
+        return self.G.apply({"params": g_params}, a)

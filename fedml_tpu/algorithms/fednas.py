@@ -50,6 +50,10 @@ class FedNASConfig:
     grad_clip: float = 5.0        # --grad_clip
     seed: int = 0
 
+    def __post_init__(self):
+        if self.epochs < 1:
+            raise ValueError("FedNAS requires epochs >= 1")
+
 
 class FedNAS:
     def __init__(self, model: DARTSSearchNetwork, cfg: FedNASConfig):

@@ -58,8 +58,17 @@ def load_data(name: str, data_dir: Optional[str] = None,
             raise FileNotFoundError(
                 f"dataset {name!r}: data_dir {data_dir!r} does not exist")
         merged = {**entry["defaults"], **kw}
-        return entry["loader"](
-            data_dir=data_dir, **_accepted_kwargs(entry["loader"], merged))
+        accepted = _accepted_kwargs(entry["loader"], merged)
+        # twin-only kwargs (e.g. num_clients) are dropped quietly; anything
+        # NEITHER callable accepts is a typo and must fail loudly
+        dropped = set(merged) - set(accepted)
+        twin_ok = set(_accepted_kwargs(entry["twin"], merged)) \
+            if entry["twin"] is not None else set()
+        unknown = dropped - twin_ok
+        if unknown:
+            raise TypeError(
+                f"dataset {name!r}: unknown option(s) {sorted(unknown)}")
+        return entry["loader"](data_dir=data_dir, **accepted)
     if synthetic_ok and entry["twin"] is not None:
         return entry["twin"](**_accepted_kwargs(entry["twin"], kw))
     raise FileNotFoundError(

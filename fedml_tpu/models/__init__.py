@@ -15,3 +15,7 @@ from fedml_tpu.models.darts import (
     DARTSSearchNetwork, DARTSEvalNetwork, Genotype, PRIMITIVES,
     init_alphas, parse_genotype,
 )
+from fedml_tpu.models.gan import (
+    Generator, Discriminator, CondGenerator, PatchDiscriminator)
+from fedml_tpu.models.segmentation import (
+    DeepLabV3Plus, UNet, AlignedXception, ResNetBackbone, ASPP)

@@ -1,0 +1,98 @@
+"""GAN model pair for the federated GAN algorithms.
+
+Parity targets: the reference's GAN nets live in
+``fedml_api/model/cv/{dadgan,asdgan,networks}.py`` — a conv
+generator/discriminator family (DCGAN/pix2pix flavors) managed by a torch
+``BaseModel`` with checkpoint save/load (base_model.py:161-178).  Here:
+
+* ``Generator`` — noise z -> image via dense reshape + transposed-conv
+  stack (the DCGAN shape used by FedGan);
+* ``Discriminator`` — image -> real/fake logit via strided conv stack;
+* ``CondGenerator`` — conditioning image A -> synthetic image B
+  (encoder-decoder, the AsDGan server generator whose outputs ship to
+  clients, AsDGanAggregator.forward_G);
+* ``PatchDiscriminator`` — patch-logit map (the client-side D judging
+  (A, B) pairs).
+
+GroupNorm everywhere (jit-stable under tiny federated batches); NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.norms import Norm
+
+
+class Generator(nn.Module):
+    """z [B, z_dim] -> image [B, H, W, C]; H = 4 * 2^len(widths)."""
+    out_channels: int = 1
+    base_hw: int = 4
+    widths: Sequence[int] = (64, 32)
+    z_dim: int = 64
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        B = z.shape[0]
+        x = nn.Dense(self.base_hw * self.base_hw * self.widths[0])(z)
+        x = x.reshape(B, self.base_hw, self.base_hw, self.widths[0])
+        for w in self.widths:
+            x = nn.ConvTranspose(w, (4, 4), strides=(2, 2), padding="SAME")(x)
+            x = Norm("group")(x, train)
+            x = nn.relu(x)
+        x = nn.Conv(self.out_channels, (3, 3), padding="SAME")(x)
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    """image -> single real/fake logit."""
+    widths: Sequence[int] = (32, 64)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for w in self.widths:
+            x = nn.Conv(w, (4, 4), strides=(2, 2), padding="SAME")(x)
+            x = Norm("group")(x, train)
+            x = nn.leaky_relu(x, 0.2)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(1)(x)
+
+
+class CondGenerator(nn.Module):
+    """A -> fake B (encoder-decoder with skip, pix2pix-lite)."""
+    out_channels: int = 1
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, a, train: bool = False):
+        e1 = nn.Conv(self.width, (4, 4), strides=(2, 2), padding="SAME")(a)
+        e1 = nn.relu(Norm("group")(e1, train))
+        e2 = nn.Conv(self.width * 2, (4, 4), strides=(2, 2), padding="SAME")(e1)
+        e2 = nn.relu(Norm("group")(e2, train))
+        d1 = nn.ConvTranspose(self.width, (4, 4), strides=(2, 2),
+                              padding="SAME")(e2)
+        d1 = nn.relu(Norm("group")(d1, train))
+        d1 = jnp.concatenate([d1, e1], axis=-1)
+        d2 = nn.ConvTranspose(self.width, (4, 4), strides=(2, 2),
+                              padding="SAME")(d1)
+        d2 = nn.relu(Norm("group")(d2, train))
+        x = nn.Conv(self.out_channels, (3, 3), padding="SAME")(d2)
+        return jnp.tanh(x)
+
+
+class PatchDiscriminator(nn.Module):
+    """(optionally A-conditioned) image -> patch logit map [B, h, w, 1]."""
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i, mult in enumerate((1, 2)):
+            x = nn.Conv(self.width * mult, (4, 4), strides=(2, 2),
+                        padding="SAME")(x)
+            if i:
+                x = Norm("group")(x, train)
+            x = nn.leaky_relu(x, 0.2)
+        return nn.Conv(1, (3, 3), padding="SAME")(x)

@@ -1,0 +1,202 @@
+"""Segmentation model zoo for FedSeg: U-Net and DeepLabV3+ (Xception/ResNet).
+
+Parity targets (``fedml_api/model/cv/``):
+
+* ``deeplabV3_plus.py``: ASPP with atrous rates (1, 6, 12, 18) at output
+  stride 16 + global-pool branch (:52-107), decoder fusing 4x-upsampled ASPP
+  output with 1x1-reduced low-level features then two 3x3 convs (:110-140);
+* ``xception.py`` AlignedXception backbone (:98-…): entry flow (two convs +
+  separable-conv blocks 128/256/728 with stride 2), middle flow (repeated
+  728 separable blocks), exit flow; low-level features tapped after the
+  first entry block;
+* ``unet.py``: 4-down/4-up encoder-decoder with skip concats (:61);
+* ``resnetLab.py``: ResNet backbone variant for deeplab (:49).
+
+All NHWC + GroupNorm (SyncBatchNorm machinery is obsolete under jit —
+SURVEY.md §2.3); bilinear resize via ``jax.image.resize``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.norms import Norm, conv_kernel_init
+from fedml_tpu.models.resnet import BasicBlock
+
+
+def _resize(x, hw):
+    return jax.image.resize(x, x.shape[:1] + tuple(hw) + x.shape[-1:],
+                            method="bilinear")
+
+
+class SepConvNorm(nn.Module):
+    """Depthwise-separable conv + norm (xception.py SeparableConv2d)."""
+    features: int
+    stride: int = 1
+    dilation: int = 1
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(x.shape[-1], (3, 3), strides=(self.stride,) * 2,
+                    kernel_dilation=(self.dilation,) * 2,
+                    feature_group_count=x.shape[-1], padding="SAME",
+                    use_bias=False, kernel_init=conv_kernel_init)(x)
+        x = nn.Conv(self.features, (1, 1), use_bias=False,
+                    kernel_init=conv_kernel_init)(x)
+        return Norm("group")(x, train)
+
+
+class XceptionBlock(nn.Module):
+    """reps× separable convs with residual skip (xception.py Block)."""
+    features: int
+    reps: int = 2
+    stride: int = 1
+    dilation: int = 1
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        skip = x
+        if self.stride != 1 or x.shape[-1] != self.features:
+            skip = nn.Conv(self.features, (1, 1),
+                           strides=(self.stride,) * 2, use_bias=False,
+                           kernel_init=conv_kernel_init)(x)
+            skip = Norm("group")(skip, train)
+        for i in range(self.reps):
+            x = nn.relu(x)
+            x = SepConvNorm(self.features,
+                            stride=self.stride if i == self.reps - 1 else 1,
+                            dilation=self.dilation)(x, train)
+        return x + skip
+
+
+class AlignedXception(nn.Module):
+    """Compact aligned Xception: entry (32/2, 64, blocks 128/2, 256/2,
+    728/2), middle (``middle_reps``x 728 blocks, dilation 1), exit (1024).
+    Returns (high-level feats at OS16, low-level feats at OS4)."""
+    middle_reps: int = 4
+    width_mult: float = 0.25   # compact default; 1.0 = paper widths
+
+    @nn.compact
+    def __call__(self, x, train=False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        w = lambda c: max(8, int(c * self.width_mult))
+        x = nn.Conv(w(32), (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, kernel_init=conv_kernel_init)(x)
+        x = nn.relu(Norm("group")(x, train))
+        x = nn.Conv(w(64), (3, 3), padding="SAME", use_bias=False,
+                    kernel_init=conv_kernel_init)(x)
+        x = nn.relu(Norm("group")(x, train))
+        x = XceptionBlock(w(128), stride=2)(x, train)
+        low_level = x                               # OS4
+        x = XceptionBlock(w(256), stride=2)(x, train)
+        x = XceptionBlock(w(728), stride=2)(x, train)   # OS16
+        for _ in range(self.middle_reps):
+            x = XceptionBlock(w(728), dilation=2)(x, train)
+        x = XceptionBlock(w(1024), dilation=2)(x, train)
+        return x, low_level
+
+
+class ResNetBackbone(nn.Module):
+    """resnetLab-style backbone: stem + 3 BasicBlock stages; stage strides
+    (1, 2, 2) after a /4 stem -> OS16 high / OS4 low."""
+    widths: Sequence[int] = (32, 64, 128)
+    blocks_per_stage: int = 2
+
+    @nn.compact
+    def __call__(self, x, train=False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = nn.Conv(self.widths[0], (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False, kernel_init=conv_kernel_init)(x)
+        x = nn.relu(Norm("group")(x, train))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        low_level = None
+        for si, planes in enumerate(self.widths):
+            for bi in range(self.blocks_per_stage):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                x = BasicBlock(planes, stride, "group")(x, train)
+            if si == 0:
+                low_level = x                       # OS4
+        return x, low_level
+
+
+class ASPP(nn.Module):
+    """Atrous spatial pyramid pooling (deeplabV3_plus.py:52-107): 1x1 +
+    three dilated 3x3 branches + image-level pool, concat -> 1x1."""
+    features: int = 64
+    rates: Sequence[int] = (6, 12, 18)
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        branches = [nn.Conv(self.features, (1, 1), use_bias=False,
+                            kernel_init=conv_kernel_init)(x)]
+        for r in self.rates:
+            branches.append(nn.Conv(
+                self.features, (3, 3), kernel_dilation=(r, r),
+                padding="SAME", use_bias=False,
+                kernel_init=conv_kernel_init)(x))
+        gp = jnp.mean(x, axis=(1, 2), keepdims=True)
+        gp = nn.Conv(self.features, (1, 1), use_bias=False,
+                     kernel_init=conv_kernel_init)(gp)
+        branches.append(jnp.broadcast_to(
+            gp, x.shape[:3] + (self.features,)))
+        out = jnp.concatenate(
+            [nn.relu(Norm("group")(b, train)) for b in branches], axis=-1)
+        out = nn.Conv(self.features, (1, 1), use_bias=False,
+                      kernel_init=conv_kernel_init)(out)
+        return nn.relu(Norm("group")(out, train))
+
+
+class DeepLabV3Plus(nn.Module):
+    """backbone -> ASPP -> decoder (low-level fuse) -> per-pixel logits
+    (deeplabV3_plus.py DeepLab)."""
+    num_classes: int
+    backbone: str = "xception"      # "xception" | "resnet"
+    aspp_features: int = 64
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        H, W = x.shape[1], x.shape[2]
+        bb = (AlignedXception() if self.backbone == "xception"
+              else ResNetBackbone())
+        high, low = bb(x, train)
+        a = ASPP(self.aspp_features)(high, train)
+        a = _resize(a, low.shape[1:3])
+        low = nn.Conv(48, (1, 1), use_bias=False,
+                      kernel_init=conv_kernel_init)(low)
+        low = nn.relu(Norm("group")(low, train))
+        d = jnp.concatenate([a, low], axis=-1)
+        for _ in range(2):
+            d = nn.Conv(self.aspp_features, (3, 3), padding="SAME",
+                        use_bias=False, kernel_init=conv_kernel_init)(d)
+            d = nn.relu(Norm("group")(d, train))
+        logits = nn.Conv(self.num_classes, (1, 1))(d)
+        return _resize(logits, (H, W))
+
+
+class UNet(nn.Module):
+    """Encoder-decoder with skip concats (unet.py:61)."""
+    num_classes: int
+    widths: Sequence[int] = (16, 32, 64)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def double_conv(x, w):
+            for _ in range(2):
+                x = nn.Conv(w, (3, 3), padding="SAME", use_bias=False,
+                            kernel_init=conv_kernel_init)(x)
+                x = nn.relu(Norm("group")(x, train))
+            return x
+
+        skips = []
+        for w in self.widths:
+            x = double_conv(x, w)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = double_conv(x, self.widths[-1] * 2)
+        for w, skip in zip(reversed(self.widths), reversed(skips)):
+            x = nn.ConvTranspose(w, (2, 2), strides=(2, 2))(x)
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = double_conv(x, w)
+        return nn.Conv(self.num_classes, (1, 1))(x)
