@@ -49,6 +49,11 @@ class FedNASConfig:
     lambda_train_regularizer: float = 1.0   # step_v2 λ (main_fednas.py:91)
     grad_clip: float = 5.0        # --grad_clip
     seed: int = 0
+    # Reference parity: FedNASAggregator averages only weights and α; each
+    # client keeps its own optimizer state (momentum / Adam moments) across
+    # rounds.  True = TPU-native deviation that sample-weight-averages the
+    # optimizer states too (shares momentum across the cohort).
+    aggregate_opt_state: bool = False
 
     def __post_init__(self):
         if self.epochs < 1:
@@ -110,9 +115,11 @@ class FedNAS:
                                              (train, valid))
             return carry + (jnp.mean(losses),)
 
-        # all sampled clients search in parallel (vs N MPI processes)
+        # all sampled clients search in parallel (vs N MPI processes);
+        # optimizer states are per-client (stacked on axis 0) — clients keep
+        # their own momentum/Adam moments, as in the reference
         self._cohort_search = jax.jit(jax.vmap(
-            search_round, in_axes=(None, None, None, None, 0, 0)))
+            search_round, in_axes=(None, None, 0, 0, 0, 0)))
 
         def metrics(params, alphas, batch):
             logits = self.model.apply({"params": params}, batch["x"], alphas)
@@ -138,8 +145,16 @@ class FedNAS:
         cfg = self.cfg
         rng = rng if rng is not None else jax.random.key(cfg.seed)
         params, alphas = self.init(rng, train_cohort["x"][0, 0])
-        w_state = self.w_opt.init(params)
-        a_state = self.a_opt.init(alphas)
+        C = train_cohort["x"].shape[0]
+
+        def stack_per_client(t):
+            return jax.tree.map(lambda x: jnp.stack([jnp.asarray(x)] * C), t)
+
+        # one optimizer state PER CLIENT, carried across rounds (the
+        # reference's clients own their optimizers; the server never sees
+        # momentum — FedNASAggregator aggregates only weights and α)
+        w_state = stack_per_client(self.w_opt.init(params))
+        a_state = stack_per_client(self.a_opt.init(alphas))
         history: List[Dict[str, Any]] = []
         weights = train_cohort["num_samples"] if "num_samples" in train_cohort \
             else jnp.sum(train_cohort["mask"], axis=(1, 2))
@@ -158,9 +173,16 @@ class FedNAS:
             wrap = lambda t: tree_weighted_mean({"t": t}, weights)["t"]
             params = tree_weighted_mean(c_params, weights)
             alphas = wrap(c_alphas)
-            # optimizer state mean keeps momentum continuity across rounds
-            w_state = wrap(w_state_c)
-            a_state = wrap(a_state_c)
+            if cfg.aggregate_opt_state:
+                # opt-in deviation: share momentum across the cohort
+                w_state = jax.tree.map(
+                    lambda a, s: jnp.stack([a.astype(s.dtype)] * C),
+                    wrap(w_state_c), w_state_c)
+                a_state = jax.tree.map(
+                    lambda a, s: jnp.stack([a.astype(s.dtype)] * C),
+                    wrap(a_state_c), a_state_c)
+            else:  # reference behavior: clients keep their own states
+                w_state, a_state = w_state_c, a_state_c
             genotype = self.genotype(alphas)
             history.append({"round": rnd,
                             "search_loss": float(jnp.mean(losses)),

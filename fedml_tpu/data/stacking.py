@@ -62,10 +62,13 @@ def stack_client_data(xs: Sequence[Array], ys: Sequence[Array],
         steps = int(np.ceil(max(int(counts.max()), 1) / batch_size))
     cap = steps * batch_size
 
-    x0 = np.asarray(xs[0])
+    # derive shapes/dtypes from the first NON-empty client, so absent users
+    # (LEAF splits missing a user yield shape-(0,) arrays) don't poison the
+    # stacked layout
+    x0 = next((np.asarray(x) for x in xs if len(x)), np.asarray(xs[0]))
     sample_shape = x0.shape[1:]
     x_out = np.zeros((C, steps, batch_size) + sample_shape, dtype=x0.dtype)
-    y0 = np.asarray(ys[0])
+    y0 = next((np.asarray(y) for y in ys if len(y)), np.asarray(ys[0]))
     y_shape = y0.shape[1:]
     y_dtype = y0.dtype
     y_out = np.zeros((C, steps, batch_size) + y_shape, dtype=y_dtype)
@@ -74,6 +77,8 @@ def stack_client_data(xs: Sequence[Array], ys: Sequence[Array],
     clipped = np.minimum(counts, cap)
     for c in range(C):
         n = int(clipped[c])
+        if n == 0:  # empty client: all-zero padding, mask 0, weight 0
+            continue
         x = np.asarray(xs[c])[:n]
         y = np.asarray(ys[c])[:n]
         if rng is not None and n > 1:

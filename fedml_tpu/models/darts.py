@@ -162,8 +162,17 @@ class MixedOp(nn.Module):
 
     @nn.compact
     def __call__(self, x, weights, train=False):
-        outs = [_Op(p, self.C, self.stride, self.norm)(x, train)
-                for p in PRIMITIVES]
+        outs = []
+        for p in PRIMITIVES:
+            o = _Op(p, self.C, self.stride, self.norm)(x, train)
+            if p in ("max_pool_3x3", "avg_pool_3x3"):
+                # SEARCH-only affine-free norm on pool branches so their
+                # magnitude statistics match the normed conv branches during
+                # the α search (model_search.py:17 wraps pools in
+                # BatchNorm2d(C, affine=False)); the discrete eval network
+                # keeps raw pools, as the reference's OPS table does
+                o = Norm(self.norm, affine=False)(o, train)
+            outs.append(o)
         return sum(w * o for w, o in zip(weights, outs))
 
 

@@ -32,6 +32,8 @@ class Norm(nn.Module):
     kind: str = "group"          # "group" | "batch" | "none"
     channels_per_group: int = 32  # norm2d's num_channels_per_group default
     zero_init: bool = False
+    affine: bool = True           # False = no learnable scale/bias
+                                  # (torch norm(..., affine=False))
     axis_name: str | None = None  # set to mesh axis for cross-device BN stats
 
     @nn.compact
@@ -43,12 +45,14 @@ class Norm(nn.Module):
         if self.kind == "batch":
             return nn.BatchNorm(
                 use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                use_scale=self.affine, use_bias=self.affine,
                 scale_init=scale_init, axis_name=self.axis_name)(x)
         channels = x.shape[-1]
         groups = max(1, channels // self.channels_per_group)
         while channels % groups:  # GroupNorm requires groups | channels
             groups -= 1
         return nn.GroupNorm(num_groups=groups, epsilon=1e-5,
+                            use_scale=self.affine, use_bias=self.affine,
                             scale_init=scale_init)(x)
 
 

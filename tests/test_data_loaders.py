@@ -286,3 +286,22 @@ def test_word_vocab_oov_stable_hash(tmp_path):
     import zlib
     expect = zlib.crc32(b"zzz") % 4 + 2 + 3
     assert v.word_id("zzz") == expect
+
+
+def test_stacking_tolerates_empty_clients():
+    """Absent LEAF users yield shape-(0,) arrays; stacking must shape them
+    as zero-sample clients regardless of where they fall in the ordering
+    (round-1 advisor finding: both orderings used to raise)."""
+    from fedml_tpu.data.stacking import stack_client_data
+    full_x = np.ones((6, 4), np.float32)
+    full_y = np.zeros(6, np.int32)
+    empty = np.asarray([], np.float32)
+    for xs, ys in [
+        ([empty, full_x], [empty.astype(np.int32), full_y]),   # empty first
+        ([full_x, empty], [full_y, empty.astype(np.int32)]),   # empty later
+    ]:
+        d = stack_client_data(xs, ys, batch_size=3)
+        assert d["x"].shape[2:] == (3, 4)
+        assert d["num_samples"].tolist() in ([0.0, 6.0], [6.0, 0.0])
+        empty_idx = int(np.argmin(d["num_samples"]))
+        assert d["mask"][empty_idx].sum() == 0.0
