@@ -1,0 +1,6 @@
+"""``python -m fedml_tpu`` entry point (see fedml_tpu/experiments/main.py)."""
+
+from fedml_tpu.experiments.main import main
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ import numpy as np
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.stacking import FederatedData, gather_cohort
 from fedml_tpu.parallel.cohort import make_cohort_step, cohort_eval
+from fedml_tpu.parallel.mesh import stage_global
 from fedml_tpu.trainer.local_sgd import make_local_trainer, make_evaluator
 from fedml_tpu.trainer.workload import Workload, make_client_optimizer
 
@@ -52,16 +53,25 @@ class FedAvgConfig:
 
 class FedAvg:
     def __init__(self, workload: Workload, data: FederatedData,
-                 config: FedAvgConfig, mesh=None):
+                 config: FedAvgConfig, mesh=None, sink=None):
         self.workload = workload
         self.data = data
         self.cfg = config
         self.mesh = mesh
+        self.sink = sink  # optional MetricsSink: per-round wandb-style log
+        if mesh is not None:
+            n_dev = mesh.shape["clients"]
+            if config.client_num_per_round % n_dev:
+                raise ValueError(
+                    f"client_num_per_round={config.client_num_per_round} "
+                    f"must be a multiple of the mesh clients axis ({n_dev})")
         opt = make_client_optimizer(config.client_optimizer, config.lr, config.wd)
         local_train = make_local_trainer(workload, opt, config.epochs)
         self.cohort_step = make_cohort_step(local_train, mesh=mesh)
         self.evaluate = make_evaluator(workload)
-        self._eval_cohort = cohort_eval(self.evaluate, mesh=None)
+        # global eval over ALL clients rides the mesh too (each device
+        # evaluates its shard of clients; metric psum over ICI)
+        self._eval_cohort = cohort_eval(self.evaluate, mesh=mesh)
         self.history: List[Dict[str, Any]] = []
 
     def init_params(self, rng: Optional[jax.Array] = None):
@@ -80,13 +90,19 @@ class FedAvg:
                 lambda v: v[0, 0], {k: self.data.train[k]
                                     for k in ("x", "y", "mask")}))
 
+        from jax.sharding import PartitionSpec as P
+        # multi-process pods: host data must enter the global-mesh jit as
+        # global jax.Arrays (no-op single-process)
+        params = stage_global(params, self.mesh)
         for round_idx in range(cfg.comm_round):
             t0 = time.time()
             ids = sample_clients(round_idx, self.data.client_num,
                                  cfg.client_num_per_round)
             cohort = gather_cohort(self.data.train, ids,
                                    pad_to=cfg.client_num_per_round)
+            cohort = stage_global(cohort, self.mesh, P("clients"))
             rng, round_rng = jax.random.split(rng)
+            round_rng = stage_global(round_rng, self.mesh)
             params, _ = self.cohort_step(params, cohort, round_rng)
             jax.block_until_ready(params)
             round_s = time.time() - t0
@@ -97,17 +113,32 @@ class FedAvg:
                 stats.update(round=round_idx, round_s=round_s)
                 logger.info("round %d: %s", round_idx, stats)
                 self.history.append(stats)
+                if self.sink is not None:
+                    self.sink.log(stats, step=round_idx)
         return params
 
     def evaluate_global(self, params) -> Dict[str, float]:
         """Weighted train/test metrics over ALL clients' shards (parity with
         _local_test_on_all_clients, fedavg_api.py:118-171)."""
+        from jax.sharding import PartitionSpec as P
         out: Dict[str, float] = {}
         for split, stacked in (("train", self.data.train), ("test", self.data.test)):
             if stacked is None:
                 continue
-            m = self._eval_cohort(params, {k: jax.numpy.asarray(v)
-                                           for k, v in stacked.items()})
+            batch = {k: jax.numpy.asarray(v) for k, v in stacked.items()}
+            if self.mesh is not None and jax.process_count() > 1:
+                # cohort_eval pads to the device count internally, but global
+                # staging must happen pre-jit, so pad here first
+                n_dev = self.mesh.shape["clients"]
+                C = batch["num_samples"].shape[0]
+                if C % n_dev:
+                    pad = n_dev - C % n_dev
+                    batch = jax.tree.map(
+                        lambda x: jax.numpy.concatenate(
+                            [x, jax.numpy.zeros((pad,) + x.shape[1:],
+                                                x.dtype)]), batch)
+                batch = stage_global(batch, self.mesh, P("clients"))
+            m = self._eval_cohort(params, batch)
             total = float(m["total"])
             out[f"{split}_acc"] = float(m["correct"]) / max(total, 1.0)
             out[f"{split}_loss"] = float(m["loss_sum"]) / max(total, 1.0)

@@ -31,8 +31,8 @@ class FedAvgRobustConfig(FedAvgConfig):
 class FedAvgRobust(FedAvg):
     DEFENSES = ("norm_diff_clipping", "weak_dp", "none")
 
-    def __init__(self, workload, data, config: FedAvgRobustConfig, mesh=None):
-        super().__init__(workload, data, config, mesh=mesh)
+    def __init__(self, workload, data, config: FedAvgRobustConfig, mesh=None, sink=None):
+        super().__init__(workload, data, config, mesh=mesh, sink=sink)
         cfg = config
         if cfg.defense not in self.DEFENSES:
             raise ValueError(f"unknown defense {cfg.defense!r}; "

@@ -110,8 +110,8 @@ def make_fednova_local_trainer(workload, cfg: FedNovaConfig):
 
 
 class FedNova(FedAvg):
-    def __init__(self, workload, data, config: FedNovaConfig, mesh=None):
-        super().__init__(workload, data, config, mesh=mesh)
+    def __init__(self, workload, data, config: FedNovaConfig, mesh=None, sink=None):
+        super().__init__(workload, data, config, mesh=mesh, sink=sink)
         cfg = config
         local_train = make_fednova_local_trainer(workload, cfg)
         self._gmf_buf = None

@@ -50,8 +50,8 @@ class FedOptConfig(FedAvgConfig):
 class FedOpt(FedAvg):
     """FedAvg + server optimizer on the pseudo-gradient."""
 
-    def __init__(self, workload, data, config: FedOptConfig, mesh=None):
-        super().__init__(workload, data, config, mesh=mesh)
+    def __init__(self, workload, data, config: FedOptConfig, mesh=None, sink=None):
+        super().__init__(workload, data, config, mesh=mesh, sink=sink)
         try:
             factory = SERVER_OPTIMIZERS[config.server_optimizer]
         except KeyError:

@@ -25,8 +25,8 @@ class FedProxConfig(FedAvgConfig):
 
 
 class FedProx(FedAvg):
-    def __init__(self, workload, data, config: FedProxConfig, mesh=None):
-        super().__init__(workload, data, config, mesh=mesh)
+    def __init__(self, workload, data, config: FedProxConfig, mesh=None, sink=None):
+        super().__init__(workload, data, config, mesh=mesh, sink=sink)
         opt = make_client_optimizer(config.client_optimizer, config.lr,
                                     config.wd)
         local_train = make_local_trainer(workload, opt, config.epochs,

@@ -40,8 +40,8 @@ class HierarchicalConfig(FedAvgConfig):
 
 
 class HierarchicalFedAvg(FedAvg):
-    def __init__(self, workload, data, config: HierarchicalConfig, mesh=None):
-        super().__init__(workload, data, config, mesh=mesh)
+    def __init__(self, workload, data, config: HierarchicalConfig, mesh=None, sink=None):
+        super().__init__(workload, data, config, mesh=mesh, sink=sink)
         cfg = config
         if cfg.group_method != "random":
             raise ValueError(f"unknown group_method {cfg.group_method!r}")
@@ -88,4 +88,6 @@ class HierarchicalFedAvg(FedAvg):
                 stats["round"] = global_round
                 self.history.append(stats)
                 logger.info("global round %d: %s", global_round, stats)
+                if self.sink is not None:
+                    self.sink.log(stats, step=global_round)
         return params

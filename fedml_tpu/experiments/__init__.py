@@ -1,0 +1,4 @@
+from fedml_tpu.experiments.config import ExperimentConfig, build_parser
+from fedml_tpu.experiments.main import main, RUNNERS
+
+__all__ = ["ExperimentConfig", "build_parser", "main", "RUNNERS"]

@@ -1,0 +1,68 @@
+"""Model × dataset factory — parity with the reference's ``create_model``
+switch (``fedml_experiments/distributed/fedavg/main_fedavg.py:224-259``).
+
+The reference pairs a model name with a dataset to pick both the
+architecture and the trainer flavor (classification / next-word prediction /
+tag prediction — FedAvgAPI.py:33-39).  Here the same switch returns a
+``Workload`` (model + loss + metrics bundled), so every runner downstream is
+algorithm-generic.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from fedml_tpu.data.stacking import FederatedData
+from fedml_tpu.models import (
+    CNNDropOut, CNNOriginalFedAvg, LogisticRegression, RNNOriginalFedAvg,
+    RNNStackOverflow, efficientnet, mobilenet, mobilenet_v3, resnet18_gn,
+    resnet56, resnet110, vgg11, vgg13, vgg16)
+from fedml_tpu.trainer.workload import (
+    ClassificationWorkload, NWPWorkload, TagPredictionWorkload, Workload)
+
+# next-word/char-prediction datasets -> NWP trainer flavor
+_NWP_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
+
+
+def create_workload(model_name: str, dataset: str, class_num: int,
+                    sample_shape: Sequence[int]) -> Workload:
+    """main_fedavg.py:224-259 switch, flax edition."""
+    if dataset in _NWP_DATASETS:
+        if dataset == "stackoverflow_nwp":
+            model = RNNStackOverflow()          # rnn.py:39-70
+        else:
+            model = RNNOriginalFedAvg(vocab_size=class_num)  # rnn.py:4-36
+        return NWPWorkload(model)
+    if dataset == "stackoverflow_lr":
+        model = LogisticRegression(int(np.prod(sample_shape)), class_num)
+        return TagPredictionWorkload(model)
+
+    input_dim = int(np.prod(sample_shape))
+    small = class_num <= 10
+    factories = {
+        "lr": lambda: LogisticRegression(input_dim, class_num),
+        "cnn": lambda: CNNDropOut(only_digits=small),          # Reddi'20
+        "cnn_fedavg": lambda: CNNOriginalFedAvg(only_digits=small),
+        "resnet56": lambda: resnet56(class_num),
+        "resnet110": lambda: resnet110(class_num),
+        "resnet18_gn": lambda: resnet18_gn(class_num),
+        "mobilenet": lambda: mobilenet(num_classes=class_num),
+        "mobilenet_v3": lambda: mobilenet_v3(num_classes=class_num),
+        "efficientnet": lambda: efficientnet("b0", num_classes=class_num),
+        "vgg11": lambda: vgg11(num_classes=class_num),
+        "vgg13": lambda: vgg13(num_classes=class_num),
+        "vgg16": lambda: vgg16(num_classes=class_num),
+    }
+    if model_name not in factories:
+        raise KeyError(f"unknown model {model_name!r}; "
+                       f"have {sorted(factories)}")
+    # grad-clip 1.0 parity with MyModelTrainer (classification only,
+    # my_model_trainer_classification.py:44)
+    return ClassificationWorkload(factories[model_name](),
+                                  num_classes=class_num, grad_clip_norm=1.0)
+
+
+def sample_shape_of(data: FederatedData) -> tuple:
+    return tuple(data.train["x"].shape[3:])

@@ -113,8 +113,16 @@ def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
         in_specs=(P(), data_spec, P()),
         out_specs=(P(), data_spec))
 
+    n_dev = mesh.shape["clients"]
+
     @jax.jit
     def step(global_params, cohort_data, rng):
+        C = cohort_data["num_samples"].shape[0]
+        if C % n_dev:  # static shape — checked at trace time
+            raise ValueError(
+                f"cohort size {C} not divisible by the mesh clients axis "
+                f"({n_dev}); pad the cohort (gather_cohort pad_to=) to a "
+                f"multiple of the device count")
         return sharded(global_params, cohort_data, rng)
 
     return step
@@ -139,4 +147,18 @@ def cohort_eval(evaluate, mesh: Optional[Mesh] = None):
 
     sharded = jax.shard_map(
         _sharded, mesh=mesh, in_specs=(P(), P("clients")), out_specs=P())
-    return jax.jit(sharded)
+    n_dev = mesh.shape["clients"]
+
+    @jax.jit
+    def padded(params, data):
+        C = next(iter(data.values())).shape[0]
+        if C % n_dev:
+            # pad with zero-mask clients so ANY client count shards; padded
+            # rows contribute nothing to the summed metrics
+            pad = n_dev - C % n_dev
+            data = jax.tree.map(
+                lambda x: jnp.concatenate(
+                    [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]), data)
+        return sharded(params, data)
+
+    return padded

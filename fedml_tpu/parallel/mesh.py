@@ -12,11 +12,11 @@ across slices (two-level mesh axes)."""
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import numpy as np
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def make_mesh(client_axis: Optional[int] = None, model_axis: int = 1,
@@ -39,3 +39,54 @@ def client_axis_size(mesh: Optional[Mesh]) -> int:
     if mesh is None:
         return 1
     return mesh.shape["clients"]
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: int = 1, process_id: int = 0) -> bool:
+    """Multi-host bootstrap — the TPU replacement for ``mpirun -np N
+    -hostfile mpi_host_file`` (run_fedavg_distributed_pytorch.sh:17-21).
+
+    Each host runs the SAME program with its own ``process_id``;
+    `jax.distributed.initialize` wires the pod so `jax.devices()` spans all
+    hosts and collectives ride ICI/DCN.  Returns True when distributed mode
+    was actually initialized (no-op for single-process runs, so the same
+    entry point serves laptop simulation and pod launches)."""
+    if coordinator_address is None or num_processes <= 1:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def stage_global(tree: Any, mesh: Optional[Mesh], spec: Optional[P] = None):
+    """Make host data feedable to a jit over a (possibly multi-process) mesh.
+
+    Single-process: identity — jit accepts host numpy directly.  Multi-
+    process (after `init_distributed`): a device on another host is not
+    addressable, so process-local arrays cannot enter a global-mesh jit;
+    each leaf is rebuilt as a global ``jax.Array`` via
+    ``make_array_from_callback``.  The data-staging contract matches the
+    rest of the framework: every process holds the SAME host-side dataset
+    (the reference ships all data to every MPI rank too, FedAvgAPI.py:60-75)
+    and the callback slices out just the shards this process addresses.
+
+    ``spec=None`` replicates (params / rng keys); ``P("clients")`` shards
+    the leading cohort axis.
+    """
+    if mesh is None or jax.process_count() == 1:
+        return tree
+    sharding = NamedSharding(mesh, spec if spec is not None else P())
+
+    def mk(x):
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            # typed PRNG keys can't round-trip through numpy; globalize the
+            # underlying uint32 data and re-wrap
+            data = mk(np.asarray(jax.random.key_data(x)))
+            return jax.random.wrap_key_data(data)
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sharding,
+                                            lambda idx: x[idx])
+
+    return jax.tree.map(mk, tree)

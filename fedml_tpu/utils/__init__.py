@@ -1,0 +1,3 @@
+from fedml_tpu.utils.metrics import MetricsSink, profiler_trace
+
+__all__ = ["MetricsSink", "profiler_trace"]

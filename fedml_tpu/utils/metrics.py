@@ -1,0 +1,113 @@
+"""Observability: per-round metrics sink + profiler hook.
+
+The reference logs per-round Train/Test acc+loss to wandb on rank 0
+(``fedml_api/distributed/fedavg/FedAVGAggregator.py:136-162``) and its CI
+asserts on the exported ``wandb-summary.json``
+(``CI-script-fedavg.sh:43-48``).  The TPU-native equivalent is dependency-
+free and machine-readable:
+
+* ``metrics.jsonl`` — one JSON object per ``log()`` call (the wandb event
+  stream);
+* ``summary.json`` — last value per key (the wandb summary file the CI
+  reads), rewritten on ``close()``;
+* optional stdout mirroring through stdlib logging.
+
+``profiler_trace(dir)`` wraps ``jax.profiler.trace`` so any run can capture
+an XLA trace with one flag (SURVEY.md §5.1 — the reference has no profiling
+at all; coarse wall-clock prints only, FedAVGAggregator.py:59,85-86).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _jsonable(v: Any) -> Any:
+    """Best-effort scalar coercion (jax/numpy scalars -> python floats)."""
+    try:
+        import numpy as np
+        if isinstance(v, np.generic):
+            return v.item()
+        if hasattr(v, "item") and getattr(v, "ndim", None) == 0:
+            return v.item()
+    except Exception:
+        pass
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
+    return str(v)
+
+
+class MetricsSink:
+    """wandb-style run logger: ``log(dict, step=...)`` appends an event,
+    ``summary`` holds the last value per key, ``close()`` persists
+    ``summary.json``.
+
+    ``run_dir=None`` keeps everything in memory (hermetic tests); the event
+    stream is then available as ``sink.events``.
+    """
+
+    def __init__(self, run_dir: Optional[str] = None, stdout: bool = False,
+                 name: str = "run"):
+        self.run_dir = run_dir
+        self.stdout = stdout
+        self.name = name
+        self.summary: Dict[str, Any] = {}
+        self.events = []
+        self._t0 = time.time()
+        self._fh = None
+        if run_dir is not None:
+            os.makedirs(run_dir, exist_ok=True)
+            self._fh = open(os.path.join(run_dir, "metrics.jsonl"), "a",
+                            buffering=1)
+
+    def log(self, metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+        event = {k: _jsonable(v) for k, v in metrics.items()}
+        if step is not None:
+            event["step"] = int(step)
+        event["_runtime_s"] = round(time.time() - self._t0, 3)
+        self.summary.update(
+            {k: v for k, v in event.items() if not k.startswith("_")})
+        self.events.append(event)
+        if self._fh is not None:
+            self._fh.write(json.dumps(event) + "\n")
+        if self.stdout:
+            logger.info("[%s] %s", self.name, event)
+
+    def close(self) -> None:
+        if self.run_dir is not None:
+            with open(os.path.join(self.run_dir, "summary.json"), "w") as f:
+                json.dump(self.summary, f, indent=2, sort_keys=True)
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+@contextlib.contextmanager
+def profiler_trace(trace_dir: Optional[str]):
+    """Capture a jax/XLA profiler trace into ``trace_dir`` (viewable with
+    tensorboard/perfetto).  ``None`` disables tracing with zero overhead."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield
