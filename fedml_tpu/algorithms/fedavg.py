@@ -81,7 +81,41 @@ class FedAvg:
             "mask": self.data.train["mask"]})
         return self.workload.init(rng, sample)
 
-    def run(self, params=None, rng: Optional[jax.Array] = None):
+    # -- checkpoint hooks (overridden by stateful servers, e.g. FedOpt) ----
+    def _extra_state(self):
+        return {}
+
+    def _extra_state_template(self, params):
+        return {}
+
+    def _load_extra_state(self, extra) -> None:
+        pass
+
+    def _ckpt_state(self, params, rng, round_idx):
+        state = {"params": params, "rng": rng, "round": round_idx}
+        extra = self._extra_state()
+        if extra:
+            state["extra"] = extra
+        return state
+
+    def _maybe_resume(self, checkpointer, params, rng):
+        """Restore (params, rng, next round, server state) from the latest
+        round checkpoint, if one exists (SURVEY.md §5.4)."""
+        if checkpointer is None or checkpointer.latest_round() is None:
+            return params, rng, 0
+        template = {"params": params, "rng": rng, "round": 0}
+        extra_t = self._extra_state_template(params)
+        if extra_t:
+            template["extra"] = extra_t
+        state = checkpointer.restore(like=template)
+        if "extra" in state:
+            self._load_extra_state(state["extra"])
+        logger.info("resumed from round %d (%s)", state["round"],
+                    checkpointer.ckpt_dir)
+        return state["params"], state["rng"], int(state["round"]) + 1
+
+    def run(self, params=None, rng: Optional[jax.Array] = None,
+            checkpointer=None):
         cfg = self.cfg
         rng = rng if rng is not None else jax.random.key(cfg.seed)
         if params is None:
@@ -89,12 +123,13 @@ class FedAvg:
             params = self.workload.init(init_rng, jax.tree.map(
                 lambda v: v[0, 0], {k: self.data.train[k]
                                     for k in ("x", "y", "mask")}))
+        params, rng, start_round = self._maybe_resume(checkpointer, params, rng)
 
         from jax.sharding import PartitionSpec as P
         # multi-process pods: host data must enter the global-mesh jit as
         # global jax.Arrays (no-op single-process)
         params = stage_global(params, self.mesh)
-        for round_idx in range(cfg.comm_round):
+        for round_idx in range(start_round, cfg.comm_round):
             t0 = time.time()
             ids = sample_clients(round_idx, self.data.client_num,
                                  cfg.client_num_per_round)
@@ -115,6 +150,10 @@ class FedAvg:
                 self.history.append(stats)
                 if self.sink is not None:
                     self.sink.log(stats, step=round_idx)
+            if checkpointer is not None:
+                checkpointer.maybe_save(
+                    round_idx, self._ckpt_state(params, rng, round_idx),
+                    last_round=round_idx == cfg.comm_round - 1)
         return params
 
     def evaluate_global(self, params) -> Dict[str, float]:
@@ -129,14 +168,8 @@ class FedAvg:
             if self.mesh is not None and jax.process_count() > 1:
                 # cohort_eval pads to the device count internally, but global
                 # staging must happen pre-jit, so pad here first
-                n_dev = self.mesh.shape["clients"]
-                C = batch["num_samples"].shape[0]
-                if C % n_dev:
-                    pad = n_dev - C % n_dev
-                    batch = jax.tree.map(
-                        lambda x: jax.numpy.concatenate(
-                            [x, jax.numpy.zeros((pad,) + x.shape[1:],
-                                                x.dtype)]), batch)
+                from fedml_tpu.parallel.cohort import pad_clients
+                batch = pad_clients(batch, self.mesh.shape["clients"])
                 batch = stage_global(batch, self.mesh, P("clients"))
             m = self._eval_cohort(params, batch)
             total = float(m["total"])

@@ -182,3 +182,14 @@ class FedNova(FedAvg):
         params, self._gmf_buf = self._nova_step(params, cohort, rng,
                                                 self._gmf_buf)
         return params, {}
+
+    # server momentum buffer rides the round checkpoint (bit-identical
+    # resume contract, utils/checkpoint.py)
+    def _extra_state(self):
+        return {"gmf_buf": self._gmf_buf}
+
+    def _extra_state_template(self, params):
+        return {"gmf_buf": jax.tree.map(jnp.zeros_like, params)}
+
+    def _load_extra_state(self, extra) -> None:
+        self._gmf_buf = extra["gmf_buf"]

@@ -82,3 +82,14 @@ class FedOpt(FedAvg):
         params, metrics, self.server_opt_state = self._fedopt_step(
             params, cohort, rng, self.server_opt_state)
         return params, metrics
+
+    # server optimizer state (momentum / Adam moments) rides the round
+    # checkpoint so a resumed run continues the same trajectory
+    def _extra_state(self):
+        return {"server_opt_state": self.server_opt_state}
+
+    def _extra_state_template(self, params):
+        return {"server_opt_state": self.server_opt.init(params)}
+
+    def _load_extra_state(self, extra) -> None:
+        self.server_opt_state = extra["server_opt_state"]

@@ -54,7 +54,7 @@ class HierarchicalFedAvg(FedAvg):
             groups.setdefault(int(self.group_indexes[cid]), []).append(int(cid))
         return groups
 
-    def run(self, params=None, rng=None):
+    def run(self, params=None, rng=None, checkpointer=None):
         cfg = self.cfg
         rng = rng if rng is not None else jax.random.key(cfg.seed)
         if params is None:
@@ -62,8 +62,12 @@ class HierarchicalFedAvg(FedAvg):
             params = self.workload.init(init_rng, jax.tree.map(
                 lambda v: v[0, 0], {k: self.data.train[k]
                                     for k in ("x", "y", "mask")}))
+        params, rng, start_round = self._maybe_resume(checkpointer, params, rng)
 
-        for global_round in range(cfg.comm_round):
+        from jax.sharding import PartitionSpec as P
+        from fedml_tpu.parallel.mesh import stage_global
+        params = stage_global(params, self.mesh)
+        for global_round in range(start_round, cfg.comm_round):
             ids = sample_clients(global_round, self.data.client_num,
                                  cfg.client_num_per_round)
             groups = self._group_clients(np.asarray(ids))
@@ -73,8 +77,10 @@ class HierarchicalFedAvg(FedAvg):
                 w_group = params
                 cohort = gather_cohort(self.data.train, gids,
                                        pad_to=cfg.client_num_per_round)
+                cohort = stage_global(cohort, self.mesh, P("clients"))
                 for group_round in range(cfg.group_comm_round):
                     rng, rr = jax.random.split(rng)
+                    rr = stage_global(rr, self.mesh)
                     w_group, _ = self.cohort_step(w_group, cohort, rr)
                 group_params.append(w_group)
                 group_weights.append(
@@ -90,4 +96,9 @@ class HierarchicalFedAvg(FedAvg):
                 logger.info("global round %d: %s", global_round, stats)
                 if self.sink is not None:
                     self.sink.log(stats, step=global_round)
+            if checkpointer is not None:
+                checkpointer.maybe_save(
+                    global_round,
+                    self._ckpt_state(params, rng, global_round),
+                    last_round=global_round == cfg.comm_round - 1)
         return params

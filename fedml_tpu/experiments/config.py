@@ -74,6 +74,10 @@ class ExperimentConfig:
     profile_dir: Optional[str] = None    # jax.profiler trace dir
     log_stdout: bool = True
 
+    # ---- checkpoint / resume (orbax round-level, SURVEY §5.4) ----------
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 10
+
 
 def build_parser() -> argparse.ArgumentParser:
     """Argparse surface generated from the dataclass — one flag per field,

@@ -68,13 +68,24 @@ def load_experiment_data(cfg: ExperimentConfig):
 
 def _fedavg_cfg_kwargs(cfg: ExperimentConfig) -> Dict[str, Any]:
     freq = cfg.frequency_of_the_test
-    if cfg.ci:  # CI mode short-circuits eval to the final round
+    if cfg.ci:
+        # CI mode restricts eval to round 0 + the final round (the gate
+        # `round_idx % freq == 0` always fires at 0, reference parity:
+        # FedAVGAggregator.py:126-131 shrinks eval rather than skipping it)
         freq = max(cfg.comm_round, 1)
     return dict(comm_round=cfg.comm_round,
                 client_num_per_round=cfg.client_num_per_round,
                 epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr,
                 client_optimizer=cfg.client_optimizer, wd=cfg.wd,
                 frequency_of_the_test=freq, seed=cfg.seed)
+
+
+def _make_checkpointer(cfg: ExperimentConfig):
+    if not cfg.checkpoint_dir:
+        return None
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+    return RoundCheckpointer(cfg.checkpoint_dir,
+                             save_every=cfg.checkpoint_every)
 
 
 def _eval_global(workload, params, data) -> Dict[str, float]:
@@ -124,7 +135,7 @@ def run_fedavg(cfg, data, mesh, sink):
                          sample_shape_of(data))
     algo = FedAvg(wl, data, FedAvgConfig(**_fedavg_cfg_kwargs(cfg)),
                   mesh=mesh, sink=sink)
-    algo.run()
+    algo.run(checkpointer=_make_checkpointer(cfg))
     return algo.history[-1] if algo.history else {}
 
 
@@ -136,7 +147,7 @@ def run_fedprox(cfg, data, mesh, sink):
     algo = FedProx(wl, data,
                    FedProxConfig(mu=cfg.mu, **_fedavg_cfg_kwargs(cfg)),
                    mesh=mesh, sink=sink)
-    algo.run()
+    algo.run(checkpointer=_make_checkpointer(cfg))
     return algo.history[-1] if algo.history else {}
 
 
@@ -149,7 +160,7 @@ def run_fedopt(cfg, data, mesh, sink):
         server_optimizer=cfg.server_optimizer, server_lr=cfg.server_lr,
         server_momentum=cfg.server_momentum, **_fedavg_cfg_kwargs(cfg)),
         mesh=mesh, sink=sink)
-    algo.run()
+    algo.run(checkpointer=_make_checkpointer(cfg))
     return algo.history[-1] if algo.history else {}
 
 
@@ -161,7 +172,7 @@ def run_fednova(cfg, data, mesh, sink):
     algo = FedNova(wl, data, FedNovaConfig(
         mu=cfg.mu if cfg.mu else 0.0, gmf=cfg.gmf,
         **_fedavg_cfg_kwargs(cfg)), mesh=mesh, sink=sink)
-    algo.run()
+    algo.run(checkpointer=_make_checkpointer(cfg))
     return algo.history[-1] if algo.history else {}
 
 
@@ -174,7 +185,7 @@ def run_fedavg_robust(cfg, data, mesh, sink):
     algo = FedAvgRobust(wl, data, FedAvgRobustConfig(
         norm_bound=cfg.norm_bound, stddev=cfg.stddev,
         **_fedavg_cfg_kwargs(cfg)), mesh=mesh, sink=sink)
-    algo.run()
+    algo.run(checkpointer=_make_checkpointer(cfg))
     return algo.history[-1] if algo.history else {}
 
 
@@ -187,7 +198,7 @@ def run_hierarchical(cfg, data, mesh, sink):
     algo = HierarchicalFedAvg(wl, data, HierarchicalConfig(
         group_num=cfg.group_num, group_comm_round=cfg.group_comm_round,
         **_fedavg_cfg_kwargs(cfg)), mesh=mesh, sink=sink)
-    algo.run()
+    algo.run(checkpointer=_make_checkpointer(cfg))
     return algo.history[-1] if algo.history else {}
 
 
@@ -377,7 +388,7 @@ def run_fedseg(cfg, data, mesh, sink):
                               num_classes=2)
     algo = FedAvg(wl, seg_data, FedAvgConfig(**_fedavg_cfg_kwargs(cfg)),
                   mesh=mesh, sink=sink)
-    algo.run()
+    algo.run(checkpointer=_make_checkpointer(cfg))
     return algo.history[-1] if algo.history else {}
 
 
@@ -480,15 +491,23 @@ def main(argv=None) -> Dict[str, Any]:
                 cfg.algo, cfg.model, cfg.dataset, data.client_num,
                 "real" if cfg.data_dir else "synthetic-twin")
 
-    with MetricsSink(cfg.run_dir, stdout=cfg.log_stdout,
+    # multi-host: only process 0 writes run artifacts / prints the summary
+    # (the reference's rank-0-only wandb, main_fedavg.py:288-296); other
+    # processes keep an in-memory sink so runner code is rank-agnostic
+    import jax
+    is_main = jax.process_index() == 0
+    with MetricsSink(cfg.run_dir if is_main else None,
+                     stdout=cfg.log_stdout and is_main,
                      name=cfg.algo) as sink:
         sink.log({"config": dataclasses.asdict(cfg)})
-        with profiler_trace(cfg.profile_dir):
+        with profiler_trace(cfg.profile_dir if is_main else None):
             summary = RUNNERS[cfg.algo](cfg, data, mesh, sink)
         sink.log({"final": summary})
-    print(json.dumps({"algo": cfg.algo, "dataset": cfg.dataset,
-                      "model": cfg.model, **{k: v for k, v in summary.items()
-                                             if isinstance(v, (int, float, str))}}))
+    if is_main:
+        print(json.dumps({"algo": cfg.algo, "dataset": cfg.dataset,
+                          "model": cfg.model,
+                          **{k: v for k, v in summary.items()
+                             if isinstance(v, (int, float, str))}}))
     return summary
 
 

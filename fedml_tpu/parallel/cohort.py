@@ -128,6 +128,19 @@ def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
     return step
 
 
+def pad_clients(data: CohortData, n_dev: int) -> CohortData:
+    """Zero-pad the leading clients axis to a multiple of ``n_dev``; padded
+    rows carry mask 0 / weight 0, so they contribute nothing to training or
+    metrics."""
+    C = next(iter(data.values())).shape[0]
+    if C % n_dev == 0:
+        return data
+    pad = n_dev - C % n_dev
+    return jax.tree.map(
+        lambda x: jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]), data)
+
+
 def cohort_eval(evaluate, mesh: Optional[Mesh] = None):
     """Evaluate a (global) model over a stacked cohort of datasets; returns
     summed metric dicts.  Replaces the server's sequential per-client eval
@@ -151,14 +164,7 @@ def cohort_eval(evaluate, mesh: Optional[Mesh] = None):
 
     @jax.jit
     def padded(params, data):
-        C = next(iter(data.values())).shape[0]
-        if C % n_dev:
-            # pad with zero-mask clients so ANY client count shards; padded
-            # rows contribute nothing to the summed metrics
-            pad = n_dev - C % n_dev
-            data = jax.tree.map(
-                lambda x: jnp.concatenate(
-                    [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]), data)
-        return sharded(params, data)
+        # zero-mask padding so ANY client count shards
+        return sharded(params, pad_clients(data, n_dev))
 
     return padded

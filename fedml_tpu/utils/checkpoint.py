@@ -1,0 +1,96 @@
+"""Round-level checkpoint / resume (orbax) — SURVEY.md §5.4.
+
+The reference has NO checkpointing on the FL path (no torch.save of the
+global model in any aggregator); only the GAN BaseModel saves/loads networks
+(``fedml_api/model/cv/base_model.py:161-178,277-296``) and ResNets can load
+pretrained weights (``cv/resnet.py:202-246``).  Here checkpointing is a
+first-class round-level primitive: the tuple (global params, server
+optimizer state, round idx, RNG key) is saved every N rounds and a resumed
+run continues BIT-IDENTICALLY to an uninterrupted one (tested:
+tests/test_checkpoint.py).
+
+Typed PRNG keys are stored as their uint32 key data (orbax serializes
+ordinary arrays) and re-wrapped on restore.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+Pytree = Any
+
+
+def _pack_keys(tree: Pytree) -> Pytree:
+    """typed PRNG keys -> {"__prng_data__": uint32 array} dicts (orbax
+    serializes only arrays/scalars; keys use the default threefry impl)."""
+    def pack(x):
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(
+                x.dtype, jax.dtypes.prng_key):
+            return {"__prng_data__": np.asarray(jax.random.key_data(x))}
+        return x
+    return jax.tree.map(pack, tree)
+
+
+def _unpack_keys(tree: Pytree) -> Pytree:
+    def is_packed(x):
+        return isinstance(x, dict) and "__prng_data__" in x
+
+    def unpack(x):
+        if is_packed(x):
+            return jax.random.wrap_key_data(x["__prng_data__"])
+        return x
+    return jax.tree.map(unpack, tree, is_leaf=is_packed)
+
+
+class RoundCheckpointer:
+    """Save/restore the federated training state every ``save_every``
+    rounds, keeping ``max_to_keep`` checkpoints."""
+
+    def __init__(self, ckpt_dir: str, save_every: int = 1,
+                 max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+        self.save_every = max(1, int(save_every))
+        self.ckpt_dir = os.path.abspath(ckpt_dir)
+        self._mngr = ocp.CheckpointManager(
+            self.ckpt_dir,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
+        self._ocp = ocp
+
+    def maybe_save(self, round_idx: int, state: Dict[str, Any],
+                   last_round: bool = False) -> bool:
+        if not last_round and (round_idx + 1) % self.save_every:
+            return False
+        self.save(round_idx, state)
+        return True
+
+    def save(self, round_idx: int, state: Dict[str, Any]) -> None:
+        state = _pack_keys(state)
+        self._mngr.save(round_idx,
+                        args=self._ocp.args.StandardSave(state))
+        self._mngr.wait_until_finished()
+
+    def latest_round(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, round_idx: Optional[int] = None,
+                like: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """``like``: a template pytree with the target structure/shapes
+        (e.g. a freshly-initialized state) — lets orbax restore to the exact
+        dtypes/shardings.  Without it, orbax infers from the saved
+        metadata."""
+        step = round_idx if round_idx is not None else self.latest_round()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.ckpt_dir}")
+        if like is not None:
+            restored = self._mngr.restore(
+                step, args=self._ocp.args.StandardRestore(_pack_keys(like)))
+        else:
+            restored = self._mngr.restore(step)
+        return _unpack_keys(restored)
+
+    def close(self) -> None:
+        self._mngr.close()

@@ -1,0 +1,174 @@
+"""Import reference PyTorch checkpoints into flax params.
+
+Parity target: the reference's pretrained-weight loading —
+``fedml_api/model/cv/resnet.py:202-246`` (``torch.load`` a ``{'state_dict':
+...}`` checkpoint, strip the DataParallel ``module.`` prefix, load) and the
+GAN BaseModel save/load (``cv/base_model.py:161-178,277-296``).
+
+Approach: both frameworks create sub-modules in forward/definition order, so
+a torch ``state_dict`` (insertion-ordered) and a flax params tree (dict
+insertion order = creation order) enumerate the SAME sequence of units
+(conv / norm / dense).  The converter zips the two walks, transposing
+layouts (torch conv OIHW -> flax HWIO, dense [out,in] -> [in,out]) and
+routing BatchNorm running stats into the ``batch_stats`` collection.  This
+is structural, not name-based, so it works for any reference model whose
+module order matches its flax re-implementation (ResNets, CNNs, GANs).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+Pytree = Any
+
+
+def strip_module_prefix(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """DataParallel saves keys as ``module.*`` (resnet.py:213-217); strip
+    only the leading prefix (a mid-key 'module.' belongs to a real
+    attribute name)."""
+    return {(k[len("module."):] if k.startswith("module.") else k): v
+            for k, v in state_dict.items()}
+
+
+def load_torch_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    """torch.load -> numpy state_dict (handles the reference's
+    ``{'state_dict': ...}`` wrapper)."""
+    import torch
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    sd = ckpt.get("state_dict", ckpt) if isinstance(ckpt, dict) else ckpt
+    return {k: v.detach().cpu().numpy()
+            for k, v in strip_module_prefix(sd).items()
+            if hasattr(v, "detach")}
+
+
+def _torch_units(sd: Dict[str, np.ndarray]) -> List[Dict[str, np.ndarray]]:
+    """Group consecutive same-prefix entries into per-module units."""
+    units: List[Dict[str, np.ndarray]] = []
+    prev_prefix = None
+    for k, v in sd.items():
+        if k.endswith("num_batches_tracked"):
+            continue
+        prefix, name = k.rsplit(".", 1) if "." in k else ("", k)
+        if prefix != prev_prefix:
+            units.append({})
+            prev_prefix = prefix
+        units[-1][name] = np.asarray(v)
+    return units
+
+
+_TYPE_RANK = {"Conv": 0, "ConvTranspose": 0, "Norm": 1}
+
+
+def _elem_key(name: str):
+    """Reconstruct creation order from flax auto-names (the params dict is
+    ALPHABETICALLY sorted, so 'Bottleneck_0' would sort before the stem
+    'Conv_0').  Within one module the torch-mirroring nets here create
+    Conv_i immediately followed by Norm_i, with container blocks after the
+    stem and explicitly-named heads ('fc') last — so order by (index,
+    conv<norm<container), non-indexed names last.  Any model where this
+    heuristic misfires fails the count/shape validation loudly."""
+    prefix, _, idx = name.rpartition("_")
+    if prefix and idx.isdigit():
+        return (0, int(idx), _TYPE_RANK.get(prefix, 2), prefix)
+    return (1, 0, 0, name)
+
+
+def _path_key(path: Tuple[str, ...]):
+    return tuple(_elem_key(p) for p in path)
+
+
+def _flax_units(params: Pytree, path: Tuple[str, ...] = ()
+                ) -> List[Tuple[Tuple[str, ...], Dict]]:
+    """Leaf modules (dicts holding 'kernel' or 'scale'/'bias') in creation
+    order (see _elem_key)."""
+    out = []
+    if isinstance(params, dict):
+        if "kernel" in params or "scale" in params or (
+                set(params) <= {"bias"} and params):
+            return [(path, params)]
+        for k, v in params.items():
+            out.extend(_flax_units(v, path + (k,)))
+        if not path:  # sort once, at the root
+            out.sort(key=lambda pu: _path_key(pu[0]))
+    return out
+
+
+def _get_path(tree: Pytree, path: Tuple[str, ...]):
+    for p in path:
+        if not isinstance(tree, dict) or p not in tree:
+            return None
+        tree = tree[p]
+    return tree
+
+
+def import_torch_state_dict(variables: Pytree,
+                            state_dict: Dict[str, np.ndarray]) -> Pytree:
+    """Fill a flax variables dict (``{"params": ..., "batch_stats": ...}``
+    or bare params) from an ordered torch state_dict.  Returns a new tree;
+    raises on any unit-count or shape mismatch (silent partial loads are
+    how wrong-checkpoint bugs hide)."""
+    import jax
+
+    full = "params" in variables
+    params = jax.tree.map(np.asarray, variables["params"] if full
+                          else variables)
+    stats = jax.tree.map(np.asarray, variables.get("batch_stats", {})) \
+        if full else {}
+
+    t_units = _torch_units(state_dict)
+    f_units = _flax_units(params)
+    if len(t_units) != len(f_units):
+        raise ValueError(
+            f"unit count mismatch: torch has {len(t_units)} modules, flax "
+            f"has {len(f_units)} — architectures differ")
+
+    for (path, leaf), tu in zip(f_units, t_units):
+        where = "/".join(path)
+        if "kernel" in leaf:
+            w = tu.get("weight")
+            if w is None:
+                raise ValueError(f"{where}: torch unit has no weight")
+            if leaf["kernel"].ndim == 4:          # conv OIHW -> HWIO
+                w = w.transpose(2, 3, 1, 0)
+            elif leaf["kernel"].ndim == 2:        # dense [out,in] -> [in,out]
+                w = w.T
+            if w.shape != leaf["kernel"].shape:
+                raise ValueError(f"{where}: kernel shape {leaf['kernel'].shape}"
+                                 f" vs torch {w.shape}")
+            leaf["kernel"] = w.astype(leaf["kernel"].dtype)
+            if "bias" in leaf and "bias" in tu:
+                leaf["bias"] = tu["bias"].astype(leaf["bias"].dtype)
+        else:                                     # norm affine
+            if "scale" in leaf and "weight" in tu:
+                if tu["weight"].shape != leaf["scale"].shape:
+                    raise ValueError(f"{where}: scale shape mismatch")
+                leaf["scale"] = tu["weight"].astype(leaf["scale"].dtype)
+            if "bias" in leaf and "bias" in tu:
+                leaf["bias"] = tu["bias"].astype(leaf["bias"].dtype)
+            if "running_mean" in tu:
+                st = _get_path(stats, path)
+                if st is not None:
+                    st["mean"] = tu["running_mean"].astype(st["mean"].dtype)
+                    st["var"] = tu["running_var"].astype(st["var"].dtype)
+
+    out = {"params": params, **({"batch_stats": stats} if stats else {})} \
+        if full else params
+    return jax.tree.map(lambda x: x, out)  # fresh copy
+
+
+def load_pretrained_resnet(path: str, depth: int = 56,
+                           num_classes: int = 10) -> Tuple[Any, Pytree]:
+    """``resnet56(class_num, pretrained=True, path=...)`` parity
+    (resnet.py:202-222): returns (flax model, variables) with the torch
+    checkpoint's weights, BatchNorm running stats included."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.models import resnet56, resnet110
+    model = (resnet56 if depth == 56 else resnet110)(num_classes,
+                                                     norm="batch")
+    dummy = jnp.zeros((1, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), dummy)
+    return model, import_torch_state_dict(
+        dict(variables), load_torch_checkpoint(path))

@@ -1,0 +1,218 @@
+"""Checkpoint/resume (orbax) + torch pretrained import tests.
+
+Kill-and-resume contract: a run interrupted at round k and resumed from its
+checkpoint must be BIT-IDENTICAL to the uninterrupted run (VERDICT r1 #5) —
+params, server optimizer state, round index, and RNG key all round-trip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.algorithms.fedopt import FedOpt, FedOptConfig
+from fedml_tpu.data.synthetic import synthetic_federated_dataset
+from fedml_tpu.models import LogisticRegression
+from fedml_tpu.trainer.workload import ClassificationWorkload
+from fedml_tpu.utils.checkpoint import (RoundCheckpointer, _pack_keys,
+                                        _unpack_keys)
+
+
+def _setup():
+    data = synthetic_federated_dataset(num_clients=8, samples_per_client=12,
+                                       sample_shape=(6,), class_num=3,
+                                       batch_size=4)
+    wl = ClassificationWorkload(LogisticRegression(6, 3), num_classes=3,
+                                grad_clip_norm=None)
+    return wl, data
+
+
+def _assert_trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def _kwargs(rounds):
+    return dict(comm_round=rounds, client_num_per_round=4, epochs=1,
+                batch_size=4, lr=0.1, frequency_of_the_test=100, seed=0)
+
+
+def test_prng_key_pack_roundtrip():
+    key = jax.random.key(42)
+    tree = {"rng": key, "x": jnp.ones(3)}
+    packed = _pack_keys(tree)
+    assert isinstance(packed["rng"], dict) and "__prng_data__" in packed["rng"]
+    restored = _unpack_keys(packed)
+    assert jnp.all(jax.random.key_data(restored["rng"])
+                   == jax.random.key_data(key))
+
+
+def test_fedavg_kill_and_resume_bit_identical(tmp_path):
+    wl, data = _setup()
+    # uninterrupted 4-round run
+    straight = FedAvg(wl, data, FedAvgConfig(**_kwargs(4))).run()
+
+    # interrupted: 2 rounds with checkpointing, then a FRESH object resumes
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1)
+    FedAvg(wl, data, FedAvgConfig(**_kwargs(2))).run(checkpointer=ck)
+    assert ck.latest_round() == 1
+    resumed = FedAvg(wl, data, FedAvgConfig(**_kwargs(4))).run(
+        checkpointer=ck)
+    _assert_trees_equal(straight, resumed)
+
+
+def test_fedopt_resume_preserves_server_momentum(tmp_path):
+    wl, data = _setup()
+    cfg = dict(server_optimizer="sgd", server_lr=0.5, server_momentum=0.9)
+    straight = FedOpt(wl, data, FedOptConfig(**cfg, **_kwargs(4))).run()
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1)
+    FedOpt(wl, data, FedOptConfig(**cfg, **_kwargs(2))).run(checkpointer=ck)
+    resumed = FedOpt(wl, data, FedOptConfig(**cfg, **_kwargs(4))).run(
+        checkpointer=ck)
+    # with momentum 0.9 any server-state loss would diverge immediately;
+    # bit-equality proves the optimizer state rode the checkpoint
+    _assert_trees_equal(straight, resumed)
+
+
+def test_fednova_resume_preserves_gmf_buffer(tmp_path):
+    from fedml_tpu.algorithms.fednova import FedNova, FedNovaConfig
+    wl, data = _setup()
+    cfg = dict(gmf=0.9)
+    straight = FedNova(wl, data, FedNovaConfig(**cfg, **_kwargs(4))).run()
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1)
+    FedNova(wl, data, FedNovaConfig(**cfg, **_kwargs(2))).run(checkpointer=ck)
+    resumed = FedNova(wl, data, FedNovaConfig(**cfg, **_kwargs(4))).run(
+        checkpointer=ck)
+    _assert_trees_equal(straight, resumed)
+
+
+def test_save_every_gating(tmp_path):
+    wl, data = _setup()
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=3)
+    FedAvg(wl, data, FedAvgConfig(**_kwargs(4))).run(checkpointer=ck)
+    # rounds saved: idx 2 (every 3rd) and 3 (last round)
+    assert ck.latest_round() == 3
+
+
+def test_cli_checkpoint_flag(tmp_path):
+    from fedml_tpu.experiments.main import main
+    argv = ["--algo", "fedavg", "--model", "lr", "--dataset", "mnist",
+            "--client_num_in_total", "8", "--client_num_per_round", "4",
+            "--batch_size", "4", "--comm_round", "2", "--log_stdout",
+            "false", "--checkpoint_dir", str(tmp_path / "ck"),
+            "--checkpoint_every", "1"]
+    main(argv)
+    ck = RoundCheckpointer(str(tmp_path / "ck"))
+    assert ck.latest_round() == 1
+    # resume continues (round 2..3 of a 4-round config); fresh handle —
+    # CheckpointManager instances cache their step list
+    main([a if a != "2" else "4" for a in argv])
+    assert RoundCheckpointer(str(tmp_path / "ck")).latest_round() == 3
+
+
+# ---------------------------------------------------------------------------
+# torch pretrained import (resnet.py:202-246 parity)
+# ---------------------------------------------------------------------------
+
+def _torch_cifar_resnet(layers=(1, 1, 1), num_classes=10):
+    """Reference-shaped torch CIFAR ResNet (Bottleneck, 16/32/64 stages) —
+    built here only to produce a structurally-faithful state_dict."""
+    torch = pytest.importorskip("torch")
+    nn = torch.nn
+
+    class Bottleneck(nn.Module):
+        expansion = 4
+
+        def __init__(self, inplanes, planes, stride=1, downsample=None):
+            super().__init__()
+            self.conv1 = nn.Conv2d(inplanes, planes, 1, bias=False)
+            self.bn1 = nn.BatchNorm2d(planes)
+            self.conv2 = nn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+            self.bn2 = nn.BatchNorm2d(planes)
+            self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+            self.bn3 = nn.BatchNorm2d(planes * 4)
+            self.downsample = downsample
+
+        def forward(self, x):
+            identity = x
+            out = torch.relu(self.bn1(self.conv1(x)))
+            out = torch.relu(self.bn2(self.conv2(out)))
+            out = self.bn3(self.conv3(out))
+            if self.downsample is not None:
+                identity = self.downsample(x)
+            return torch.relu(out + identity)
+
+    class Net(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = nn.Conv2d(3, 16, 3, padding=1, bias=False)
+            self.bn1 = nn.BatchNorm2d(16)
+            inplanes = 16
+            for s, (planes, n) in enumerate(zip((16, 32, 64), layers)):
+                blocks = []
+                for i in range(n):
+                    stride = 2 if (s > 0 and i == 0) else 1
+                    down = None
+                    if stride != 1 or inplanes != planes * 4:
+                        down = nn.Sequential(
+                            nn.Conv2d(inplanes, planes * 4, 1, stride,
+                                      bias=False),
+                            nn.BatchNorm2d(planes * 4))
+                    blocks.append(Bottleneck(inplanes, planes, stride, down))
+                    inplanes = planes * 4
+                setattr(self, f"layer{s + 1}", nn.Sequential(*blocks))
+            self.fc = nn.Linear(64 * 4, num_classes)
+
+        def forward(self, x):
+            x = torch.relu(self.bn1(self.conv1(x)))
+            x = self.layer3(self.layer2(self.layer1(x)))
+            x = x.mean(dim=(2, 3))
+            return self.fc(x)
+
+    return Net()
+
+
+def test_torch_resnet_import_forward_parity(tmp_path):
+    """Import a torch CIFAR-ResNet checkpoint and verify the flax model
+    produces the SAME logits (33x33 input keeps XLA SAME padding symmetric,
+    matching torch's pad=1 on strided convs)."""
+    torch = pytest.importorskip("torch")
+    from fedml_tpu.models.resnet import CifarResNet
+    from fedml_tpu.utils.torch_import import (import_torch_state_dict,
+                                              load_torch_checkpoint)
+
+    torch.manual_seed(0)
+    tnet = _torch_cifar_resnet(layers=(1, 1, 1))
+    tnet.eval()
+    # reference checkpoint format: {'state_dict': ...} with module. prefix
+    sd = {"module." + k: v for k, v in tnet.state_dict().items()}
+    path = str(tmp_path / "ckpt.pth")
+    torch.save({"state_dict": sd}, path)
+
+    model = CifarResNet(layers=(1, 1, 1), num_classes=10, norm="batch")
+    x = np.random.RandomState(0).randn(2, 33, 33, 3).astype(np.float32)
+    variables = model.init(jax.random.key(0), jnp.asarray(x))
+    variables = import_torch_state_dict(dict(variables),
+                                        load_torch_checkpoint(path))
+
+    flax_out = model.apply(variables, jnp.asarray(x), train=False)
+    with torch.no_grad():
+        torch_out = tnet(torch.from_numpy(x).permute(0, 3, 1, 2)).numpy()
+    np.testing.assert_allclose(np.asarray(flax_out), torch_out,
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_import_rejects_architecture_mismatch(tmp_path):
+    torch = pytest.importorskip("torch")
+    from fedml_tpu.models.resnet import CifarResNet
+    from fedml_tpu.utils.torch_import import import_torch_state_dict
+
+    tnet = _torch_cifar_resnet(layers=(1, 1, 1))
+    sd = {k: v.numpy() for k, v in tnet.state_dict().items()}
+    model = CifarResNet(layers=(2, 2, 2), num_classes=10, norm="batch")
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)))
+    with pytest.raises(ValueError, match="unit count"):
+        import_torch_state_dict(dict(variables), sd)

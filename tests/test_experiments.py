@@ -76,10 +76,16 @@ def test_cli_mesh_equals_single_chip(devices):
                                rtol=1e-5)
 
 
-def test_cli_ci_mode_defers_eval():
+def test_cli_ci_mode_restricts_eval(tmp_path):
+    run_dir = str(tmp_path / "ci")
     summary = main(["--algo", "fedavg", "--model", "lr", "--dataset",
-                    "mnist", "--ci", "1"] + _BASE)
-    assert summary["round"] == 1  # only the final round evaluated
+                    "mnist", "--comm_round", "6", "--ci", "1",
+                    "--run_dir", run_dir] + _BASE[:4] + _BASE[8:])
+    assert summary["round"] == 5
+    events = [json.loads(l) for l in
+              open(os.path.join(run_dir, "metrics.jsonl"))]
+    evaluated = [e["round"] for e in events if "train_acc" in e]
+    assert evaluated == [0, 5]  # round 0 + final only
 
 
 @pytest.mark.parametrize("algo", ["fedopt", "centralized", "vfl"])
