@@ -28,7 +28,8 @@ import numpy as np
 
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.stacking import FederatedData, gather_cohort
-from fedml_tpu.parallel.cohort import make_cohort_step, cohort_eval
+from fedml_tpu.parallel.cohort import (make_cohort_step, make_device_round,
+                                       cohort_eval)
 from fedml_tpu.parallel.mesh import stage_global
 from fedml_tpu.trainer.local_sgd import make_local_trainer, make_evaluator
 from fedml_tpu.trainer.workload import Workload, make_client_optimizer
@@ -67,7 +68,14 @@ class FedAvg:
                     f"must be a multiple of the mesh clients axis ({n_dev})")
         opt = make_client_optimizer(config.client_optimizer, config.lr, config.wd)
         local_train = make_local_trainer(workload, opt, config.epochs)
+        self._local_train = local_train
         self.cohort_step = make_cohort_step(local_train, mesh=mesh)
+        self._base_cohort_step = self.cohort_step  # fast-path eligibility
+        # single-chip fast path: dataset resident in HBM, cohort gathered
+        # by ids inside the jit (see make_device_round); built lazily on
+        # first run, only when the stacked data fits on device
+        self._device_round = None
+        self._train_dev = None
         self.evaluate = make_evaluator(workload)
         # global eval over ALL clients rides the mesh too (each device
         # evaluates its shard of clients; metric psum over ICI)
@@ -129,16 +137,32 @@ class FedAvg:
         # multi-process pods: host data must enter the global-mesh jit as
         # global jax.Arrays (no-op single-process)
         params = stage_global(params, self.mesh)
+        # the HBM-resident fast path only serves the BASE cohort step —
+        # subclasses (FedOpt/FedNova/FedProx/Robust) replace cohort_step
+        # with their own server logic, which must not be bypassed
+        use_device_data = (self.mesh is None
+                           and self.cohort_step is self._base_cohort_step
+                           and self._stage_train_on_device())
         for round_idx in range(start_round, cfg.comm_round):
             t0 = time.time()
             ids = sample_clients(round_idx, self.data.client_num,
                                  cfg.client_num_per_round)
-            cohort = gather_cohort(self.data.train, ids,
-                                   pad_to=cfg.client_num_per_round)
-            cohort = stage_global(cohort, self.mesh, P("clients"))
             rng, round_rng = jax.random.split(rng)
-            round_rng = stage_global(round_rng, self.mesh)
-            params, _ = self.cohort_step(params, cohort, round_rng)
+            if use_device_data:
+                m = cfg.client_num_per_round
+                live = np.ones(m, np.float32)
+                live[len(ids):] = 0.0
+                padded_ids = np.zeros(m, np.int32)
+                padded_ids[:len(ids)] = ids
+                params, _ = self._device_round(
+                    params, self._train_dev, jax.numpy.asarray(padded_ids),
+                    jax.numpy.asarray(live), round_rng)
+            else:
+                cohort = gather_cohort(self.data.train, ids,
+                                       pad_to=cfg.client_num_per_round)
+                cohort = stage_global(cohort, self.mesh, P("clients"))
+                round_rng = stage_global(round_rng, self.mesh)
+                params, _ = self.cohort_step(params, cohort, round_rng)
             jax.block_until_ready(params)
             round_s = time.time() - t0
 
@@ -155,6 +179,28 @@ class FedAvg:
                     round_idx, self._ckpt_state(params, rng, round_idx),
                     last_round=round_idx == cfg.comm_round - 1)
         return params
+
+    def _stage_train_on_device(self, budget_bytes: Optional[int] = None
+                               ) -> bool:
+        """Upload the stacked train set to HBM once (returns False when it
+        exceeds the budget — 4 GiB default, FEDML_TPU_DEVICE_DATA_BYTES to
+        override — falling back to per-round host gather)."""
+        if self._train_dev is not None:
+            return True
+        import os
+        budget = budget_bytes if budget_bytes is not None else int(
+            os.environ.get("FEDML_TPU_DEVICE_DATA_BYTES", str(4 << 30)))
+        nbytes = sum(np.asarray(v).nbytes for v in self.data.train.values())
+        if nbytes > budget:
+            logger.info("train set %.1f MB > device budget; using host "
+                        "gather", nbytes / 1e6)
+            return False
+        if self._device_round is None:
+            self._device_round = make_device_round(
+                self._local_train, self.cfg.client_num_per_round)
+        self._train_dev = {k: jax.numpy.asarray(v)
+                           for k, v in self.data.train.items()}
+        return True
 
     def evaluate_global(self, params) -> Dict[str, float]:
         """Weighted train/test metrics over ALL clients' shards (parity with

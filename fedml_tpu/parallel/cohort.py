@@ -128,6 +128,37 @@ def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
     return step
 
 
+def make_device_round(local_train, clients_per_round: int,
+                      aggregate=tree_weighted_mean, transform_update=None):
+    """Fully-on-device round: the ENTIRE stacked dataset lives in HBM and
+    the sampled cohort is gathered by ids INSIDE the jit — zero per-round
+    host<->device traffic (only the [m] ids array crosses).
+
+    This is the TPU answer to SURVEY.md hard part (f): the reference's
+    "process k plays sampled client i" re-pointing (FedAVGTrainer.py:25-29)
+    becomes one XLA gather.  At large cohorts the host-gather path
+    (gather_cohort + re-upload) is bandwidth-bound and collapses — see
+    BENCH_DETAILS.json cohort_scaling; this path keeps the chip fed.
+
+    Returns ``round_fn(params, stacked_dev, ids, live, rng)`` where
+    ``stacked_dev`` is the device-resident ``{x, y, mask, num_samples}``
+    tree, ``ids`` an int32[m] cohort (padded with any valid id), and
+    ``live`` a float32[m] 1/0 mask of real (non-padding) cohort slots.
+    """
+
+    @jax.jit
+    def round_fn(params, stacked, ids, live, rng):
+        cohort = jax.tree.map(lambda v: jnp.take(v, ids, axis=0), stacked)
+        cohort["mask"] = cohort["mask"] * live[:, None, None]
+        cohort["num_samples"] = cohort["num_samples"] * live
+        stacked_out, metrics = train_cohort(
+            local_train, params, cohort, rng,
+            transform_update=transform_update)
+        return aggregate(stacked_out, cohort["num_samples"]), metrics
+
+    return round_fn
+
+
 def pad_clients(data: CohortData, n_dev: int) -> CohortData:
     """Zero-pad the leading clients axis to a multiple of ``n_dev``; padded
     rows carry mask 0 / weight 0, so they contribute nothing to training or

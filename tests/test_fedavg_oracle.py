@@ -172,3 +172,71 @@ def test_adam_optimizer_path(workload):
     p_empty, _ = local(params, empty, jax.random.key(3))
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=0, atol=0),
                  p_empty, params)
+
+
+def test_device_round_equals_host_gather():
+    """The HBM-resident in-jit gather round (make_device_round) must equal
+    the host-gather cohort step bit-for-bit, including weight-0 padding."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.core.sampling import sample_clients
+    from fedml_tpu.data.stacking import gather_cohort, stack_client_data
+    from fedml_tpu.models import LogisticRegression
+    from fedml_tpu.parallel.cohort import make_cohort_step, make_device_round
+    from fedml_tpu.trainer.local_sgd import make_local_trainer
+    from fedml_tpu.trainer.workload import (ClassificationWorkload,
+                                            make_client_optimizer)
+
+    rng = np.random.RandomState(3)
+    xs = [rng.randn(rng.randint(4, 12), 6).astype(np.float32)
+          for _ in range(9)]
+    ys = [rng.randint(0, 3, len(x)).astype(np.int32) for x in xs]
+    stacked = stack_client_data(xs, ys, batch_size=4)
+    wl = ClassificationWorkload(LogisticRegression(6, 3), num_classes=3,
+                                grad_clip_norm=None)
+    local = make_local_trainer(wl, make_client_optimizer("sgd", 0.1), 1)
+    step = make_cohort_step(local)
+    m = 4
+    round_fn = make_device_round(local, m)
+    params = wl.init(jax.random.key(0), jax.tree.map(
+        lambda v: jnp.asarray(v[0, 0]),
+        {k: stacked[k] for k in ("x", "y", "mask")}))
+    stacked_dev = {k: jnp.asarray(v) for k, v in stacked.items()}
+
+    for rnd in range(3):
+        ids = sample_clients(rnd, 9, m)[:3]  # 3 live + 1 padded slot
+        key = jax.random.key(rnd)
+        host_cohort = gather_cohort(stacked, ids, pad_to=m)
+        p_host, _ = step(params, host_cohort, key)
+        padded_ids = np.zeros(m, np.int32)
+        padded_ids[:3] = ids
+        live = jnp.asarray([1.0, 1.0, 1.0, 0.0])
+        p_dev, _ = round_fn(params, stacked_dev, jnp.asarray(padded_ids),
+                            live, key)
+        for a, b in zip(jax.tree.leaves(p_host), jax.tree.leaves(p_dev)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        params = p_dev
+
+
+def test_fedavg_device_path_matches_host_path():
+    """FedAvg.run with the device-resident fast path == forced host gather."""
+    from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+    from fedml_tpu.data.synthetic import synthetic_federated_dataset
+    from fedml_tpu.models import LogisticRegression
+    from fedml_tpu.trainer.workload import ClassificationWorkload
+
+    data = synthetic_federated_dataset(num_clients=9, samples_per_client=10,
+                                       sample_shape=(6,), class_num=3,
+                                       batch_size=4)
+    wl = ClassificationWorkload(LogisticRegression(6, 3), num_classes=3,
+                                grad_clip_norm=None)
+    cfg = FedAvgConfig(comm_round=3, client_num_per_round=4, batch_size=4,
+                       lr=0.1, frequency_of_the_test=100, seed=0)
+    fast_algo = FedAvg(wl, data, cfg)
+    fast = fast_algo.run()
+    assert fast_algo._train_dev is not None  # fast path actually engaged
+    slow_algo = FedAvg(wl, data, cfg)
+    slow_algo._stage_train_on_device = lambda *a, **k: False  # force host
+    slow = slow_algo.run()
+    for a, b in zip(jax.tree.leaves(fast), jax.tree.leaves(slow)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
