@@ -7,6 +7,9 @@ from fedml_tpu.algorithms.fedavg_robust import FedAvgRobust, FedAvgRobustConfig
 from fedml_tpu.algorithms.decentralized import (
     DecentralizedGossip, DecentralizedConfig,
 )
+from fedml_tpu.algorithms.decentralized_online import (
+    DecentralizedOnline, DecentralizedOnlineConfig, run_decentralized_online,
+)
 from fedml_tpu.algorithms.hierarchical import (
     HierarchicalFedAvg, HierarchicalConfig,
 )

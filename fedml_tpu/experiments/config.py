@@ -56,6 +56,14 @@ class ExperimentConfig:
     group_comm_round: int = 2            # hierarchical
     drop_tolerance: int = 1              # turboaggregate
     neighbor_num: int = 2                # decentralized topology
+    # decentralized online learning (standalone/decentralized main_dol.py)
+    mode: str = "DOL"                    # "DOL" | "PUSHSUM" | "LOCAL"
+    iteration_number: int = 100          # stream length T per client
+    beta: float = 0.0                    # adversarial (kmeans) stream frac
+    b_symmetric: bool = False            # undirected vs directed topology
+    topology_neighbors_num_undirected: int = 4
+    topology_neighbors_num_directed: int = 4
+    time_varying: bool = False           # regenerate graph each iteration
     temperature: float = 3.0             # FedGKT KD temperature
     fednas_layers: int = 3               # DARTS search depth
     fednas_channels: int = 8             # DARTS init channels

@@ -252,6 +252,39 @@ def run_decentralized(cfg, data, mesh, sink):
     return algo.history[-1] if algo.history else {}
 
 
+@runner("decentralized_online")
+def run_decentralized_online(cfg, data, mesh, sink):
+    """DSGD / PushSum online learning on streaming UCI data (standalone/
+    decentralized main_dol.py surface: --mode --iteration_number --beta
+    --b_symmetric --time_varying --topology_neighbors_num_*)."""
+    import os
+    from fedml_tpu.algorithms.decentralized_online import (
+        DecentralizedOnlineConfig, run_decentralized_online as run_dol)
+    from fedml_tpu.data.uci import load_streaming_uci, synthetic_stream
+    n = min(cfg.client_num_in_total, 128)
+    total = cfg.iteration_number * n
+    if cfg.data_dir and cfg.dataset.upper() in ("SUSY", "RO"):
+        path = cfg.data_dir if os.path.isfile(cfg.data_dir) else os.path.join(
+            cfg.data_dir, "SUSY.csv" if cfg.dataset.upper() == "SUSY"
+            else "datatraining.txt")
+        stream = load_streaming_uci(cfg.dataset, path, list(range(n)),
+                                    total, cfg.beta, seed=cfg.seed)
+    else:
+        stream = synthetic_stream(num_clients=n, total=total,
+                                  beta=cfg.beta, seed=cfg.seed)
+    out = run_dol(stream, DecentralizedOnlineConfig(
+        mode=cfg.mode, iteration_number=cfg.iteration_number,
+        epochs=cfg.epochs, learning_rate=cfg.lr, weight_decay=cfg.wd,
+        b_symmetric=cfg.b_symmetric,
+        topology_neighbors_num_undirected=cfg.topology_neighbors_num_undirected,
+        topology_neighbors_num_directed=cfg.topology_neighbors_num_directed,
+        time_varying=cfg.time_varying, seed=cfg.seed))
+    for h in out["history"][:: max(len(out["history"]) // 50, 1)]:
+        sink.log(h, step=h["iteration"])
+    return {"final_regret": out["final_regret"],
+            "accuracy": out["accuracy"]}
+
+
 @runner("turboaggregate")
 def run_turboaggregate(cfg, data, mesh, sink):
     import jax

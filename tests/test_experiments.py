@@ -108,6 +108,8 @@ def test_cli_every_algorithm(algo, tmp_path):
         "asdgan": ["--dataset", "femnist"],
         "fedseg": ["--dataset", "femnist"],
         "hierarchical": ["--group_num", "2", "--group_comm_round", "1"],
+        "decentralized_online": ["--iteration_number", "30", "--lr", "0.3",
+                                 "--wd", "0"],
         "turboaggregate": ["--group_num", "2"],
     }
     argv = (["--algo", algo, "--model", "lr", "--dataset", "mnist"]
