@@ -1,0 +1,134 @@
+"""Backdoor-attack evaluation for robust FL.
+
+Parity with the reference's poisoned-task pipeline
+(``fedml_api/distributed/fedavg_robust/FedAvgRobustAggregator.py``):
+
+* poisoned clients — a fraction of the cohort trains on trigger-stamped,
+  target-relabeled data (the reference mixes externally-downloaded edge-case
+  sets into attacker shards via ``poisoned_train_loader``, :14-45);
+* ``test_target_accuracy`` (:270) — "targetted-task" accuracy: how often the
+  global model emits the attacker's target label on backdoored inputs, the
+  backdoor's success rate;
+* raw-task accuracy stays tracked alongside, so a defense is judged on BOTH
+  axes (kills the backdoor, keeps the main task).
+
+Poison construction is `fedml_tpu.data.edge_case` (pixel triggers, external
+poison pickles); this module wires it into the stacked-cohort data contract
+and provides the targeted evaluation the defense tests assert on.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.data.edge_case import apply_pixel_trigger
+from fedml_tpu.data.stacking import FederatedData
+
+Array = np.ndarray
+
+
+def poison_stacked_clients(train: Dict[str, Array],
+                           attacker_ids: Sequence[int],
+                           target_label: int,
+                           poison_frac: float = 1.0,
+                           trigger_size: int = 3,
+                           value: float = 1.0,
+                           seed: int = 0) -> Dict[str, Array]:
+    """Stamp the pixel trigger + target relabel onto ``poison_frac`` of each
+    attacker's real (masked) samples in a stacked [C, S, B, ...] train dict.
+
+    In-place replacement (not blending) keeps the static stacked shapes —
+    sample counts, masks, and therefore aggregation weights are unchanged,
+    so defended/undefended comparisons differ ONLY in the defense."""
+    x = np.array(train["x"], copy=True)
+    y = np.array(train["y"], copy=True)
+    rng = np.random.RandomState(seed)
+    sample_shape = x.shape[3:]
+    for cid in attacker_ids:
+        flat_x = x[cid].reshape((-1,) + sample_shape)
+        flat_y = y[cid].reshape(-1)
+        real = np.where(train["mask"][cid].reshape(-1) > 0)[0]
+        k = int(round(poison_frac * len(real)))
+        if k == 0:
+            continue
+        sel = rng.choice(real, k, replace=False)
+        px, py = apply_pixel_trigger(flat_x[sel], target_label,
+                                     trigger_size=trigger_size, value=value)
+        flat_x[sel] = px
+        flat_y[sel] = py
+        x[cid] = flat_x.reshape(x[cid].shape)
+        y[cid] = flat_y.reshape(y[cid].shape)
+    return {**train, "x": x, "y": y}
+
+
+def poison_federated_data(data: FederatedData,
+                          attacker_ids: Sequence[int],
+                          target_label: int,
+                          poison_frac: float = 1.0,
+                          trigger_size: int = 3,
+                          value: float = 1.0,
+                          seed: int = 0) -> FederatedData:
+    """FederatedData with the attackers' TRAIN shards backdoored (test data
+    stays clean — raw-task eval must measure the honest task)."""
+    return FederatedData(
+        client_num=data.client_num, class_num=data.class_num,
+        train=poison_stacked_clients(
+            data.train, attacker_ids, target_label, poison_frac,
+            trigger_size, value, seed),
+        test=data.test, train_global=data.train_global,
+        test_global=data.test_global)
+
+
+def make_targeted_test_set(x_clean: Array, y_clean: Array, target_label: int,
+                           trigger_size: int = 3, value: float = 1.0,
+                           exclude_target_class: bool = True
+                           ) -> Dict[str, Array]:
+    """Trigger-stamp clean test images; keep only images whose TRUE label is
+    not already the target (the reference's targetted-task loaders likewise
+    measure flips, not freebies)."""
+    if exclude_target_class:
+        keep = y_clean != target_label
+        x_clean, y_clean = x_clean[keep], y_clean[keep]
+    xt, yt = apply_pixel_trigger(x_clean, target_label,
+                                 trigger_size=trigger_size, value=value)
+    return {"x": xt, "y": yt}
+
+
+def targeted_accuracy(workload, params, targeted: Dict[str, Array],
+                      batch_size: int = 256) -> float:
+    """Backdoor success rate: fraction of targeted-task inputs the model
+    classifies as the attacker's label (test(..., mode="targetted-task"),
+    FedAvgRobustAggregator.py:14-45)."""
+    x = np.asarray(targeted["x"])
+    y = np.asarray(targeted["y"])
+    hits, total = 0, 0
+    for lo in range(0, len(x), batch_size):
+        logits = workload.apply(params, jnp.asarray(x[lo:lo + batch_size]))
+        pred = np.asarray(jnp.argmax(logits, axis=-1))
+        hits += int((pred == y[lo:lo + batch_size]).sum())
+        total += len(pred)
+    return hits / max(total, 1)
+
+
+def evaluate_backdoor(workload, params, targeted: Dict[str, Array],
+                      clean: Optional[Dict[str, Array]] = None
+                      ) -> Dict[str, float]:
+    """The two-axis report: backdoor success + (optionally) raw-task
+    accuracy on a clean stacked eval set."""
+    out = {"backdoor_acc": targeted_accuracy(workload, params, targeted)}
+    if clean is not None:
+        # accept one batch [B, ...] or a batch stack [S, B, ...]
+        x, y, m = (np.asarray(clean[k]) for k in ("x", "y", "mask"))
+        if m.ndim == 2:
+            x = x.reshape((-1,) + x.shape[2:])
+            y = y.reshape(-1)
+            m = m.reshape(-1)
+        metrics = jax.jit(workload.metric_fn)(params, {
+            "x": jnp.asarray(x), "y": jnp.asarray(y), "mask": jnp.asarray(m)})
+        out["raw_task_acc"] = (float(metrics["correct"])
+                               / max(float(metrics["total"]), 1.0))
+    return out

@@ -52,6 +52,14 @@ class ExperimentConfig:
     gmf: float = 0.0                     # FedNova global momentum factor
     norm_bound: float = 5.0              # robust: clip threshold
     stddev: float = 0.025                # robust: weak-DP noise
+    defense: str = "weak_dp"             # robust: defense type | "none"
+    # robust: backdoor attack evaluation (poison_type pipeline,
+    # FedAvgRobustAggregator.py:14-45, 270)
+    backdoor: bool = False               # poison attacker shards + eval
+    attacker_num: int = 1                # first K clients are attackers
+    target_label: int = 9                # attack target ("truck" for cifar)
+    poison_frac: float = 1.0             # fraction of attacker shard stamped
+    trigger_size: int = 3                # pixel-trigger side length
     group_num: int = 2                   # hierarchical / turboaggregate
     group_comm_round: int = 2            # hierarchical
     drop_tolerance: int = 1              # turboaggregate

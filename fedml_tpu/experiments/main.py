@@ -182,11 +182,38 @@ def run_fedavg_robust(cfg, data, mesh, sink):
                                                     FedAvgRobustConfig)
     wl = create_workload(cfg.model, cfg.dataset, data.class_num,
                          sample_shape_of(data))
+    targeted = None
+    if cfg.backdoor:
+        # poison the first K clients' shards + track targeted-task accuracy
+        # (FedAvgRobustAggregator.test_target_accuracy:270)
+        from fedml_tpu.algorithms.backdoor import (make_targeted_test_set,
+                                                   poison_federated_data)
+        shape = _image_sample_shape(cfg, data, "fedavg_robust --backdoor")
+        del shape
+        attackers = list(range(min(cfg.attacker_num, data.client_num)))
+        eval_src = data.test if data.test is not None else data.train
+        honest = np.arange(len(attackers), data.client_num)
+        x_eval = np.asarray(eval_src["x"])[honest]
+        y_eval = np.asarray(eval_src["y"])[honest]
+        m_eval = np.asarray(eval_src["mask"])[honest].reshape(-1) > 0
+        x_eval = x_eval.reshape((-1,) + x_eval.shape[3:])[m_eval]
+        y_eval = y_eval.reshape(-1)[m_eval]
+        targeted = make_targeted_test_set(
+            x_eval, y_eval, cfg.target_label, trigger_size=cfg.trigger_size)
+        data = poison_federated_data(
+            data, attackers, cfg.target_label, cfg.poison_frac,
+            cfg.trigger_size, seed=cfg.seed)
     algo = FedAvgRobust(wl, data, FedAvgRobustConfig(
-        norm_bound=cfg.norm_bound, stddev=cfg.stddev,
+        defense=cfg.defense, norm_bound=cfg.norm_bound, stddev=cfg.stddev,
         **_fedavg_cfg_kwargs(cfg)), mesh=mesh, sink=sink)
-    algo.run(checkpointer=_make_checkpointer(cfg))
-    return algo.history[-1] if algo.history else {}
+    params = algo.run(checkpointer=_make_checkpointer(cfg))
+    out = dict(algo.history[-1]) if algo.history else {}
+    if targeted is not None:
+        from fedml_tpu.algorithms.backdoor import targeted_accuracy
+        out["backdoor_acc"] = targeted_accuracy(wl, params, targeted)
+        sink.log({"backdoor_acc": out["backdoor_acc"]},
+                 step=cfg.comm_round - 1)
+    return out
 
 
 @runner("hierarchical")

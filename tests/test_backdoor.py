@@ -1,0 +1,124 @@
+"""Backdoor attack + defense evaluation (FedAvgRobust).
+
+The reference defends with norm-diff clipping and weak DP and measures
+"targetted-task" accuracy (FedAvgRobustAggregator.py:270 test_target_accuracy).
+Here: one fully-poisoned attacker in an 8-client cohort; the defended runs
+must show a lower backdoor success rate than the undefended run while keeping
+the raw task intact.
+"""
+
+import flax.linen as nn
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.backdoor import (evaluate_backdoor,
+                                           make_targeted_test_set,
+                                           poison_federated_data,
+                                           targeted_accuracy)
+from fedml_tpu.algorithms.fedavg_robust import (FedAvgRobust,
+                                                FedAvgRobustConfig)
+from fedml_tpu.data.stacking import FederatedData, stack_client_data
+from fedml_tpu.trainer.workload import ClassificationWorkload
+
+H = W = 12
+CLASSES = 4
+TARGET = 3
+TRIGGER_VALUE = 3.0   # outside the clean pixel range -> salient backdoor
+
+
+class _MLP(nn.Module):
+    """Small non-saturating classifier (the sigmoid-squashed reference LR
+    caps logits at 1, which mutes the backdoor-vs-raw-task contrast this
+    suite measures)."""
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(CLASSES)(nn.relu(nn.Dense(32)(x)))
+
+
+def _image_clients(n_clients=8, per_client=24, seed=0):
+    """Class-identifiable synthetic images: per-class base pattern + noise.
+    The trigger corner region is left noisy (no class signal there)."""
+    rng = np.random.RandomState(seed)
+    bases = rng.rand(CLASSES, H, W, 1).astype(np.float32)
+    xs, ys = [], []
+    for _ in range(n_clients):
+        y = rng.randint(0, CLASSES, per_client).astype(np.int32)
+        x = bases[y] + 0.3 * rng.randn(per_client, H, W, 1).astype(np.float32)
+        xs.append(x.astype(np.float32))
+        ys.append(y)
+    return xs, ys
+
+
+def _fed_data(xs, ys):
+    train = stack_client_data(xs, ys, batch_size=8)
+    return FederatedData(client_num=len(xs), class_num=CLASSES,
+                         train=train, test=train)
+
+
+def _run(defense, data, workload, seed=1):
+    cfg = FedAvgRobustConfig(
+        comm_round=12, client_num_per_round=data.client_num, epochs=5,
+        batch_size=8, lr=0.4, frequency_of_the_test=100, seed=seed,
+        defense=defense, norm_bound=0.3, stddev=0.05)
+    algo = FedAvgRobust(workload, data, cfg)
+    return algo.run()
+
+
+@pytest.fixture(scope="module")
+def attack_setup():
+    xs, ys = _image_clients()
+    clean = _fed_data(xs, ys)
+    poisoned = poison_federated_data(clean, attacker_ids=[0],
+                                     target_label=TARGET, poison_frac=1.0,
+                                     trigger_size=3, value=TRIGGER_VALUE,
+                                     seed=0)
+    # targeted set from HONEST clients' samples (trigger flips, not freebies)
+    x_eval = np.concatenate(xs[1:])
+    y_eval = np.concatenate(ys[1:])
+    targeted = make_targeted_test_set(x_eval, y_eval, TARGET, trigger_size=3,
+                                      value=TRIGGER_VALUE)
+    wl = ClassificationWorkload(_MLP(), num_classes=CLASSES,
+                                grad_clip_norm=None)
+    return clean, poisoned, targeted, wl
+
+
+def test_poisoning_preserves_weights_and_masks(attack_setup):
+    clean, poisoned, _, _ = attack_setup
+    np.testing.assert_array_equal(clean.train["mask"],
+                                  poisoned.train["mask"])
+    np.testing.assert_array_equal(clean.train["num_samples"],
+                                  poisoned.train["num_samples"])
+    # attacker shard changed, honest shards untouched
+    assert not np.allclose(clean.train["x"][0], poisoned.train["x"][0])
+    np.testing.assert_array_equal(clean.train["x"][1:],
+                                  poisoned.train["x"][1:])
+    assert (poisoned.train["y"][0][poisoned.train["mask"][0] > 0]
+            == TARGET).all()
+
+
+def test_backdoor_implants_undefended(attack_setup):
+    _, poisoned, targeted, wl = attack_setup
+    params = _run("none", poisoned, wl)
+    rep = evaluate_backdoor(wl, params, targeted,
+                            clean={k: v[1] for k, v in
+                                   poisoned.test.items() if k != "num_samples"})
+    assert rep["backdoor_acc"] > 0.5, rep
+    assert rep["raw_task_acc"] > 0.8, rep
+
+
+@pytest.mark.parametrize("defense", ["norm_diff_clipping", "weak_dp"])
+def test_defense_lowers_backdoor_accuracy(attack_setup, defense):
+    """The round's headline claim: the defense cuts the backdoor success
+    rate vs the undefended run on identical data/seeds, without giving up
+    the raw task."""
+    _, poisoned, targeted, wl = attack_setup
+    undefended = _run("none", poisoned, wl)
+    defended = _run(defense, poisoned, wl)
+    acc_u = targeted_accuracy(wl, undefended, targeted)
+    acc_d = targeted_accuracy(wl, defended, targeted)
+    assert acc_d < acc_u * 0.6, (defense, acc_u, acc_d)
+    clean_eval = {k: v[1] for k, v in poisoned.test.items()
+                  if k != "num_samples"}
+    rep = evaluate_backdoor(wl, defended, targeted, clean=clean_eval)
+    assert rep["raw_task_acc"] > 0.7, rep
