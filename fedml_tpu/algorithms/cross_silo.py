@@ -21,6 +21,8 @@ FedAVGTrainer.py:25-29) is preserved: the server sends each silo a
 from __future__ import annotations
 
 import logging
+import math
+import threading
 from typing import Callable, Dict, Optional
 
 import jax
@@ -41,6 +43,7 @@ class MsgType:
     S2C_SYNC = 2          # MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT
     C2S_MODEL = 3         # MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
     S2C_FINISH = 4        # shutdown signal (reference uses MPI Abort instead)
+    ROUND_TIMEOUT = 5     # server self-message from the straggler timer
 
 
 # a silo-local trainer: (global_params, client_idx, round_idx) ->
@@ -55,19 +58,44 @@ class FedAvgServerActor(ServerManager):
     def __init__(self, transport: Transport, init_params,
                  client_num_in_total: int, client_num_per_round: int,
                  num_rounds: int,
-                 on_round_done: Optional[Callable[[int, object], None]] = None):
+                 on_round_done: Optional[Callable[[int, object], None]] = None,
+                 straggler_policy: str = "wait",
+                 round_timeout_s: Optional[float] = None,
+                 min_silo_frac: float = 0.5):
+        """Failure handling (SURVEY.md §5.3 — the reference has none: its
+        barrier waits forever and its only exit is ``MPI.Abort``,
+        server_manager.py:64):
+
+        * ``straggler_policy="wait"`` — reference-parity strict barrier;
+          with a timeout set it logs the missing silos and keeps waiting.
+        * ``"drop"`` — after ``round_timeout_s``, aggregate the silos that
+          DID report, provided at least ``min_silo_frac`` of the cohort
+          arrived (else keep waiting); stragglers' late uploads are
+          discarded by the round tag.
+        * ``"abort"`` — after the timeout, send FINISH to every silo and
+          stop (the clean version of the reference's MPI abort).
+        """
         super().__init__(0, transport)
+        if straggler_policy not in ("wait", "drop", "abort"):
+            raise ValueError(f"unknown straggler_policy {straggler_policy!r}")
         self.params = init_params
         self.client_num_in_total = client_num_in_total
         self.client_num_per_round = client_num_per_round
         self.num_rounds = num_rounds
         self.round_idx = 0
         self.on_round_done = on_round_done
+        self.straggler_policy = straggler_policy
+        self.round_timeout_s = round_timeout_s
+        self.min_silo_frac = min_silo_frac
+        self.aborted = False
+        self.dropped_silos: Dict[int, list] = {}  # round -> missing silo ids
         self._received: Dict[int, tuple] = {}
         self._num_silos = 0  # silos contacted this round (= sampled cohort)
+        self._timer: Optional[threading.Timer] = None
 
     def register_handlers(self) -> None:
         self.register_handler(MsgType.C2S_MODEL, self._on_model)
+        self.register_handler(MsgType.ROUND_TIMEOUT, self._on_timeout)
 
     # -- round logic ---------------------------------------------------------
     def start(self) -> None:
@@ -91,14 +119,71 @@ class FedAvgServerActor(ServerManager):
                       **{Message.ARG_MODEL_PARAMS: host_params,
                          Message.ARG_CLIENT_INDEX: int(client_idx),
                          Message.ARG_ROUND: self.round_idx})
+        self._arm_timer()
+
+    # -- straggler timer ----------------------------------------------------
+    def _arm_timer(self) -> None:
+        if self.round_timeout_s is None:
+            return
+        self._cancel_timer()
+        round_at_arm = self.round_idx
+        # the timer thread only ENQUEUES a self-message; all policy logic
+        # runs on the transport's event loop, so handler state stays
+        # single-threaded (SURVEY.md §5.2)
+        self._timer = threading.Timer(
+            self.round_timeout_s,
+            lambda: self.send(MsgType.ROUND_TIMEOUT, 0,
+                              **{Message.ARG_ROUND: round_at_arm}))
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _cancel_timer(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def _on_timeout(self, msg: Message) -> None:
+        if msg.get(Message.ARG_ROUND) != self.round_idx:
+            return  # stale timer from an already-completed round
+        missing = sorted(set(range(1, self._num_silos + 1))
+                         - set(self._received))
+        if not missing:
+            return
+        log.warning("round %d: silos %s have not reported after %.1fs "
+                    "(policy=%s)", self.round_idx, missing,
+                    self.round_timeout_s, self.straggler_policy)
+        if self.straggler_policy == "abort":
+            self.aborted = True
+            for silo in range(1, self._num_silos + 1):
+                self.send(MsgType.S2C_FINISH, silo)
+            self.finish()
+            return
+        quorum = max(1, math.ceil(self.min_silo_frac * self._num_silos))
+        if self.straggler_policy == "drop" and len(self._received) >= quorum:
+            self.dropped_silos[self.round_idx] = missing
+            self._complete_round()
+            return
+        self._arm_timer()  # wait (or drop below quorum): keep waiting
 
     def _on_model(self, msg: Message) -> None:
+        # stale-round guard: a straggler's upload arriving after its round
+        # was closed out (drop policy) must not pollute the next barrier
+        upload_round = msg.get(Message.ARG_ROUND)
+        if upload_round is not None and upload_round != self.round_idx:
+            log.warning("discarding round-%s upload from silo %d (current "
+                        "round %d)", upload_round, msg.sender_id,
+                        self.round_idx)
+            return
         # barrier semantics: wait for every sampled silo
         # (check_whether_all_receive, FedAvgServerManager.py:51)
         self._received[msg.sender_id] = (
             msg.get(Message.ARG_MODEL_PARAMS), msg.get(Message.ARG_NUM_SAMPLES))
         if len(self._received) < self._num_silos:
             return
+        self._complete_round()
+
+    def _complete_round(self) -> None:
+        self._cancel_timer()
         trees = [self._received[s][0] for s in sorted(self._received)]
         weights = np.array([self._received[s][1] for s in sorted(self._received)],
                            dtype=np.float32)
@@ -113,6 +198,10 @@ class FedAvgServerActor(ServerManager):
             self.finish()
         else:
             self._broadcast(MsgType.S2C_SYNC)
+
+    def finish(self) -> None:
+        self._cancel_timer()
+        super().finish()
 
 
 class FedAvgClientActor(ClientManager):
@@ -136,4 +225,5 @@ class FedAvgClientActor(ClientManager):
         self.send(MsgType.C2S_MODEL, 0,
                   **{Message.ARG_MODEL_PARAMS: jax.tree.map(np.asarray,
                                                             new_params),
-                     Message.ARG_NUM_SAMPLES: int(num_samples)})
+                     Message.ARG_NUM_SAMPLES: int(num_samples),
+                     Message.ARG_ROUND: round_idx})

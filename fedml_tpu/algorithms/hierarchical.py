@@ -10,9 +10,12 @@ Parity with fedml_api/standalone/hierarchical_fl/:
   clients' sample counts (trainer.py:56-62).
 
 TPU mapping (SURVEY.md §2.5): group tier = ICI within a pod slice, global
-tier = DCN across slices.  In this single-program form each group round is a
-cohort-engine jit; group cohorts are padded to one static bucket so all
-groups share one compiled program.
+tier = DCN across slices.  Single-chip, the WHOLE two-tier round is one jit:
+group cohorts are padded to one static [G, M, ...] bucket, each group's
+``group_comm_round`` FedAvg rounds run as a `lax.scan`, and the G groups run
+simultaneously under `vmap` — groups are a batch axis, not a Python loop.
+On a mesh the groups iterate host-side over the client-sharded cohort step
+(each group already parallel over its clients' devices).
 """
 
 from __future__ import annotations
@@ -24,12 +27,58 @@ from typing import Dict, List
 import jax
 import numpy as np
 
+import jax.numpy as jnp
+
 from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
 from fedml_tpu.core.pytree import tree_weighted_mean
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.data.stacking import gather_cohort
+from fedml_tpu.parallel.cohort import train_cohort
 
 logger = logging.getLogger(__name__)
+
+
+def make_grouped_round(local_train, group_comm_round: int):
+    """One jit for an entire hierarchical round: vmap over the group axis of
+    a scanned multi-round FedAvg (group.py:24-46 per group, trainer.py:56-62
+    across groups).
+
+    ``grouped(params, cohorts, rng) -> new_params`` with cohort leaves
+    [G, M, S, B, ...]; a group whose sampled-client weights are all zero
+    (possible under random assignment) passes params through unchanged.
+    """
+
+    def group_run(params, cohort, rng):
+        # guard the weights, not the mean: an all-padding (empty) group gets
+        # uniform dummy weights so tree_weighted_mean stays finite (ints
+        # included), then the result is discarded by the total>0 select
+        total = jnp.sum(cohort["num_samples"].astype(jnp.float32))
+        safe_w = jnp.where(total > 0, cohort["num_samples"],
+                           jnp.ones_like(cohort["num_samples"]))
+
+        def body(carry, _):
+            p, r = carry
+            r, rr = jax.random.split(r)
+            stacked, _ = train_cohort(local_train, p, cohort, rr)
+            p_new = tree_weighted_mean(stacked, safe_w)
+            # empty group: no clients -> model unchanged
+            p = jax.tree.map(
+                lambda new, old: jnp.where(total > 0, new, old), p_new, p)
+            return (p, r), None
+
+        (p, _), _ = jax.lax.scan(body, (params, rng), None,
+                                 length=group_comm_round)
+        return p, total
+
+    @jax.jit
+    def grouped(params, cohorts, rng):
+        rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+            jnp.arange(cohorts["num_samples"].shape[0]))
+        group_params, group_w = jax.vmap(
+            group_run, in_axes=(None, 0, 0))(params, cohorts, rngs)
+        return tree_weighted_mean(group_params, group_w)
+
+    return grouped
 
 
 @dataclasses.dataclass
@@ -47,6 +96,10 @@ class HierarchicalFedAvg(FedAvg):
             raise ValueError(f"unknown group_method {cfg.group_method!r}")
         rng = np.random.RandomState(cfg.seed)
         self.group_indexes = rng.randint(0, cfg.group_num, data.client_num)
+        # single-chip: all groups train simultaneously (vmap'd group axis)
+        self._grouped_round = (None if mesh is not None else
+                               make_grouped_round(self._local_train,
+                                                  cfg.group_comm_round))
 
     def _group_clients(self, ids: np.ndarray) -> Dict[int, List[int]]:
         groups: Dict[int, List[int]] = {}
@@ -71,22 +124,38 @@ class HierarchicalFedAvg(FedAvg):
             ids = sample_clients(global_round, self.data.client_num,
                                  cfg.client_num_per_round)
             groups = self._group_clients(np.asarray(ids))
-            group_params, group_weights = [], []
-            for gidx in sorted(groups):
-                gids = groups[gidx]
-                w_group = params
-                cohort = gather_cohort(self.data.train, gids,
-                                       pad_to=cfg.client_num_per_round)
-                cohort = stage_global(cohort, self.mesh, P("clients"))
-                for group_round in range(cfg.group_comm_round):
-                    rng, rr = jax.random.split(rng)
-                    rr = stage_global(rr, self.mesh)
-                    w_group, _ = self.cohort_step(w_group, cohort, rr)
-                group_params.append(w_group)
-                group_weights.append(
-                    float(self.data.train["num_samples"][gids].sum()))
-            params = tree_weighted_mean(group_params,
-                                        jax.numpy.asarray(group_weights))
+            if self._grouped_round is not None:
+                # one jit: [G, M, ...] cohorts, groups vmapped in parallel
+                rng, rr = jax.random.split(rng)
+                cohorts = [gather_cohort(self.data.train,
+                                         groups.get(g, []),
+                                         pad_to=cfg.client_num_per_round)
+                           for g in range(cfg.group_num)]
+                stacked = jax.tree.map(lambda *xs: jax.numpy.stack(xs),
+                                       *cohorts)
+                params = self._grouped_round(params, stacked, rr)
+            else:
+                # same rng derivation as the vmapped path (fold_in by group
+                # index, split per group round) so one seed yields one model
+                # regardless of topology
+                rng, rr = jax.random.split(rng)
+                group_params, group_weights = [], []
+                for gidx in sorted(groups):
+                    gids = groups[gidx]
+                    w_group = params
+                    cohort = gather_cohort(self.data.train, gids,
+                                           pad_to=cfg.client_num_per_round)
+                    cohort = stage_global(cohort, self.mesh, P("clients"))
+                    r_g = jax.random.fold_in(rr, gidx)
+                    for group_round in range(cfg.group_comm_round):
+                        r_g, rloc = jax.random.split(r_g)
+                        rloc = stage_global(rloc, self.mesh)
+                        w_group, _ = self.cohort_step(w_group, cohort, rloc)
+                    group_params.append(w_group)
+                    group_weights.append(
+                        float(self.data.train["num_samples"][gids].sum()))
+                params = tree_weighted_mean(group_params,
+                                            jax.numpy.asarray(group_weights))
 
             if (global_round % cfg.frequency_of_the_test == 0
                     or global_round == cfg.comm_round - 1):

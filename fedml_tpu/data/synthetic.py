@@ -79,6 +79,43 @@ def load_synthetic(alpha: float = 0.5, beta: float = 0.5, iid: bool = False,
                                  np.concatenate(ys_te), batch_size))
 
 
+def mnist_learnable_twin(num_clients: int = 1000, class_num: int = 10,
+                         dim: int = 784, batch_size: int = 10,
+                         noise: float = 0.9, max_samples: int = 64,
+                         seed: int = 0) -> FederatedData:
+    """A LEARNABLE MNIST stand-in for convergence validation: each class is
+    a random prototype vector, samples are prototype + N(0, noise), client
+    sizes follow the LEAF power law (lognormal), class mix per client is
+    non-uniform (two dominant classes per client, like LEAF MNIST's
+    power-law label skew).  Logistic regression reaches >90% here, mirroring
+    real MNIST-LR learnability (benchmark/README.md:12 target >75)."""
+    rng = np.random.RandomState(seed)
+    protos = rng.randn(class_num, dim).astype(np.float32)
+    sizes = np.minimum(rng.lognormal(3.0, 1.0, num_clients).astype(int) + 8,
+                       max_samples)
+    xs_tr, ys_tr, xs_te, ys_te = [], [], [], []
+    for c in range(num_clients):
+        # two dominant classes per client (non-IID label skew)
+        dom = rng.choice(class_num, 2, replace=False)
+        p = np.full(class_num, 0.1 / (class_num - 2))
+        p[dom] = 0.45
+        n = int(sizes[c])
+        n_te = max(1, n // 5)
+        for xs, ys, m in ((xs_tr, ys_tr, n), (xs_te, ys_te, n_te)):
+            y = rng.choice(class_num, m, p=p).astype(np.int32)
+            x = (protos[y] + noise * rng.randn(m, dim)).astype(np.float32)
+            xs.append(x)
+            ys.append(y)
+    train = stack_client_data(xs_tr, ys_tr, batch_size)
+    test = stack_client_data(xs_te, ys_te, batch_size)
+    return FederatedData(
+        client_num=num_clients, class_num=class_num, train=train, test=test,
+        train_global=batch_global(np.concatenate(xs_tr),
+                                  np.concatenate(ys_tr), batch_size),
+        test_global=batch_global(np.concatenate(xs_te),
+                                 np.concatenate(ys_te), batch_size))
+
+
 def synthetic_federated_dataset(
         num_clients: int = 8, samples_per_client: int = 32,
         sample_shape: Sequence[int] = (28, 28, 1), class_num: int = 10,

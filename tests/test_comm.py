@@ -7,6 +7,7 @@ exact message protocol of the distributed FedAvg choreography
 """
 
 import threading
+import types
 
 import numpy as np
 import pytest
@@ -223,3 +224,182 @@ def test_ring_weights_two_nodes():
     assert abs(w_self + w_left + w_right - 1.0) < 1e-9
     with pytest.raises(ValueError):
         _ring_weights(np.array([[0.9, 0.5], [0.5, 0.5]], np.float64))
+
+
+class _FakeMqttBroker:
+    """In-process pub/sub broker standing in for a real MQTT daemon — routes
+    published payloads to subscribed fake clients by exact topic match."""
+
+    def __init__(self):
+        self.subs = {}  # topic -> list of clients
+
+    def subscribe(self, topic, client):
+        self.subs.setdefault(topic, []).append(client)
+
+    def publish(self, topic, payload):
+        for client in self.subs.get(topic, []):
+            client._deliver(topic, payload)
+
+
+class _FakeMqttClient:
+    """paho-mqtt Client API surface used by MqttTransport (connect,
+    subscribe, publish, loop_start/stop, disconnect, on_message)."""
+
+    _broker: "_FakeMqttBroker" = None  # class-level: shared per test
+
+    def __init__(self, client_id=""):
+        self.client_id = client_id
+        self.on_message = None
+
+    def connect(self, host, port):
+        assert host == "fake-broker"
+
+    def subscribe(self, topic, qos=0):
+        self._broker.subscribe(topic, self)
+
+    def publish(self, topic, payload, qos=0):
+        self._broker.publish(topic, payload)
+
+    def _deliver(self, topic, payload):
+        msg = types.SimpleNamespace(topic=topic, payload=payload)
+        if self.on_message is not None:
+            self.on_message(self, None, msg)
+
+    def loop_start(self):
+        pass
+
+    def loop_stop(self):
+        pass
+
+    def disconnect(self):
+        pass
+
+
+def test_mqtt_transport_loopback(monkeypatch):
+    """MqttTransport over a broker fake: topic scheme, binary pytree codec,
+    observer dispatch, clean stop (the reference never tests its
+    MqttCommManager at all — mqtt_comm_manager.py has no test)."""
+    from fedml_tpu.comm import mqtt_transport as mt
+
+    class _FakeModule:
+        Client = _FakeMqttClient
+
+    _FakeMqttClient._broker = _FakeMqttBroker()
+    monkeypatch.setattr(mt, "_mqtt", _FakeModule)
+    monkeypatch.setattr(mt, "HAVE_MQTT", True)
+
+    a = mt.MqttTransport(0, "fake-broker")
+    b = mt.MqttTransport(1, "fake-broker")
+    got = []
+
+    class Collect:
+        def receive_message(self, msg_type, msg):
+            got.append((msg_type, msg))
+            b.stop()
+
+    b.add_observer(Collect())
+    tree = _params_tree(5)
+    a.send_message(Message(3, 0, 1).add(Message.ARG_MODEL_PARAMS, tree))
+    b.run()  # drains inbox until stop
+    assert len(got) == 1
+    mtype, msg = got[0]
+    assert mtype == 3 and msg.sender_id == 0 and msg.receiver_id == 1
+    np.testing.assert_array_equal(
+        msg.get(Message.ARG_MODEL_PARAMS)["dense"]["kernel"],
+        tree["dense"]["kernel"])
+
+
+def test_mqtt_unavailable_raises(monkeypatch):
+    from fedml_tpu.comm import mqtt_transport as mt
+    monkeypatch.setattr(mt, "HAVE_MQTT", False)
+    with pytest.raises(ImportError):
+        mt.MqttTransport(0, "fake-broker")
+
+
+class _DeafClientActor(FedAvgClientActor):
+    """A silo that never responds to sync messages (crashed/partitioned) but
+    still honors FINISH so the test can shut it down."""
+
+    def register_handlers(self):
+        self.register_handler(MsgType.S2C_FINISH, lambda m: self.finish())
+
+
+def _silo_train_fn(delta):
+    def fn(params, client_idx, round_idx):
+        import jax
+        return jax.tree.map(lambda v: v + delta, params), 10 * delta
+    return fn
+
+
+def test_straggler_drop_policy_completes_rounds():
+    """With straggler_policy='drop', a dead silo stalls each round only for
+    the timeout, then the quorum aggregates without it (the reference's
+    barrier would hang forever, FedAvgServerManager.py:51)."""
+    hub = LocalHub()
+    t_server = hub.transport(0)
+    t_c1, t_c2 = hub.transport(1), hub.transport(2)
+    init = _params_tree(0)
+    history = []
+    server = FedAvgServerActor(
+        t_server, init, client_num_in_total=2, client_num_per_round=2,
+        num_rounds=2,
+        on_round_done=lambda r, p: history.append((r, p)),
+        straggler_policy="drop", round_timeout_s=0.25, min_silo_frac=0.5)
+    c1 = FedAvgClientActor(1, t_c1, _silo_train_fn(1))
+    c2 = _DeafClientActor(2, t_c2, _silo_train_fn(2))
+
+    threads = [threading.Thread(target=a.run) for a in (c1, c2)]
+    for th in threads:
+        th.start()
+    server.register_handlers()
+    server.start()
+    server.transport.run()  # until FINISH after num_rounds
+    for th in threads:
+        th.join(timeout=5)
+
+    assert server.round_idx == 2 and not server.aborted
+    assert server.dropped_silos == {0: [2], 1: [2]}
+    # both rounds aggregated silo 1 alone: params = init + round_count
+    np.testing.assert_allclose(
+        np.asarray(server.params["dense"]["kernel"]),
+        np.asarray(init["dense"]["kernel"]) + 2, rtol=1e-6)
+    assert [r for r, _ in history] == [0, 1]
+
+
+def test_straggler_abort_policy():
+    hub = LocalHub()
+    t_server = hub.transport(0)
+    t_c1, t_c2 = hub.transport(1), hub.transport(2)
+    server = FedAvgServerActor(
+        t_server, _params_tree(0), client_num_in_total=2,
+        client_num_per_round=2, num_rounds=3,
+        straggler_policy="abort", round_timeout_s=0.2)
+    c1 = FedAvgClientActor(1, t_c1, _silo_train_fn(1))
+    c2 = _DeafClientActor(2, t_c2, _silo_train_fn(2))
+    threads = [threading.Thread(target=a.run) for a in (c1, c2)]
+    for th in threads:
+        th.start()
+    server.register_handlers()
+    server.start()
+    server.transport.run()
+    for th in threads:
+        th.join(timeout=5)
+    assert server.aborted and server.round_idx == 0
+
+
+def test_stale_round_upload_discarded():
+    """A straggler's upload tagged with a closed round must not count toward
+    the current barrier."""
+    hub = LocalHub()
+    server = FedAvgServerActor(
+        hub.transport(0), _params_tree(0), client_num_in_total=2,
+        client_num_per_round=2, num_rounds=5)
+    server.register_handlers()
+    server.round_idx = 3
+    server._num_silos = 2
+    stale = Message(MsgType.C2S_MODEL, 2, 0)
+    stale.add(Message.ARG_MODEL_PARAMS, _params_tree(1))
+    stale.add(Message.ARG_NUM_SAMPLES, 5)
+    stale.add(Message.ARG_ROUND, 2)  # old round
+    server._on_model(stale)
+    assert server._received == {}
