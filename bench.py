@@ -127,13 +127,12 @@ def bench_femnist_cnn(rounds, clients_per_round=10, mesh=None,
                     rounds)
 
 
-def _measure_device(model, classes, lr, epochs, batch_size, xs, ys,
-                    clients_per_round, rounds):
+def _device_setup(model, classes, lr, epochs, batch_size, xs, ys):
+    """Shared HBM-resident staging for the device-round / scanned benches:
+    (local_train, params, stacked_dev)."""
     import jax
     import jax.numpy as jnp
-    from fedml_tpu.core.sampling import sample_clients
     from fedml_tpu.data.stacking import stack_client_data
-    from fedml_tpu.parallel.cohort import make_device_round
     from fedml_tpu.trainer.local_sgd import make_local_trainer
     from fedml_tpu.trainer.workload import (ClassificationWorkload,
                                             make_client_optimizer)
@@ -142,11 +141,23 @@ def _measure_device(model, classes, lr, epochs, batch_size, xs, ys,
     workload = ClassificationWorkload(model, num_classes=classes)
     local = make_local_trainer(workload,
                                make_client_optimizer("sgd", lr), epochs)
-    round_fn = make_device_round(local, clients_per_round)
     params = workload.init(jax.random.key(0), jax.tree.map(
         lambda v: jnp.asarray(v[0, 0]),
         {k: stacked[k] for k in ("x", "y", "mask")}))
     stacked_dev = {k: jnp.asarray(v) for k, v in stacked.items()}
+    return local, params, stacked_dev
+
+
+def _measure_device(model, classes, lr, epochs, batch_size, xs, ys,
+                    clients_per_round, rounds):
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.core.sampling import sample_clients
+    from fedml_tpu.parallel.cohort import make_device_round
+
+    local, params, stacked_dev = _device_setup(
+        model, classes, lr, epochs, batch_size, xs, ys)
+    round_fn = make_device_round(local, clients_per_round)
     live = jnp.ones(clients_per_round, jnp.float32)
 
     def ids_for(i):
@@ -163,6 +174,47 @@ def _measure_device(model, classes, lr, epochs, batch_size, xs, ys,
                              jax.random.key(i))
     jax.block_until_ready(params)
     return (_now() - t0) / rounds, flops
+
+
+def bench_femnist_cnn_scanned(rounds, clients_per_round=10, k=20):
+    """The dispatch-amortised fast path: lax.scan over K rounds per device
+    dispatch (make_scanned_rounds).  At sub-ms round times the host loop is
+    latency-bound — this measures the true on-chip round rate."""
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.core.sampling import sample_clients
+    from fedml_tpu.models import CNNOriginalFedAvg
+    from fedml_tpu.parallel.cohort import make_scanned_rounds
+
+    samples = int(os.environ.get("BENCH_FEMNIST_SAMPLES", "200"))
+    xs, ys = _synth_clients(max(128, clients_per_round), samples,
+                            (28, 28, 1), 62)
+    # identical workload/hparams to the dispatch headline (_measure_device
+    # via bench_femnist_cnn) so the two numbers compare the dispatch model
+    local, params, stacked_dev = _device_setup(
+        CNNOriginalFedAvg(only_digits=False), 62, 0.1, 1, 20, xs, ys)
+    rounds_fn = make_scanned_rounds(local, clients_per_round)
+
+    def ids_for(chunk):
+        ids = np.stack([sample_clients(chunk * k + i, len(xs),
+                                       clients_per_round)
+                        for i in range(k)]).astype(np.int32)
+        return jnp.asarray(ids), jnp.ones((k, clients_per_round), jnp.float32)
+
+    ids, live = ids_for(0)
+    args0 = (params, stacked_dev, ids, live, jax.random.key(0))
+    flops = _compiled_flops(rounds_fn, *args0)
+    params, _ = rounds_fn(*args0)     # warmup/compile
+    jax.block_until_ready(params)
+    n_chunks = max(1, rounds // k)
+    t0 = _now()
+    for c in range(1, n_chunks + 1):
+        ids, live = ids_for(c)
+        params, _ = rounds_fn(params, stacked_dev, ids, live,
+                              jax.random.key(c))
+    jax.block_until_ready(params)
+    per_round = (_now() - t0) / (n_chunks * k)
+    return per_round, (flops / k if flops else 0.0)
 
 
 def bench_resnet56_cifar10(rounds, mesh=None, samples=512):
@@ -243,16 +295,31 @@ def main():
         "round_s": round_s, "rounds_per_s": 1.0 / round_s,
         "flops_per_round": flops, "mfu": _mfu(flops, round_s)}
 
-    # 2) flagship cross-silo
-    r56_rounds = max(3, rounds // 4)
-    samples = int(os.environ.get("BENCH_R56_SAMPLES",
-                                 "5000" if full else "512"))
-    round_s56, flops56 = bench_resnet56_cifar10(r56_rounds, samples=samples)
-    steps = 10 * (samples // 64)
-    details["configs"]["resnet56_cifar10_c10_b64"] = {
-        "round_s": round_s56, "samples_per_client": samples,
-        "step_time_ms": 1e3 * round_s56 / max(steps, 1),
-        "flops_per_round": flops56, "mfu": _mfu(flops56, round_s56)}
+    # 1b) dispatch-amortised headline (scan K rounds per dispatch)
+    # (a CPU fallback run does ~14s/CNN-round — shrink so bench terminates)
+    on_cpu = details["platform"] == "cpu"
+    scan_round_s, scan_flops = bench_femnist_cnn_scanned(
+        4 if on_cpu else max(rounds, 20), k=2 if on_cpu else 20)
+    details["configs"]["femnist_cnn_c10_scan20"] = {
+        "round_s": scan_round_s, "rounds_per_s": 1.0 / scan_round_s,
+        "flops_per_round": scan_flops, "mfu": _mfu(scan_flops, scan_round_s)}
+
+    # 2) flagship cross-silo (skipped on a CPU fallback run: resnet56
+    # training steps take tens of seconds per round there)
+    if not on_cpu:
+        r56_rounds = max(3, rounds // 4)
+        samples = int(os.environ.get("BENCH_R56_SAMPLES",
+                                     "5000" if full else "512"))
+        round_s56, flops56 = bench_resnet56_cifar10(r56_rounds,
+                                                    samples=samples)
+        steps = 10 * (samples // 64)
+        details["configs"]["resnet56_cifar10_c10_b64"] = {
+            "round_s": round_s56, "samples_per_client": samples,
+            "step_time_ms": 1e3 * round_s56 / max(steps, 1),
+            "flops_per_round": flops56, "mfu": _mfu(flops56, round_s56)}
+    else:
+        details["configs"]["resnet56_cifar10_c10_b64"] = {"mfu": 0.0,
+                                                          "skipped": "cpu"}
 
     # 3) cohort scaling curve
     if os.environ.get("BENCH_SCALING", "1") != "0":
@@ -280,11 +347,14 @@ def main():
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_DETAILS.json"), "w") as f:
         json.dump(details, f, indent=2)
+    best_round_s = min(round_s, scan_round_s)
     print(json.dumps({
         "metric": "fedavg_round_time_femnist_cnn",
-        "value": round(1.0 / round_s, 3),
+        "value": round(1.0 / best_round_s, 3),
         "unit": "rounds/sec",
-        "vs_baseline": round((torch_s or round_s) / round_s, 3),
+        "vs_baseline": round((torch_s or best_round_s) / best_round_s, 3),
+        "rounds_per_s_dispatch": round(1.0 / round_s, 3),
+        "rounds_per_s_scan20": round(1.0 / scan_round_s, 3),
         "mfu_femnist": round(details["configs"]["femnist_cnn_c10"]["mfu"], 4),
         "mfu_resnet56": round(
             details["configs"]["resnet56_cifar10_c10_b64"]["mfu"], 4),

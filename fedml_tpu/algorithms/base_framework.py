@@ -1,0 +1,148 @@
+"""Base-framework template — the skeleton for new message-passing algorithms.
+
+Parity with ``fedml_api/distributed/base_framework/`` (algorithm_api.py:16-38,
+central_worker.py, client_worker.py, central_manager.py, client_manager.py):
+a minimal central/client worker pair whose "model" is any python value, used
+as the copy-me scaffold for building a new distributed algorithm.
+
+TPU translation: ``FedML_init``'s MPI rank/size bootstrap becomes transport
+injection (any `fedml_tpu.comm` transport — LocalHub for tests, gRPC/MQTT
+for deployment); the manager choreography (init broadcast → client update →
+C2S upload → all-received barrier → aggregate → next round) is identical.
+On-pod algorithms should NOT start from this template — they should be one
+jit program over the cohort engine (`fedml_tpu.parallel.cohort`); this
+scaffold is for host-edge choreography only.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, Optional
+
+from fedml_tpu.comm.actors import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.transport import Transport
+
+log = logging.getLogger(__name__)
+
+
+class BaseMsg:
+    """message_define.py parity."""
+    S2C_INIT = 1          # MSG_TYPE_S2C_INIT_CONFIG
+    C2S_INFORMATION = 2   # MSG_TYPE_C2S_INFORMATION
+    S2C_SYNC = 3          # MSG_TYPE_S2C_SYNC_TO_CLIENT
+    S2C_FINISH = 4
+    ARG_INFORMATION = "information"
+
+
+class BaseCentralWorker:
+    """Accumulate client results + aggregate (central_worker.py:4-31).
+    Replace ``aggregate`` in your algorithm."""
+
+    def __init__(self, client_num: int):
+        self.client_num = client_num
+        self.client_local_result_list: Dict[int, Any] = {}
+
+    def add_client_local_result(self, index: int, result: Any) -> None:
+        self.client_local_result_list[index] = result
+
+    def check_whether_all_receive(self) -> bool:
+        return len(self.client_local_result_list) >= self.client_num
+
+    def aggregate(self) -> Any:
+        total = sum(self.client_local_result_list.values())
+        self.client_local_result_list.clear()
+        return total
+
+
+class BaseClientWorker:
+    """Local computation stub (client_worker.py:1-12): ``train`` returns the
+    client's contribution; ``update`` receives the global state."""
+
+    def __init__(self, client_index: int):
+        self.client_index = client_index
+        self.updated_information: Any = 0
+
+    def update(self, info: Any) -> None:
+        self.updated_information = info
+
+    def train(self) -> Any:
+        return self.client_index
+
+
+class BaseCentralActor(ServerManager):
+    """central_manager.py choreography on the transport actor layer."""
+
+    def __init__(self, transport: Transport, worker: BaseCentralWorker,
+                 num_rounds: int,
+                 on_round_done: Optional[Callable[[int, Any], None]] = None):
+        super().__init__(0, transport)
+        self.worker = worker
+        self.num_rounds = num_rounds
+        self.round_idx = 0
+        self.on_round_done = on_round_done
+
+    def register_handlers(self) -> None:
+        self.register_handler(BaseMsg.C2S_INFORMATION, self._on_information)
+
+    def start(self) -> None:
+        for client in range(1, self.worker.client_num + 1):
+            self.send(BaseMsg.S2C_INIT, client,
+                      **{BaseMsg.ARG_INFORMATION: 0})
+
+    def _on_information(self, msg: Message) -> None:
+        self.worker.add_client_local_result(
+            msg.sender_id - 1, msg.get(BaseMsg.ARG_INFORMATION))
+        if not self.worker.check_whether_all_receive():
+            return
+        global_result = self.worker.aggregate()
+        if self.on_round_done is not None:
+            self.on_round_done(self.round_idx, global_result)
+        self.round_idx += 1
+        done = self.round_idx >= self.num_rounds
+        for client in range(1, self.worker.client_num + 1):
+            if done:
+                self.send(BaseMsg.S2C_FINISH, client)
+            else:
+                self.send(BaseMsg.S2C_SYNC, client,
+                          **{BaseMsg.ARG_INFORMATION: global_result})
+        if done:
+            self.finish()
+
+
+class BaseClientActor(ClientManager):
+    """client_manager.py choreography: update -> train -> upload."""
+
+    def __init__(self, node_id: int, transport: Transport,
+                 worker: BaseClientWorker):
+        super().__init__(node_id, transport)
+        self.worker = worker
+
+    def register_handlers(self) -> None:
+        self.register_handler(BaseMsg.S2C_INIT, self._on_sync)
+        self.register_handler(BaseMsg.S2C_SYNC, self._on_sync)
+        self.register_handler(BaseMsg.S2C_FINISH, lambda m: self.finish())
+
+    def _on_sync(self, msg: Message) -> None:
+        self.worker.update(msg.get(BaseMsg.ARG_INFORMATION))
+        self.send(BaseMsg.C2S_INFORMATION, 0,
+                  **{BaseMsg.ARG_INFORMATION: self.worker.train()})
+
+
+def run_base_framework_demo(client_num: int = 3, num_rounds: int = 2):
+    """The FedML_Base_distributed equivalent on the in-process hub
+    (deterministic pump — no threads); returns per-round aggregates."""
+    from fedml_tpu.comm.local import LocalHub
+    hub = LocalHub()
+    history = []
+    server = BaseCentralActor(hub.transport(0), BaseCentralWorker(client_num),
+                              num_rounds,
+                              on_round_done=lambda r, g: history.append(g))
+    clients = [BaseClientActor(i, hub.transport(i), BaseClientWorker(i - 1))
+               for i in range(1, client_num + 1)]
+    server.register_handlers()
+    for c in clients:
+        c.register_handlers()
+    server.start()
+    hub.pump()
+    return history

@@ -88,18 +88,100 @@ class HierarchicalConfig(FedAvgConfig):
     group_method: str = "random"
 
 
+def make_two_level_round(local_train, group_comm_round: int, mesh):
+    """The SURVEY §2.5 two-level mesh: a [groups, clients] device grid where
+    each group's ``group_comm_round`` FedAvg rounds aggregate with `psum`
+    over the ``clients`` axis (ICI within a slice) and the final global
+    average is a weighted `psum` over the ``groups`` axis (DCN across
+    slices).  One jit; same math and rng streams as `make_grouped_round`
+    (parity-tested), so single-chip simulation and pod execution are
+    interchangeable.
+
+    ``two_level(params, cohorts, rng) -> new_params`` with cohort leaves
+    [G, M, S, B, ...], G == mesh groups axis, M divisible by the clients
+    axis.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def per_device(params, cohort, rng):
+        params = jax.lax.pcast(params, ("groups", "clients"), to="varying")
+        rng = jax.lax.pcast(rng, ("groups", "clients"), to="varying")
+        g = jax.lax.axis_index("groups")
+        c = jax.lax.axis_index("clients")
+        local = jax.tree.map(lambda v: v[0], cohort)   # [M/D, ...] shard
+        m_loc = local["num_samples"].shape[0]
+        w = local["num_samples"].astype(jnp.float32)
+        total_g = jax.lax.psum(jnp.sum(w), "clients")
+        ratio = w / jnp.maximum(total_g, 1.0)
+        r_g = jax.random.fold_in(rng, g)
+
+        def body(carry, _):
+            p, r = carry
+            r, rr = jax.random.split(r)
+            stacked, _ = train_cohort(local_train, p, local, rr,
+                                      index_offset=c * m_loc)
+            p_new = jax.tree.map(
+                lambda x: jax.lax.psum(jnp.sum(
+                    x * ratio.reshape((-1,) + (1,) * (x.ndim - 1))
+                    .astype(x.dtype), axis=0), "clients"), stacked)
+            p = jax.tree.map(
+                lambda new, old: jnp.where(total_g > 0, new, old), p_new, p)
+            return (p, r), None
+
+        (p_g, _), _ = jax.lax.scan(body, (params, r_g), None,
+                                   length=group_comm_round)
+        # global tier: sample-weighted mean of group models over DCN.
+        # p_g is replicated across the clients axis (it came out of a
+        # clients-psum), so reduce over BOTH axes and divide out the D
+        # duplicate copies — this also lets shard_map statically prove the
+        # P() (fully replicated) out_spec
+        tot = jax.lax.psum(total_g, "groups")
+        D = jax.lax.axis_size("clients")
+        share = total_g / jnp.maximum(tot, 1.0) / D
+        return jax.tree.map(
+            lambda x: jax.lax.psum(x.astype(jnp.float32) * share,
+                                   ("groups", "clients")).astype(x.dtype),
+            p_g)
+
+    sharded = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(), P("groups", "clients"), P()), out_specs=P())
+    return jax.jit(sharded)
+
+
 class HierarchicalFedAvg(FedAvg):
     def __init__(self, workload, data, config: HierarchicalConfig, mesh=None, sink=None):
-        super().__init__(workload, data, config, mesh=mesh, sink=sink)
+        two_level = mesh is not None and "groups" in mesh.axis_names
+        super().__init__(workload, data, config,
+                         mesh=None if two_level else mesh, sink=sink)
+        # staging target: multi-process pods need global jax.Arrays even on
+        # the two-level path (self.mesh is None there by construction)
+        self._stage_mesh = mesh
         cfg = config
         if cfg.group_method != "random":
             raise ValueError(f"unknown group_method {cfg.group_method!r}")
         rng = np.random.RandomState(cfg.seed)
         self.group_indexes = rng.randint(0, cfg.group_num, data.client_num)
-        # single-chip: all groups train simultaneously (vmap'd group axis)
-        self._grouped_round = (None if mesh is not None else
-                               make_grouped_round(self._local_train,
-                                                  cfg.group_comm_round))
+        if two_level:
+            # [groups, clients] device grid (make_two_level_mesh): group
+            # aggregation over ICI, global over DCN — one jit per round
+            if cfg.group_num != mesh.shape["groups"]:
+                raise ValueError(
+                    f"group_num={cfg.group_num} must equal the mesh groups "
+                    f"axis ({mesh.shape['groups']})")
+            if cfg.client_num_per_round % mesh.shape["clients"]:
+                raise ValueError(
+                    f"client_num_per_round={cfg.client_num_per_round} must "
+                    f"be a multiple of the mesh clients axis "
+                    f"({mesh.shape['clients']})")
+            self._grouped_round = make_two_level_round(
+                self._local_train, cfg.group_comm_round, mesh)
+        else:
+            # single-chip: all groups train simultaneously (vmap'd group
+            # axis); 1-D client mesh falls back to the host group loop
+            self._grouped_round = (None if mesh is not None else
+                                   make_grouped_round(self._local_train,
+                                                      cfg.group_comm_round))
 
     def _group_clients(self, ids: np.ndarray) -> Dict[int, List[int]]:
         groups: Dict[int, List[int]] = {}
@@ -119,13 +201,14 @@ class HierarchicalFedAvg(FedAvg):
 
         from jax.sharding import PartitionSpec as P
         from fedml_tpu.parallel.mesh import stage_global
-        params = stage_global(params, self.mesh)
+        params = stage_global(params, self._stage_mesh)
         for global_round in range(start_round, cfg.comm_round):
             ids = sample_clients(global_round, self.data.client_num,
                                  cfg.client_num_per_round)
             groups = self._group_clients(np.asarray(ids))
             if self._grouped_round is not None:
-                # one jit: [G, M, ...] cohorts, groups vmapped in parallel
+                # one jit: [G, M, ...] cohorts — groups vmapped (single
+                # chip) or sharded over the [groups, clients] grid
                 rng, rr = jax.random.split(rng)
                 cohorts = [gather_cohort(self.data.train,
                                          groups.get(g, []),
@@ -133,6 +216,10 @@ class HierarchicalFedAvg(FedAvg):
                            for g in range(cfg.group_num)]
                 stacked = jax.tree.map(lambda *xs: jax.numpy.stack(xs),
                                        *cohorts)
+                if self._stage_mesh is not None:
+                    stacked = stage_global(stacked, self._stage_mesh,
+                                           P("groups", "clients"))
+                    rr = stage_global(rr, self._stage_mesh)
                 params = self._grouped_round(params, stacked, rr)
             else:
                 # same rng derivation as the vmapped path (fold_in by group

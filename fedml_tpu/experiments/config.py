@@ -39,6 +39,7 @@ class ExperimentConfig:
     epochs: int = 1
     comm_round: int = 10
     frequency_of_the_test: int = 5
+    rounds_per_dispatch: int = 1         # >1: lax.scan K rounds per dispatch
     ci: int = 0                          # short-circuit eval (CI mode flag)
     seed: int = 0
 
@@ -79,6 +80,7 @@ class ExperimentConfig:
 
     # ---- TPU placement (replaces gpu_mapping / mpirun) -----------------
     mesh_clients: int = 0     # >0: shard the cohort over this many devices
+    mesh_groups: int = 0      # >0 (hierarchical): [groups, clients] mesh
     platform: Optional[str] = None       # force jax platform (e.g. "cpu")
     host_device_count: int = 0           # virtual CPU devices (simulation)
     coordinator_address: Optional[str] = None  # multi-host bootstrap

@@ -77,7 +77,8 @@ def _fedavg_cfg_kwargs(cfg: ExperimentConfig) -> Dict[str, Any]:
                 client_num_per_round=cfg.client_num_per_round,
                 epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr,
                 client_optimizer=cfg.client_optimizer, wd=cfg.wd,
-                frequency_of_the_test=freq, seed=cfg.seed)
+                frequency_of_the_test=freq, seed=cfg.seed,
+                rounds_per_dispatch=cfg.rounds_per_dispatch)
 
 
 def _make_checkpointer(cfg: ExperimentConfig):
@@ -539,7 +540,20 @@ def main(argv=None) -> Dict[str, Any]:
     init_distributed(cfg.coordinator_address, cfg.num_processes,
                      cfg.process_id)
     mesh = None
-    if cfg.mesh_clients > 0:
+    if cfg.mesh_groups > 0:
+        if cfg.algo != "hierarchical":
+            raise ValueError(
+                "--mesh_groups builds the two-level [groups, clients] mesh, "
+                "which only the hierarchical algorithm consumes; other "
+                f"algorithms (got --algo {cfg.algo}) would silently "
+                "duplicate work across the groups axis. Use --mesh_clients.")
+        import jax
+        from fedml_tpu.parallel.mesh import make_two_level_mesh
+        n_cli = cfg.mesh_clients or len(jax.devices()) // cfg.mesh_groups
+        mesh = make_two_level_mesh(
+            group_axis=cfg.mesh_groups, client_axis=n_cli,
+            devices=jax.devices()[:cfg.mesh_groups * n_cli])
+    elif cfg.mesh_clients > 0:
         import jax
         mesh = make_mesh(client_axis=cfg.mesh_clients,
                          devices=jax.devices()[:cfg.mesh_clients])

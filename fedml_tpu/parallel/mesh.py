@@ -35,6 +35,24 @@ def make_mesh(client_axis: Optional[int] = None, model_axis: int = 1,
     return Mesh(arr, axis_names)
 
 
+def make_two_level_mesh(group_axis: int, client_axis: Optional[int] = None,
+                        devices: Optional[Sequence[jax.Device]] = None
+                        ) -> Mesh:
+    """[groups, clients] mesh for hierarchical FL (SURVEY.md §2.5): the
+    group tier aggregates over the ``clients`` axis (ICI within a slice),
+    the global tier over the ``groups`` axis (DCN across slices).  On a real
+    multi-slice pod pass ``devices`` ordered slice-major so the groups axis
+    falls on the DCN boundary."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if client_axis is None:
+        client_axis = n // group_axis
+    assert group_axis * client_axis == n, (
+        f"mesh {group_axis}x{client_axis} != {n} devices")
+    arr = np.asarray(devices).reshape(group_axis, client_axis)
+    return Mesh(arr, ("groups", "clients"))
+
+
 def client_axis_size(mesh: Optional[Mesh]) -> int:
     if mesh is None:
         return 1

@@ -236,6 +236,26 @@ def test_hierarchical_vmapped_groups_match_sequential_replay(workload):
     _tree_close(batched, replay, rtol=1e-5, atol=1e-6)
 
 
+def test_hierarchical_two_level_mesh_matches_vmapped(workload):
+    """The [groups, clients] two-level mesh (group psum over ICI, global
+    psum over DCN) must produce the SAME model as the single-chip vmapped
+    path — same fold_in(group)/split rng streams, same client slot
+    numbering, so simulation and pod execution are interchangeable."""
+    from fedml_tpu.parallel.mesh import make_two_level_mesh
+
+    data = _data(n_clients=8)
+    cfg = HierarchicalConfig(comm_round=3, client_num_per_round=8, epochs=1,
+                             batch_size=30, lr=0.2, group_num=2,
+                             group_comm_round=2, frequency_of_the_test=100)
+    mesh = make_two_level_mesh(group_axis=2, client_axis=4)
+    single = HierarchicalFedAvg(workload, data, cfg)
+    two = HierarchicalFedAvg(workload, data, cfg, mesh=mesh)
+    p0 = single.init_params(jax.random.key(9))
+    ps = single.run(params=jax.tree.map(jnp.copy, p0), rng=jax.random.key(4))
+    pt = two.run(params=jax.tree.map(jnp.copy, p0), rng=jax.random.key(4))
+    _tree_close(ps, pt, rtol=1e-4, atol=1e-5)
+
+
 def test_hierarchical_empty_group_is_noop(workload):
     """A group that receives no sampled clients must pass params through
     (not poison the global mean with NaNs)."""

@@ -175,6 +175,7 @@ def _torch_cifar_resnet(layers=(1, 1, 1), num_classes=10):
     return Net()
 
 
+@pytest.mark.slow
 def test_torch_resnet_import_forward_parity(tmp_path):
     """Import a torch CIFAR-ResNet checkpoint and verify the flax model
     produces the SAME logits (33x33 input keeps XLA SAME padding symmetric,

@@ -403,3 +403,11 @@ def test_stale_round_upload_discarded():
     stale.add(Message.ARG_ROUND, 2)  # old round
     server._on_model(stale)
     assert server._received == {}
+
+
+def test_base_framework_template_demo():
+    """The copy-me scaffold (base_framework/algorithm_api.py:16-38) runs its
+    sum-of-client-indexes demo: with 3 clients each round aggregates
+    0+1+2 = 3."""
+    from fedml_tpu.algorithms.base_framework import run_base_framework_demo
+    assert run_base_framework_demo(client_num=3, num_rounds=2) == [3, 3]

@@ -95,8 +95,13 @@ def test_cli_fast_algos(algo):
     assert summary
 
 
-@pytest.mark.slow
-@pytest.mark.parametrize("algo", sorted(RUNNERS))
+# big-model compiles dominate these CLI combos on CPU -> slow tier
+_HEAVY_ALGOS = {"fednas", "fedgkt", "fedseg", "asdgan", "fedgan"}
+
+
+@pytest.mark.parametrize(
+    "algo", [pytest.param(a, marks=pytest.mark.slow)
+             if a in _HEAVY_ALGOS else a for a in sorted(RUNNERS)])
 def test_cli_every_algorithm(algo, tmp_path):
     """Every algorithm × the CLI runs end-to-end on hermetic data (the
     reference CI's per-combo smoke strategy)."""

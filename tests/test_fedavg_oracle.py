@@ -240,3 +240,58 @@ def test_fedavg_device_path_matches_host_path():
     slow = slow_algo.run()
     for a, b in zip(jax.tree.leaves(fast), jax.tree.leaves(slow)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scanned_rounds_path_matches_loop_cadence(workload):
+    """rounds_per_dispatch>1 (lax.scan K rounds per dispatch) must hit the
+    same eval rounds and reach the same quality as the host-loop path (rng
+    schedules differ by design, so trajectories are compared statistically:
+    same cadence, both learn)."""
+    xs, ys = _synthetic_clients(n_clients=12, seed=3)
+    data = _make_fed_data(xs, ys, batch_size=8)
+    mk = lambda rpd: FedAvgConfig(
+        comm_round=33, client_num_per_round=4, epochs=1, batch_size=8,
+        lr=0.5, frequency_of_the_test=16, seed=5, rounds_per_dispatch=rpd)
+    loop = FedAvg(workload, data, mk(1))
+    scan = FedAvg(workload, data, mk(4))
+    p0 = loop.init_params(jax.random.key(2))
+    loop.run(params=jax.tree.map(jnp.copy, p0), rng=jax.random.key(3))
+    scan.run(params=jax.tree.map(jnp.copy, p0), rng=jax.random.key(3))
+    assert [h["round"] for h in loop.history] == \
+           [h["round"] for h in scan.history] == [0, 16, 32]
+    acc_loop = loop.history[-1]["train_acc"]
+    acc_scan = scan.history[-1]["train_acc"]
+    assert acc_scan > 0.6 and abs(acc_scan - acc_loop) < 0.2, \
+        (acc_loop, acc_scan)
+
+
+def test_scanned_rounds_same_ids_as_loop(workload, monkeypatch):
+    """The scanned path must feed each absolute round the same cohort ids
+    the host loop would (sample_clients(round) parity) — only the rng
+    schedule differs.  Captured by intercepting the rounds_fn."""
+    from fedml_tpu.core.sampling import sample_clients
+    import fedml_tpu.parallel.cohort as cohort_mod
+
+    captured = []
+    real_make = cohort_mod.make_scanned_rounds
+
+    def spy_make(local_train, m, **kw):
+        fn = real_make(local_train, m, **kw)
+
+        def wrapped(params, stacked, ids, live, rng):
+            captured.append((np.asarray(ids), np.asarray(live)))
+            return fn(params, stacked, ids, live, rng)
+        return wrapped
+
+    monkeypatch.setattr(cohort_mod, "make_scanned_rounds", spy_make)
+    xs, ys = _synthetic_clients(n_clients=12, seed=3)
+    data = _make_fed_data(xs, ys, batch_size=8)
+    algo = FedAvg(workload, data, FedAvgConfig(
+        comm_round=7, client_num_per_round=4, epochs=1, batch_size=8,
+        lr=0.3, frequency_of_the_test=3, seed=5, rounds_per_dispatch=3))
+    algo.run(rng=jax.random.key(0))
+    flat_ids = np.concatenate([ids for ids, _ in captured])
+    assert flat_ids.shape == (7, 4)
+    for r in range(7):
+        expect = sample_clients(r, 12, 4)
+        np.testing.assert_array_equal(flat_ids[r, :len(expect)], expect)

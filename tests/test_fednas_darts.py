@@ -1,5 +1,6 @@
 """DARTS search space + FedNAS federated architecture search."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -17,6 +18,7 @@ def _tiny_net():
                               multiplier=2, stem_multiplier=1)
 
 
+@pytest.mark.slow
 def test_search_network_shapes_and_alpha_grad():
     net = _tiny_net()
     rng = jax.random.key(0)
@@ -74,6 +76,7 @@ def test_eval_network_from_genotype():
     assert out.shape == (2, 3)
 
 
+@pytest.mark.slow
 def test_fednas_search_rounds():
     rng = np.random.RandomState(0)
     C, S, B = 2, 2, 4

@@ -1,5 +1,6 @@
 """Federated GANs (FedGan, AsDGan) and FedSeg segmentation stack."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -16,6 +17,7 @@ from fedml_tpu.models import (
 from fedml_tpu.data.stacking import FederatedData
 
 
+@pytest.mark.slow
 def test_fedgan_trains_and_samples():
     rng = np.random.RandomState(0)
     C, S, B = 2, 2, 8
@@ -84,6 +86,7 @@ def test_confusion_matrix_and_metrics():
         np.testing.assert_allclose(v, 1.0)
 
 
+@pytest.mark.slow
 def test_fedseg_end_to_end_unet():
     rng = np.random.RandomState(0)
     C, S, B, H = 2, 2, 2, 16
@@ -106,6 +109,7 @@ def test_fedseg_end_to_end_unet():
     assert 0.0 <= keeper.accuracy <= 1.0
 
 
+@pytest.mark.slow
 def test_deeplab_shapes_both_backbones():
     x = jnp.asarray(np.random.RandomState(0).rand(1, 32, 32, 3), jnp.float32)
     for bb in ("xception", "resnet"):

@@ -33,9 +33,11 @@ def _fwd(model, shape, train=False):
     (lambda: resnet56(10), 10, 32),
     (lambda: resnet18_gn(100), 100, 32),
     (lambda: vgg11(10), 10, 32),
-    (lambda: mobilenet(10), 10, 32),
-    (lambda: mobilenet_v3(10, mode="small"), 10, 32),
-    (lambda: efficientnet("b0", 10), 10, 32),
+    pytest.param(lambda: mobilenet(10), 10, 32, marks=pytest.mark.slow),
+    pytest.param(lambda: mobilenet_v3(10, mode="small"), 10, 32,
+                 marks=pytest.mark.slow),
+    pytest.param(lambda: efficientnet("b0", 10), 10, 32,
+                 marks=pytest.mark.slow),
 ])
 def test_forward_shapes(factory, classes, hw):
     model = factory()
@@ -69,6 +71,7 @@ def test_batchnorm_variant_has_stats():
     assert "batch_stats" not in vg
 
 
+@pytest.mark.slow
 def test_stateful_local_training_updates_stats():
     model = resnet56(10, norm="batch")
     wl = ClassificationWorkload(model, 10, stateful=True)

@@ -117,6 +117,7 @@ def test_kd_kl_loss_matches_reference_formula():
     assert float(got.min()) > -1e-3
 
 
+@pytest.mark.slow
 def test_fedgkt_end_to_end_tiny():
     client = GKTClientResNet(blocks=1, num_classes=4)
     server = GKTServerResNet(layers=(1, 1), num_classes=4)
