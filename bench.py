@@ -105,6 +105,21 @@ def _measure(step, params, stacked, clients_per_round, total_clients,
     return (_now() - t0) / rounds, flops
 
 
+# the FEMNIST headline config, shared by the dispatch and scanned benches so
+# the two rounds/s numbers always measure the same workload
+# (benchmark/README.md:54: 2-conv CNN, B=20, E=1, sgd lr=0.1, 62 classes)
+FEMNIST_CLASSES = 62
+FEMNIST_LR = 0.1
+FEMNIST_EPOCHS = 1
+FEMNIST_BATCH = 20
+
+
+def _femnist_data(clients_per_round):
+    samples = int(os.environ.get("BENCH_FEMNIST_SAMPLES", "200"))
+    return _synth_clients(max(128, clients_per_round), samples,
+                          (28, 28, 1), FEMNIST_CLASSES)
+
+
 def bench_femnist_cnn(rounds, clients_per_round=10, mesh=None,
                       on_device=True):
     """benchmark/README.md:54 config on synthetic FEMNIST-shaped data.
@@ -113,16 +128,16 @@ def bench_femnist_cnn(rounds, clients_per_round=10, mesh=None,
     gather (make_device_round) — the production fast path; False measures
     the host-gather + re-upload path for comparison."""
     from fedml_tpu.models import CNNOriginalFedAvg
-    samples = int(os.environ.get("BENCH_FEMNIST_SAMPLES", "200"))
-    xs, ys = _synth_clients(max(128, clients_per_round), samples,
-                            (28, 28, 1), 62)
+    xs, ys = _femnist_data(clients_per_round)
     if on_device and mesh is None:
-        return _measure_device(CNNOriginalFedAvg(only_digits=False), 62,
-                               0.1, 1, 20, xs, ys, clients_per_round,
-                               rounds)
+        return _measure_device(
+            CNNOriginalFedAvg(only_digits=False), FEMNIST_CLASSES,
+            FEMNIST_LR, FEMNIST_EPOCHS, FEMNIST_BATCH, xs, ys,
+            clients_per_round, rounds)
     step, params, stacked = _build_step(
-        CNNOriginalFedAvg(only_digits=False), 62, lr=0.1, epochs=1,
-        batch_size=20, xs=xs, ys=ys, mesh=mesh)
+        CNNOriginalFedAvg(only_digits=False), FEMNIST_CLASSES,
+        lr=FEMNIST_LR, epochs=FEMNIST_EPOCHS, batch_size=FEMNIST_BATCH,
+        xs=xs, ys=ys, mesh=mesh)
     return _measure(step, params, stacked, clients_per_round, len(xs),
                     rounds)
 
@@ -186,13 +201,12 @@ def bench_femnist_cnn_scanned(rounds, clients_per_round=10, k=20):
     from fedml_tpu.models import CNNOriginalFedAvg
     from fedml_tpu.parallel.cohort import make_scanned_rounds
 
-    samples = int(os.environ.get("BENCH_FEMNIST_SAMPLES", "200"))
-    xs, ys = _synth_clients(max(128, clients_per_round), samples,
-                            (28, 28, 1), 62)
-    # identical workload/hparams to the dispatch headline (_measure_device
-    # via bench_femnist_cnn) so the two numbers compare the dispatch model
+    xs, ys = _femnist_data(clients_per_round)
+    # identical workload/hparams to the dispatch headline (shared FEMNIST_*
+    # constants) so the two numbers compare only the dispatch model
     local, params, stacked_dev = _device_setup(
-        CNNOriginalFedAvg(only_digits=False), 62, 0.1, 1, 20, xs, ys)
+        CNNOriginalFedAvg(only_digits=False), FEMNIST_CLASSES, FEMNIST_LR,
+        FEMNIST_EPOCHS, FEMNIST_BATCH, xs, ys)
     rounds_fn = make_scanned_rounds(local, clients_per_round)
 
     def ids_for(chunk):

@@ -120,10 +120,13 @@ def make_two_level_round(local_train, group_comm_round: int, mesh):
             r, rr = jax.random.split(r)
             stacked, _ = train_cohort(local_train, p, local, rr,
                                       index_offset=c * m_loc)
+            # accumulate in f32 and cast back, matching tree_weighted_mean
+            # (exact for int leaves, full precision for bf16 params)
             p_new = jax.tree.map(
                 lambda x: jax.lax.psum(jnp.sum(
-                    x * ratio.reshape((-1,) + (1,) * (x.ndim - 1))
-                    .astype(x.dtype), axis=0), "clients"), stacked)
+                    x.astype(jnp.float32)
+                    * ratio.reshape((-1,) + (1,) * (x.ndim - 1)),
+                    axis=0), "clients").astype(x.dtype), stacked)
             p = jax.tree.map(
                 lambda new, old: jnp.where(total_g > 0, new, old), p_new, p)
             return (p, r), None

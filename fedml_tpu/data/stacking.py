@@ -101,6 +101,32 @@ def batch_global(x: Array, y: Array, batch_size: int) -> Dict[str, Array]:
     return {"x": d["x"][0], "y": d["y"][0], "mask": d["mask"][0]}
 
 
+def save_stacked(stacked: Dict[str, Array], out_dir: str) -> None:
+    """Persist a stacked client tree as one ``.npy`` per key (the staging
+    format for corpora that exceed RAM — see load_stacked_memmap)."""
+    import os
+    os.makedirs(out_dir, exist_ok=True)
+    for k, v in stacked.items():
+        np.save(os.path.join(out_dir, f"{k}.npy"), np.asarray(v))
+
+
+def load_stacked_memmap(in_dir: str) -> Dict[str, Array]:
+    """Load a saved stacked tree memory-mapped (SURVEY.md §7 hard part (f):
+    342k-client StackOverflow without re-staging).
+
+    The [N, S, B, ...] arrays stay on disk; ``gather_cohort``'s fancy-index
+    ``v[ids]`` copies ONLY the sampled cohort's rows per round, so host RAM
+    holds one cohort, not the corpus.  FedAvg's HBM budget check reads
+    ``nbytes`` without materialising, so an over-budget memmap dataset
+    automatically stays on the per-round host-gather path."""
+    import os
+    out = {}
+    for f in sorted(os.listdir(in_dir)):
+        if f.endswith(".npy"):
+            out[f[:-4]] = np.load(os.path.join(in_dir, f), mmap_mode="r")
+    return out
+
+
 def gather_cohort(stacked: Dict[str, Array], client_ids: Sequence[int],
                   pad_to: Optional[int] = None) -> Dict[str, Any]:
     """Select the sampled cohort's rows; optionally pad with weight-0 dummy

@@ -549,7 +549,12 @@ def main(argv=None) -> Dict[str, Any]:
                 "duplicate work across the groups axis. Use --mesh_clients.")
         import jax
         from fedml_tpu.parallel.mesh import make_two_level_mesh
-        n_cli = cfg.mesh_clients or len(jax.devices()) // cfg.mesh_groups
+        n_dev = len(jax.devices())
+        n_cli = cfg.mesh_clients or n_dev // cfg.mesh_groups
+        if n_cli < 1:
+            raise ValueError(
+                f"--mesh_groups {cfg.mesh_groups} exceeds the "
+                f"{n_dev} available devices")
         mesh = make_two_level_mesh(
             group_axis=cfg.mesh_groups, client_axis=n_cli,
             devices=jax.devices()[:cfg.mesh_groups * n_cli])

@@ -47,8 +47,10 @@ def make_two_level_mesh(group_axis: int, client_axis: Optional[int] = None,
     n = len(devices)
     if client_axis is None:
         client_axis = n // group_axis
-    assert group_axis * client_axis == n, (
-        f"mesh {group_axis}x{client_axis} != {n} devices")
+    if client_axis < 1 or group_axis * client_axis != n:
+        raise ValueError(
+            f"cannot build a [{group_axis}, {client_axis}] two-level mesh "
+            f"from {n} devices; groups axis must divide the device count")
     arr = np.asarray(devices).reshape(group_axis, client_axis)
     return Mesh(arr, ("groups", "clients"))
 

@@ -1,0 +1,33 @@
+"""Readers for the reference's stored training curves.
+
+The reference ships torch-pickled per-epoch metric lists with its pretrained
+resnet56 models (``fedml_api/model/cv/pretrained/<DATASET>/resnet56/
+{train,test}_metrics`` — lists of dicts with ``train_loss``,
+``train_accTop1``, ``train_accTop5``, ``time``).  These are the accuracy
+targets BASELINE.md's CIFAR rows cite; loading them lets convergence runs be
+shape-checked against the published trajectories instead of bare thresholds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+def load_reference_curve(path: str) -> List[Dict[str, float]]:
+    """One torch-pickled metrics file -> list of per-epoch dicts (keys as
+    stored: train_loss / train_accTop1 / ... or the test_ equivalents)."""
+    import torch
+    curve = torch.load(path, map_location="cpu", weights_only=False)
+    return [{k: float(v) for k, v in epoch.items()} for epoch in curve]
+
+
+def curve_is_learning(values: List[float], min_gain: float = 0.0,
+                      head_frac: float = 0.2, tail_frac: float = 0.2) -> bool:
+    """The qualitative "learning curve" shape check: the tail-window mean of
+    an accuracy series must exceed the head-window mean by ``min_gain``."""
+    n = len(values)
+    if n < 2:
+        return False
+    head = values[:max(1, int(n * head_frac))]
+    tail = values[-max(1, int(n * tail_frac)):]
+    return (sum(tail) / len(tail)) - (sum(head) / len(head)) > min_gain

@@ -55,3 +55,57 @@ def test_mnist_lr_to_75():
     params = algo.run()
     acc = algo.evaluate_global(params)["train_acc"]
     assert acc > 0.75, f"MNIST-LR twin train acc {acc:.3f} <= 0.75"
+
+
+REF_CURVES = "/root/reference/fedml_api/model/cv/pretrained/CIFAR10/resnet56"
+
+
+@pytest.mark.skipif(not __import__("os").path.isdir(REF_CURVES),
+                    reason="reference curves not mounted")
+def test_reference_curve_reader_parses_published_cifar10():
+    """The stored resnet56/CIFAR10 trajectory parses and matches
+    BASELINE.md's expectations: ~top-1 >90 by the end, monotone learning
+    shape (pretrained/CIFAR10/resnet56/train_metrics)."""
+    import os
+    from fedml_tpu.utils.reference_curves import (curve_is_learning,
+                                                  load_reference_curve)
+    curve = load_reference_curve(os.path.join(REF_CURVES, "train_metrics"))
+    acc = [e["train_accTop1"] for e in curve]
+    assert len(acc) > 50
+    assert acc[-1] > 90.0
+    assert curve_is_learning(acc, min_gain=10.0)
+
+
+@pytest.mark.slow
+def test_noniid_cifar_twin_learning_curve_shape():
+    """A non-IID (Dirichlet-partitioned) CIFAR run whose accuracy series
+    must show the same qualitative shape as the published reference curve
+    (rising tail; VERDICT round-1 item 4). Small CNN stands in for resnet56
+    so the run fits CPU; the partition/augment path is the real one."""
+    import jax
+    import flax.linen as nn
+    from fedml_tpu.algorithms import FedAvg, FedAvgConfig
+    from fedml_tpu.data import load_data
+    from fedml_tpu.trainer.workload import ClassificationWorkload
+    from fedml_tpu.utils.reference_curves import curve_is_learning
+
+    data = load_data("cifar10", data_dir=None, batch_size=32, client_num=8,
+                     partition_method="hetero", partition_alpha=0.5, seed=0)
+
+    class SmallCNN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.relu(nn.Conv(16, (3, 3), strides=2)(x))
+            x = nn.relu(nn.Conv(32, (3, 3), strides=2)(x))
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(10)(x)
+
+    wl = ClassificationWorkload(SmallCNN(), num_classes=10,
+                                grad_clip_norm=None)
+    cfg = FedAvgConfig(comm_round=30, client_num_per_round=4, epochs=1,
+                       batch_size=32, lr=0.05, frequency_of_the_test=5,
+                       seed=0)
+    algo = FedAvg(wl, data, cfg)
+    algo.run()
+    accs = [h["train_acc"] for h in algo.history]
+    assert curve_is_learning(accs, min_gain=0.05), accs
