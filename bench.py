@@ -37,6 +37,16 @@ import numpy as np
 PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
 
 
+def _compute_dtype():
+    """BENCH_DTYPE=bfloat16 runs model compute in bf16 (mixed precision:
+    f32 master params/opt, bf16 conv/matmul), the MXU-native mode."""
+    name = os.environ.get("BENCH_DTYPE")
+    if not name:
+        return None
+    import jax.numpy as jnp
+    return jnp.dtype(name)
+
+
 def _now():
     return time.time()
 
@@ -71,7 +81,8 @@ def _build_step(model, classes, lr, epochs, batch_size, xs, ys, mesh=None):
                                             make_client_optimizer)
 
     stacked = stack_client_data(xs, ys, batch_size)
-    workload = ClassificationWorkload(model, num_classes=classes)
+    workload = ClassificationWorkload(model, num_classes=classes,
+                                      compute_dtype=_compute_dtype())
     local = make_local_trainer(workload,
                                make_client_optimizer("sgd", lr), epochs)
     step = make_cohort_step(local, mesh=mesh)
@@ -153,7 +164,8 @@ def _device_setup(model, classes, lr, epochs, batch_size, xs, ys):
                                             make_client_optimizer)
 
     stacked = stack_client_data(xs, ys, batch_size)
-    workload = ClassificationWorkload(model, num_classes=classes)
+    workload = ClassificationWorkload(model, num_classes=classes,
+                                      compute_dtype=_compute_dtype())
     local = make_local_trainer(workload,
                                make_client_optimizer("sgd", lr), epochs)
     params = workload.init(jax.random.key(0), jax.tree.map(

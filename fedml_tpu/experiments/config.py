@@ -34,6 +34,7 @@ class ExperimentConfig:
     client_num_per_round: int = 10
     batch_size: int = 10
     client_optimizer: str = "sgd"
+    compute_dtype: str = ""              # "bfloat16": MXU mixed precision
     lr: float = 0.03
     wd: float = 0.001
     epochs: int = 1

@@ -27,8 +27,20 @@ _NWP_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
 
 
 def create_workload(model_name: str, dataset: str, class_num: int,
-                    sample_shape: Sequence[int]) -> Workload:
-    """main_fedavg.py:224-259 switch, flax edition."""
+                    sample_shape: Sequence[int],
+                    compute_dtype: str = "") -> Workload:
+    """main_fedavg.py:224-259 switch, flax edition.
+
+    ``compute_dtype="bfloat16"`` enables MXU-native mixed precision on the
+    classification workloads (f32 master params, bf16 model compute)."""
+    import jax.numpy as jnp
+    dtype = jnp.dtype(compute_dtype) if compute_dtype else None
+    if dtype is not None and (dataset in _NWP_DATASETS
+                              or dataset == "stackoverflow_lr"):
+        raise ValueError(
+            f"--compute_dtype is only wired into the classification "
+            f"workloads; dataset {dataset!r} uses an NWP/tag workload that "
+            f"would silently ignore it")
     if dataset in _NWP_DATASETS:
         if dataset == "stackoverflow_nwp":
             model = RNNStackOverflow()          # rnn.py:39-70
@@ -61,7 +73,8 @@ def create_workload(model_name: str, dataset: str, class_num: int,
     # grad-clip 1.0 parity with MyModelTrainer (classification only,
     # my_model_trainer_classification.py:44)
     return ClassificationWorkload(factories[model_name](),
-                                  num_classes=class_num, grad_clip_norm=1.0)
+                                  num_classes=class_num, grad_clip_norm=1.0,
+                                  compute_dtype=dtype)
 
 
 def sample_shape_of(data: FederatedData) -> tuple:

@@ -95,28 +95,59 @@ def _masked_mean(values: jax.Array, mask: jax.Array) -> jax.Array:
     return jnp.sum(values * mask) / denom
 
 
+def cast_floats(tree: Pytree, dtype) -> Pytree:
+    """Cast floating leaves to ``dtype`` (ints/keys untouched)."""
+    return jax.tree.map(
+        lambda v: v.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) else v, tree)
+
+
 def ClassificationWorkload(model, num_classes: int,
                            grad_clip_norm: Optional[float] = 1.0,
-                           stateful: bool = False) -> Workload:
+                           stateful: bool = False,
+                           compute_dtype=None) -> Workload:
     """Softmax cross-entropy on logits, batch-mean over valid rows (the
     torch ``nn.CrossEntropyLoss()`` default reduction).  ``stateful=True``
     for BatchNorm models: params is the full variables dict and updated
-    running stats ride the loss aux (see Workload docstring)."""
+    running stats ride the loss aux (see Workload docstring).
+
+    ``compute_dtype=jnp.bfloat16`` enables mixed precision the TPU way
+    (SURVEY.md "MXU" guidance): master params, gradients, and the optimizer
+    stay f32; the forward/backward model compute — conv/matmul inputs AND
+    weights — is cast to bf16, halving HBM traffic and doubling MXU rate.
+    The CE loss is always computed in f32 (softmax is range-sensitive)."""
 
     def loss_fn(params, batch, rng, train):
         kwargs = {"rngs": {"dropout": rng}} if rng is not None else {}
+        x = batch["x"]
+        if compute_dtype is not None:
+            if stateful:
+                # keep BatchNorm running stats f32: their momentum update
+                # adds increments far below bf16's 8-bit mantissa
+                params = {k: (v if k == "batch_stats"
+                              else cast_floats(v, compute_dtype))
+                          for k, v in params.items()}
+            else:
+                params = cast_floats(params, compute_dtype)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+                x = x.astype(compute_dtype)
         if stateful:
             logits, new_state = model.apply(
-                params, batch["x"], train=train,
+                params, x, train=train,
                 mutable=["batch_stats"], **kwargs)
         else:
-            logits = model.apply({"params": params}, batch["x"],
+            logits = model.apply({"params": params}, x,
                                  train=train, **kwargs)
+        logits = logits.astype(jnp.float32)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
         loss = _masked_mean(ce, batch["mask"])
         aux = {"loss": loss}
         if stateful:
-            aux["state"] = dict(new_state)
+            new_state = dict(new_state)
+            if compute_dtype is not None:
+                # running stats rejoin the f32 master tree
+                new_state = cast_floats(new_state, jnp.float32)
+            aux["state"] = new_state
         return loss, aux
 
     def metric_fn(params, batch):

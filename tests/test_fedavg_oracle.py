@@ -295,3 +295,36 @@ def test_scanned_rounds_same_ids_as_loop(workload, monkeypatch):
     for r in range(7):
         expect = sample_clients(r, 12, 4)
         np.testing.assert_array_equal(flat_ids[r, :len(expect)], expect)
+
+
+def test_bf16_compute_dtype_mixed_precision():
+    """compute_dtype=bfloat16: master params stay f32, training still
+    learns, and the trajectory stays close to the f32 run at small lr (the
+    TPU mixed-precision mode — f32 CE, bf16 conv/matmul)."""
+    import flax.linen as nn
+
+    class _Linear(nn.Module):
+        # un-squashed logits: the reference LR's sigmoid caps logits in
+        # [0, 1], where bf16's ~8-bit mantissa flattens class margins
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(4)(x.reshape((x.shape[0], -1)))
+
+    xs, ys = _synthetic_clients(n_clients=6, seed=8)
+    data = _make_fed_data(xs, ys, batch_size=32)
+    cfg = FedAvgConfig(comm_round=20, client_num_per_round=6, epochs=1,
+                       batch_size=32, lr=0.3, frequency_of_the_test=100)
+    runs = {}
+    for name, dt in (("f32", None), ("bf16", jnp.bfloat16)):
+        wl = ClassificationWorkload(_Linear(), num_classes=4,
+                                    grad_clip_norm=None, compute_dtype=dt)
+        algo = FedAvg(wl, data, cfg)
+        p0 = algo.init_params(jax.random.key(4))
+        p = algo.run(params=jax.tree.map(jnp.copy, p0),
+                     rng=jax.random.key(5))
+        assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(p))
+        runs[name] = (p, algo.evaluate_global(p)["train_acc"])
+    # both learn, and bf16 tracks f32 loosely (rounding differs per step)
+    assert runs["bf16"][1] > 0.9 and runs["f32"][1] > 0.9
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=0.08),
+                 runs["f32"][0], runs["bf16"][0])

@@ -101,3 +101,34 @@ def test_norm_switch_changes_params():
     # same trained-param count; batch variant adds running stats
     assert _n_params(vb["params"]) == _n_params(vg["params"])
     assert "batch_stats" in vb and "batch_stats" not in vg
+
+
+def test_bf16_stateful_batch_stats_stay_f32():
+    """Mixed precision with BatchNorm: compute runs bf16 but the running
+    stats spliced back into the master tree must come back f32 (they are
+    FedAvg-aggregated alongside weights)."""
+    import flax.linen as nn
+    from fedml_tpu.trainer.local_sgd import make_local_trainer
+    from fedml_tpu.trainer.workload import make_client_optimizer
+
+    class TinyBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Dense(8)(x.reshape((x.shape[0], -1)))
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.Dense(3)(x)
+
+    wl = ClassificationWorkload(TinyBN(), 3, stateful=True,
+                                compute_dtype=jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    data = {"x": jnp.asarray(rng.randn(2, 4, 6), jnp.float32),
+            "y": jnp.asarray(rng.randint(0, 3, (2, 4)), jnp.int32),
+            "mask": jnp.ones((2, 4), jnp.float32)}
+    params = wl.init(jax.random.key(0), jax.tree.map(lambda v: v[0], data))
+    local = make_local_trainer(wl, make_client_optimizer("sgd", 0.1), 1)
+    p1, _ = local(params, data, jax.random.key(1))
+    for leaf in jax.tree.leaves(p1):
+        assert leaf.dtype == jnp.float32, leaf.dtype
+    # running stats actually moved
+    assert not np.allclose(np.asarray(p1["batch_stats"]["BatchNorm_0"]["mean"]),
+                           np.asarray(params["batch_stats"]["BatchNorm_0"]["mean"]))
