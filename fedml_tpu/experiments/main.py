@@ -564,6 +564,16 @@ def main(argv=None) -> Dict[str, Any]:
 
     if cfg.algo not in RUNNERS:
         raise KeyError(f"unknown --algo {cfg.algo!r}; have {sorted(RUNNERS)}")
+    # mixed precision is wired through _make_workload; runners that build
+    # their own models (NAS/GKT/GAN/seg/split/vfl/online) would silently
+    # train f32 — fail loudly instead of faking a bf16 benchmark
+    _DTYPE_RUNNERS = {"fedavg", "fedprox", "fedopt", "fednova",
+                      "fedavg_robust", "hierarchical", "centralized",
+                      "decentralized", "turboaggregate"}
+    if cfg.compute_dtype and cfg.algo not in _DTYPE_RUNNERS:
+        raise ValueError(
+            f"--compute_dtype is not wired into --algo {cfg.algo}; "
+            f"supported: {sorted(_DTYPE_RUNNERS)}")
     data = load_experiment_data(cfg)
     logger.info("algo=%s model=%s dataset=%s clients=%d (%s data)",
                 cfg.algo, cfg.model, cfg.dataset, data.client_num,

@@ -22,6 +22,7 @@ from fedml_tpu.secure.field import (
     lcc_encode, lcc_decode, lcc_encode_with_points, lcc_decode_with_points,
     additive_shares, pk_gen, key_agreement,
 )
+from fedml_tpu.secure.pallas_mask import fused_quantize_mask
 from fedml_tpu.secure.secagg import (
     quantize, dequantize, pairwise_masks, SecureCohortAggregator,
 )
@@ -31,4 +32,5 @@ __all__ = [
     "bgw_decode", "lcc_encode", "lcc_decode", "lcc_encode_with_points",
     "lcc_decode_with_points", "additive_shares", "pk_gen", "key_agreement",
     "quantize", "dequantize", "pairwise_masks", "SecureCohortAggregator",
+    "fused_quantize_mask",
 ]

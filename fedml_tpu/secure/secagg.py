@@ -86,10 +86,21 @@ class SecureCohortAggregator:
     ``lax.psum`` over the cohort mesh axis — masks cancel in either."""
 
     def __init__(self, num_clients: int, scale: float = 2.0**16,
-                 clip: float = 2.0**14):
+                 clip: float = 2.0**14, backend: str = "xla"):
+        """``backend="pallas"`` fuses quantize+mask into one VMEM pass per
+        block with an in-kernel counter PRG (fedml_tpu.secure.pallas_mask)
+        — O(D) HBM traffic instead of O(N·D).  The two backends use
+        different PRG streams; every client of a cohort must use the same
+        one or masks won't cancel.  Note the pallas stream is a 64-bit-keyed
+        hash PRG (architecture demo), not the threefry PRF of the XLA path —
+        see the pallas_mask module docstring before using it for real
+        privacy."""
+        if backend not in ("xla", "pallas"):
+            raise ValueError(f"unknown secagg backend {backend!r}")
         self.num_clients = num_clients
         self.scale = scale
         self.clip = clip
+        self.backend = backend
 
     def mask_update(self, update: Pytree, weight, client_idx,
                     round_key: jax.Array) -> Pytree:
@@ -101,6 +112,12 @@ class SecureCohortAggregator:
         ``aggregate_stacked`` does) and the sum is the weighted mean with
         magnitude ≤ clip — safe for any cohort size.  Raw sample counts as
         weights put the budget on the caller (server divides by Σn)."""
+        if self.backend == "pallas":
+            from fedml_tpu.secure.pallas_mask import fused_quantize_mask
+            return fused_quantize_mask(
+                update, weight, client_idx, round_key, self.num_clients,
+                self.scale, self.clip,
+                interpret=jax.default_backend() != "tpu")
         weighted = jax.tree.map(
             lambda x: x * jnp.asarray(weight, x.dtype), update)
         q = quantize(weighted, self.scale, self.clip)
