@@ -151,18 +151,30 @@ class AsDGanConfig:
     lr_g: float = 2e-4
     lr_d: float = 2e-4
     sample_method: str = "balance"   # 'balance' weights grads by n_c
+    # reference G objective extras (client-side terms whose grads flow back
+    # to the server G, AsDGanAggregator train loss bookkeeping :40-69):
+    # L_G = GAN + lambda_l1 * L1(fake, b) + lambda_perceptual * VGG-feat MSE
+    lambda_l1: float = 0.0
+    lambda_perceptual: float = 0.0
     seed: int = 0
 
 
 class AsDGan:
     """Server generator vs. per-client private discriminators."""
 
-    def __init__(self, generator, discriminator, cfg: AsDGanConfig):
+    def __init__(self, generator, discriminator, cfg: AsDGanConfig,
+                 feat_params=None, feat_model=None):
+        """``feat_params/feat_model``: optional pre-trained VGG16Features
+        for the perceptual term (imported via utils.checkpoint from the
+        torchvision weights the reference downloads); random-init is used
+        when lambda_perceptual > 0 and none are given."""
         self.G = generator
         self.D = discriminator
         self.cfg = cfg
         self.g_opt = optax.adam(cfg.lr_g, b1=0.5)
         self.d_opt = optax.adam(cfg.lr_d, b1=0.5)
+        self._feat_params = feat_params
+        self._feat_model = feat_model
         self._build()
 
     def _build(self):
@@ -180,21 +192,31 @@ class AsDGan:
             du, ds = self.d_opt.update(g, ds, dp)
             return optax.apply_updates(dp, du), ds, dl
 
-        def g_step(gp, gs, dps, a, weights):
+        def g_step(gp, gs, dps, a, b, weights):
             """Server G update: the weighted per-client ∂L_G/∂fake grads,
             aggregated through the chain rule in one jax.grad
             (= backward_G's hand-built scatter, AsDGanAggregator.py:159-187).
-            a: [C, B, H, W, ch]; dps: stacked per-client D params."""
+            The L1/perceptual reconstruction terms are CLIENT-side (computed
+            against the client's private b; only their gradients reach the
+            server G — same privacy topology as the reference).
+            a, b: [C, B, H, W, ch]; dps: stacked per-client D params."""
 
             def loss(gp):
                 fake = self.G.apply({"params": gp},
                                     a.reshape((-1,) + a.shape[2:]))
                 fake = fake.reshape(a.shape[:2] + fake.shape[1:])
 
-                def per_client(dp, f):
-                    return bce_logits(self.D.apply({"params": dp}, f), 1.0)
+                def per_client(dp, f, real):
+                    l = bce_logits(self.D.apply({"params": dp}, f), 1.0)
+                    if cfg.lambda_l1:
+                        l = l + cfg.lambda_l1 * jnp.mean(jnp.abs(f - real))
+                    if cfg.lambda_perceptual:
+                        from fedml_tpu.models import perceptual_loss
+                        l = l + cfg.lambda_perceptual * perceptual_loss(
+                            self._feat_params, self._feat_model, f, real)
+                    return l
 
-                losses = jax.vmap(per_client)(dps, fake)
+                losses = jax.vmap(per_client)(dps, fake, b)
                 w = weights / jnp.maximum(jnp.sum(weights), 1e-8)
                 return jnp.sum(losses * w)
 
@@ -214,6 +236,20 @@ class AsDGan:
         rng = rng if rng is not None else jax.random.key(cfg.seed)
         C, S = data["a"].shape[:2]
         rg, rd = jax.random.split(rng)
+        if cfg.lambda_perceptual and (self._feat_params is None
+                                      or self._feat_model is None):
+            from fedml_tpu.models import VGG16Features
+            if self._feat_model is None:
+                self._feat_model = VGG16Features()
+            if self._feat_params is None:
+                x0 = data["b"][0, 0]
+                x0 = jnp.repeat(x0, 3, -1) if x0.shape[-1] == 1 else x0
+                self._feat_params = self._feat_model.init(
+                    jax.random.fold_in(rng, 77), x0)["params"]
+            else:
+                raise ValueError(
+                    "feat_params were provided without feat_model; pass "
+                    "both (params must match the feature architecture)")
         gp = self.G.init(rg, data["a"][0, 0])["params"]
         dp0 = self.D.init(rd, data["b"][0, 0])["params"]
         dps = jax.tree.map(lambda v: jnp.broadcast_to(v, (C,) + v.shape), dp0)
@@ -228,7 +264,7 @@ class AsDGan:
             for s in range(S):
                 a, b = data["a"][:, s], data["b"][:, s]
                 dps, dss, dl = self._d_steps(dps, dss, gp, a, b)
-                gp, gs, gl = self._g_step(gp, gs, dps, a, weights)
+                gp, gs, gl = self._g_step(gp, gs, dps, a, b, weights)
                 # keep device scalars async; host-sync once per epoch
                 d_losses.append(jnp.mean(dl))
                 g_losses.append(gl)

@@ -75,6 +75,8 @@ class ExperimentConfig:
     topology_neighbors_num_directed: int = 4
     time_varying: bool = False           # regenerate graph each iteration
     temperature: float = 3.0             # FedGKT KD temperature
+    lambda_l1: float = 0.0               # AsDGan G reconstruction L1 term
+    lambda_perceptual: float = 0.0       # AsDGan G VGG-feature term
     fednas_layers: int = 3               # DARTS search depth
     fednas_channels: int = 8             # DARTS init channels
     fednas_steps: int = 2                # DARTS cell steps

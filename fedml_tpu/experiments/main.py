@@ -417,7 +417,9 @@ def run_asdgan(cfg, data, mesh, sink):
     b = jnp.asarray(cohort["x"])
     noise = jax.random.normal(jax.random.key(cfg.seed), b.shape) * 0.3
     algo = AsDGan(CondGenerator(out_channels=ch), PatchDiscriminator(),
-                  AsDGanConfig(epochs=cfg.comm_round, seed=cfg.seed))
+                  AsDGanConfig(epochs=cfg.comm_round, seed=cfg.seed,
+                               lambda_l1=cfg.lambda_l1,
+                               lambda_perceptual=cfg.lambda_perceptual))
     out = algo.run({"a": b + noise, "b": b,
                     "num_samples": jnp.asarray(cohort["num_samples"])})
     for h in out["history"]:

@@ -4,7 +4,8 @@ from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow
 from fedml_tpu.models.norms import Norm
 from fedml_tpu.models.resnet import (
     CifarResNet, ImageNetResNet, resnet56, resnet110, resnet18_gn)
-from fedml_tpu.models.vgg import VGG, vgg11, vgg13, vgg16
+from fedml_tpu.models.vgg import (VGG, vgg11, vgg13, vgg16, VGG16Features,
+                                  perceptual_loss)
 from fedml_tpu.models.mobilenet import (
     MobileNetV1, MobileNetV3, mobilenet, mobilenet_v3)
 from fedml_tpu.models.efficientnet import EfficientNet, efficientnet

@@ -62,3 +62,54 @@ def vgg13(num_classes: int = 1000, norm: str = "none") -> VGG:
 
 def vgg16(num_classes: int = 1000, norm: str = "none") -> VGG:
     return VGG(cfg=_CFGS["D"], num_classes=num_classes, norm=norm)
+
+
+class VGG16Features(nn.Module):
+    """The reference's perceptual-loss feature extractor
+    (``perception_loss.py:6-23 vgg16_feat``): VGG16 conv trunk tapped at
+    relu1_2 / relu2_2 / relu3_3 / relu4_3.
+
+    Weights: the reference downloads torchvision's pretrained VGG16; in an
+    air-gapped deployment TRUNCATE a torchvision ``vgg16`` state_dict to its
+    first 10 conv modules (this trunk stops at relu4_3) and import with
+    `fedml_tpu.utils.torch_import.import_torch_state_dict` — the importer
+    matches unit counts, so the full 13-conv + 3-dense checkpoint is
+    rejected untrimmed.  Random init still yields a usable
+    structural-similarity loss (Ulyanov'18-style)."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        taps = {}
+        # torchvision feature indices 3/8/15/22 fall after these conv counts
+        tap_after = {2: "relu1_2", 4: "relu2_2", 7: "relu3_3", 10: "relu4_3"}
+        conv_i = 0
+        for v in _CFGS["D"]:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.relu(nn.Conv(v, (3, 3), padding="SAME",
+                                    kernel_init=conv_kernel_init)(x))
+                conv_i += 1
+                if conv_i in tap_after:
+                    taps[tap_after[conv_i]] = x
+                if conv_i == 10:
+                    break
+        return taps
+
+
+def perceptual_loss(feat_params, feat_model: VGG16Features, x1, x2):
+    """MSE over the four tapped VGG16 feature maps
+    (``perception_loss.py:26-47``) — the AsDGan G objective's perceptual
+    term.  Inputs are NHWC in [0, 1]-ish range; single-channel inputs are
+    broadcast to RGB like the reference's 1->3 repeat."""
+    import jax.numpy as jnp
+
+    def rgb(x):
+        return jnp.repeat(x, 3, axis=-1) if x.shape[-1] == 1 else x
+
+    f1 = feat_model.apply({"params": feat_params}, rgb(x1))
+    f2 = feat_model.apply({"params": feat_params}, rgb(x2))
+    loss = 0.0
+    for k in ("relu1_2", "relu2_2", "relu3_3", "relu4_3"):
+        loss = loss + jnp.mean((f1[k] - f2[k]) ** 2)
+    return loss
