@@ -173,6 +173,10 @@ class AsDGan:
         self.cfg = cfg
         self.g_opt = optax.adam(cfg.lr_g, b1=0.5)
         self.d_opt = optax.adam(cfg.lr_d, b1=0.5)
+        if feat_params is not None and feat_model is None:
+            raise ValueError(
+                "feat_params were provided without feat_model; pass both "
+                "(params must match the feature architecture)")
         self._feat_params = feat_params
         self._feat_model = feat_model
         self._build()
@@ -246,10 +250,6 @@ class AsDGan:
                 x0 = jnp.repeat(x0, 3, -1) if x0.shape[-1] == 1 else x0
                 self._feat_params = self._feat_model.init(
                     jax.random.fold_in(rng, 77), x0)["params"]
-            else:
-                raise ValueError(
-                    "feat_params were provided without feat_model; pass "
-                    "both (params must match the feature architecture)")
         gp = self.G.init(rg, data["a"][0, 0])["params"]
         dp0 = self.D.init(rd, data["b"][0, 0])["params"]
         dps = jax.tree.map(lambda v: jnp.broadcast_to(v, (C,) + v.shape), dp0)

@@ -35,18 +35,17 @@ def create_workload(model_name: str, dataset: str, class_num: int,
     classification workloads (f32 master params, bf16 model compute)."""
     import jax.numpy as jnp
     dtype = jnp.dtype(compute_dtype) if compute_dtype else None
-    if dtype is not None and (dataset in _NWP_DATASETS
-                              or dataset == "stackoverflow_lr"):
+    if dtype is not None and dataset == "stackoverflow_lr":
         raise ValueError(
-            f"--compute_dtype is only wired into the classification "
-            f"workloads; dataset {dataset!r} uses an NWP/tag workload that "
-            f"would silently ignore it")
+            f"--compute_dtype is not wired into the tag-prediction "
+            f"workload; dataset {dataset!r} would silently ignore it")
     if dataset in _NWP_DATASETS:
         if dataset == "stackoverflow_nwp":
-            model = RNNStackOverflow()          # rnn.py:39-70
+            model = RNNStackOverflow(dtype=dtype)          # rnn.py:39-70
         else:
-            model = RNNOriginalFedAvg(vocab_size=class_num)  # rnn.py:4-36
-        return NWPWorkload(model)
+            model = RNNOriginalFedAvg(vocab_size=class_num,
+                                      dtype=dtype)          # rnn.py:4-36
+        return NWPWorkload(model, compute_dtype=dtype)
     if dataset == "stackoverflow_lr":
         model = LogisticRegression(int(np.prod(sample_shape)), class_num)
         return TagPredictionWorkload(model)

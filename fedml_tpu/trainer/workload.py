@@ -167,17 +167,27 @@ def ClassificationWorkload(model, num_classes: int,
 
 
 def NWPWorkload(model, pad_id: int = 0,
-                grad_clip_norm: Optional[float] = None) -> Workload:
+                grad_clip_norm: Optional[float] = None,
+                compute_dtype=None) -> Workload:
     """Next-word/char prediction: model emits [B, T, V] logits; CE averaged
     over non-pad positions of valid rows (my_model_trainer_nwp.py semantics,
-    where torch CE with [B, V, T] logits means per-position CE)."""
+    where torch CE with [B, V, T] logits means per-position CE).
+
+    ``compute_dtype=jnp.bfloat16``: casts params for bf16 weight loads and
+    f32 master/CE as in ClassificationWorkload — but flax RNN cells promote
+    to their own ``dtype``, so the MODEL must also be built with
+    ``dtype=bfloat16`` (RNNOriginalFedAvg/RNNStackOverflow take it;
+    create_workload wires both) or the recurrent matmuls stay f32."""
 
     def _position_mask(batch):
         tok_valid = (batch["y"] != pad_id).astype(jnp.float32)
         return tok_valid * batch["mask"][:, None]
 
     def loss_fn(params, batch, rng, train):
+        if compute_dtype is not None:
+            params = cast_floats(params, compute_dtype)
         logits = model.apply({"params": params}, batch["x"], train=train)
+        logits = logits.astype(jnp.float32)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
         m = _position_mask(batch)
         loss = jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)

@@ -192,3 +192,20 @@ def test_multiprocess_distributed_matches_single(tmp_path):
                                single["train_acc"], rtol=1e-6)
     np.testing.assert_allclose(results[0]["train_loss"],
                                single["train_loss"], rtol=1e-5)
+
+
+@pytest.mark.parametrize("dataset", [
+    "shakespeare",
+    pytest.param("stackoverflow_nwp", marks=pytest.mark.slow),
+    "stackoverflow_lr"])
+def test_cli_sequence_and_tag_datasets(dataset, tmp_path):
+    """The NWP/tag dataset axis end-to-end through the CLI (this path held
+    a latent logits-shape bug precisely because only --dataset mnist was
+    smoke-tested)."""
+    argv = ["--algo", "fedavg", "--dataset", dataset,
+            "--client_num_in_total", "4", "--client_num_per_round", "2",
+            "--comm_round", "1", "--batch_size", "4", "--epochs", "1",
+            "--frequency_of_the_test", "1", "--log_stdout", "false",
+            "--run_dir", str(tmp_path / dataset)]
+    summary = main(argv)
+    assert np.isfinite(summary.get("train_loss", np.inf))
