@@ -71,7 +71,8 @@ def _synth_clients(n_clients, samples, shape, classes, seed=0):
     return xs, ys
 
 
-def _build_step(model, classes, lr, epochs, batch_size, xs, ys, mesh=None):
+def _build_step(model, classes, lr, epochs, batch_size, xs, ys, mesh=None,
+                workload=None):
     import jax
     import jax.numpy as jnp
     from fedml_tpu.data.stacking import stack_client_data, gather_cohort
@@ -81,8 +82,9 @@ def _build_step(model, classes, lr, epochs, batch_size, xs, ys, mesh=None):
                                             make_client_optimizer)
 
     stacked = stack_client_data(xs, ys, batch_size)
-    workload = ClassificationWorkload(model, num_classes=classes,
-                                      compute_dtype=_compute_dtype())
+    if workload is None:
+        workload = ClassificationWorkload(model, num_classes=classes,
+                                          compute_dtype=_compute_dtype())
     local = make_local_trainer(workload,
                                make_client_optimizer("sgd", lr), epochs)
     step = make_cohort_step(local, mesh=mesh)
@@ -255,6 +257,25 @@ def bench_resnet56_cifar10(rounds, mesh=None, samples=512):
     return _measure(step, params, stacked, 10, 10, rounds)
 
 
+def bench_shakespeare_rnn(rounds, clients_per_round=10):
+    """The NLP family config (benchmark/README.md shakespeare row): 2-layer
+    LSTM(256) char LM, B=4, seq 80 — recurrence compiles to lax.scan."""
+    from fedml_tpu.experiments.models import create_workload
+
+    rng = np.random.RandomState(0)
+    samples = int(os.environ.get("BENCH_RNN_SAMPLES", "32"))
+    xs = [rng.randint(1, 90, (samples, 80)).astype(np.int32)
+          for _ in range(max(32, clients_per_round))]
+    ys = [np.concatenate([x[:, 1:], x[:, :1]], axis=1) for x in xs]
+    # create_workload owns the model-dtype/workload-dtype coupling
+    wl = create_workload("rnn", "shakespeare", 90, (80,),
+                         compute_dtype=os.environ.get("BENCH_DTYPE", ""))
+    step, params, stacked = _build_step(
+        None, 90, lr=0.8, epochs=1, batch_size=4, xs=xs, ys=ys, workload=wl)
+    return _measure(step, params, stacked, clients_per_round, len(xs),
+                    rounds)
+
+
 def bench_torch_baseline(clients_per_round=10, batch_size=20):
     """The reference's standalone simulator loop (sequential clients,
     fedavg_api.py:52-66) in torch on this host's CPU — an architectural
@@ -347,6 +368,13 @@ def main():
         details["configs"]["resnet56_cifar10_c10_b64"] = {"mfu": 0.0,
                                                           "skipped": "cpu"}
 
+    # 2b) NLP family: shakespeare char-LM (skipped on CPU fallback)
+    if not on_cpu:
+        rnn_s, rnn_fl = bench_shakespeare_rnn(max(3, rounds // 4))
+        details["configs"]["shakespeare_rnn_c10_b4"] = {
+            "round_s": rnn_s, "rounds_per_s": 1.0 / rnn_s,
+            "flops_per_round": rnn_fl, "mfu": _mfu(rnn_fl, rnn_s)}
+
     # 3) cohort scaling curve
     if os.environ.get("BENCH_SCALING", "1") != "0":
         curve = {}
@@ -366,6 +394,21 @@ def main():
                                    clients_per_round=max(16, n), mesh=mesh)
         details["configs"][f"femnist_cnn_mesh{n}"] = {
             "rounds_per_s": 1.0 / rs, "mfu": _mfu(fl, rs)}
+
+    # sanity: MFU needs achieved-flops <= peak; XLA cost_analysis can
+    # overcount (it models the unfused HLO), so flag near/over-peak values
+    # instead of reporting them as utilization
+    suspect = []
+    for name, c in list(details["configs"].items()) + [
+            (f"scaling_{k}", v)
+            for k, v in details.get("cohort_scaling", {}).items()]:
+        if c.get("mfu", 0.0) > 0.95:
+            suspect.append(name)
+    if suspect:
+        details["mfu_warning"] = (
+            "mfu > 0.95 for " + ", ".join(suspect) + " — XLA cost-analysis "
+            "flops likely overcount vs the fused executable; treat these "
+            "as upper bounds, trust round_s/step_time_ms")
 
     # baseline + primary line
     torch_s = bench_torch_baseline()
