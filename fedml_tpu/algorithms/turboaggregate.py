@@ -57,6 +57,7 @@ class TurboAggregateConfig:
     # saturated element decodes with flipped sign (see __init__ assert)
     quant_scale: float = 2.0**15
     quant_clip: float = 2.0**14
+    secagg_backend: str = "xla"   # "pallas": fused quantize+mask kernel
     # secret entropy for the LCC masking chunks; None = fresh per instance.
     # MUST stay secret from share holders — seeding from public values (e.g.
     # the group index) voids T-privacy entirely.
@@ -82,7 +83,8 @@ class TurboAggregate:
             make_local_trainer(workload, opt, config.epochs),
             in_axes=(None, 0, 0)))
         self.secagg = SecureCohortAggregator(
-            config.clients_per_group, config.quant_scale, config.quant_clip)
+            config.clients_per_group, config.quant_scale, config.quant_clip,
+            backend=config.secagg_backend)
         self._masked_group_sum = jax.jit(self._masked_group_sum_impl)
 
     # -- one group's secure cohort aggregate --------------------------------

@@ -65,6 +65,7 @@ class ExperimentConfig:
     group_num: int = 2                   # hierarchical / turboaggregate
     group_comm_round: int = 2            # hierarchical
     drop_tolerance: int = 1              # turboaggregate
+    secagg_backend: str = "xla"          # turboaggregate: "xla" | "pallas"
     neighbor_num: int = 2                # decentralized topology
     # decentralized online learning (standalone/decentralized main_dol.py)
     mode: str = "DOL"                    # "DOL" | "PUSHSUM" | "LOCAL"

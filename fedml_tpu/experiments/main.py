@@ -324,7 +324,8 @@ def run_turboaggregate(cfg, data, mesh, sink):
         comm_round=cfg.comm_round, group_num=cfg.group_num,
         clients_per_group=clients_per_group,
         drop_tolerance=cfg.drop_tolerance, epochs=cfg.epochs, lr=cfg.lr,
-        client_optimizer=cfg.client_optimizer, seed=cfg.seed))
+        client_optimizer=cfg.client_optimizer, seed=cfg.seed,
+        secagg_backend=cfg.secagg_backend))
     sample = jax.tree.map(lambda v: jax.numpy.asarray(v[0, 0]),
                           {k: data.train[k] for k in ("x", "y", "mask")})
     params = wl.init(jax.random.key(cfg.seed), sample)

@@ -99,3 +99,18 @@ def test_aggregator_pallas_backend_weighted_mean():
     want = (np.asarray(updates["w"]) * w[:, None, None]).sum(0)
     np.testing.assert_allclose(np.asarray(got["w"]), want,
                                atol=N / SCALE * 2)
+
+
+def test_turboaggregate_pallas_backend_cli():
+    """--secagg_backend pallas end-to-end through the CLI; result within
+    noise of the xla backend (different mask streams, same cancellation)."""
+    from fedml_tpu.experiments.main import main
+    base = ["--algo", "turboaggregate", "--model", "lr", "--dataset",
+            "mnist", "--client_num_in_total", "8", "--client_num_per_round",
+            "4", "--group_num", "2", "--comm_round", "2", "--batch_size",
+            "4", "--log_stdout", "false"]
+    s_xla = main(base + ["--secagg_backend", "xla"])
+    s_pal = main(base + ["--secagg_backend", "pallas"])
+    # masks cancel in both: the dequantized aggregates differ only by
+    # fixed-point rounding, so accuracies should be essentially equal
+    assert abs(s_xla["train_acc"] - s_pal["train_acc"]) < 0.05, (s_xla, s_pal)
