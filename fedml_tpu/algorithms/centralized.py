@@ -37,7 +37,6 @@ class CentralizedTrainer:
         return params
 
     def metrics(self, params, data: Dict) -> Dict[str, float]:
+        from fedml_tpu.utils.metrics import stats_from_metrics
         m = self.evaluate(params, jax.tree.map(jax.numpy.asarray, data))
-        total = max(float(m["total"]), 1.0)
-        return {"acc": float(m["correct"]) / total,
-                "loss": float(m["loss_sum"]) / total}
+        return stats_from_metrics(m)

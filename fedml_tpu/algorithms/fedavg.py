@@ -269,8 +269,7 @@ class FedAvg:
                 from fedml_tpu.parallel.cohort import pad_clients
                 batch = pad_clients(batch, self.mesh.shape["clients"])
                 batch = stage_global(batch, self.mesh, P("clients"))
+            from fedml_tpu.utils.metrics import stats_from_metrics
             m = self._eval_cohort(params, batch)
-            total = float(m["total"])
-            out[f"{split}_acc"] = float(m["correct"]) / max(total, 1.0)
-            out[f"{split}_loss"] = float(m["loss_sum"]) / max(total, 1.0)
+            out.update(stats_from_metrics(m, prefix=f"{split}_"))
         return out

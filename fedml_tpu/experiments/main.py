@@ -108,10 +108,9 @@ def _eval_global(workload, params, data) -> Dict[str, float]:
     for split, stacked in (("train", data.train), ("test", data.test)):
         if stacked is None:
             continue
+        from fedml_tpu.utils.metrics import stats_from_metrics
         m = ev(params, {k: jax.numpy.asarray(v) for k, v in stacked.items()})
-        total = max(float(m["total"]), 1.0)
-        out[f"{split}_acc"] = float(m["correct"]) / total
-        out[f"{split}_loss"] = float(m["loss_sum"]) / total
+        out.update(stats_from_metrics(m, prefix=f"{split}_"))
     return out
 
 

@@ -156,11 +156,18 @@ def ClassificationWorkload(model, num_classes: int,
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
         pred = jnp.argmax(logits, axis=-1)
         mask = batch["mask"]
-        return {
+        out = {
             "correct": jnp.sum((pred == batch["y"]) * mask),
             "loss_sum": jnp.sum(ce * mask),
             "total": jnp.sum(mask),
         }
+        if num_classes > 5:
+            # top-5 parity with the reference's accTop5 curves
+            # (pretrained/*/train_metrics)
+            top5 = jax.lax.top_k(logits, 5)[1]
+            in5 = jnp.any(top5 == batch["y"][..., None], axis=-1)
+            out["correct_top5"] = jnp.sum(in5 * mask)
+        return out
 
     return Workload(model=model, loss_fn=loss_fn, metric_fn=metric_fn,
                     grad_clip_norm=grad_clip_norm, stateful=stateful)

@@ -100,6 +100,18 @@ class MetricsSink:
         return False
 
 
+def stats_from_metrics(m, prefix: str = "") -> Dict[str, float]:
+    """Summable metric dict {correct, loss_sum, total, correct_top5?} ->
+    reported stats {acc, loss, acc_top5?} — THE one derivation, shared by
+    every eval path so new metric keys cannot drift between them."""
+    total = max(float(m["total"]), 1.0)
+    out = {f"{prefix}acc": float(m["correct"]) / total,
+           f"{prefix}loss": float(m["loss_sum"]) / total}
+    if "correct_top5" in m:
+        out[f"{prefix}acc_top5"] = float(m["correct_top5"]) / total
+    return out
+
+
 @contextlib.contextmanager
 def profiler_trace(trace_dir: Optional[str]):
     """Capture a jax/XLA profiler trace into ``trace_dir`` (viewable with

@@ -209,3 +209,13 @@ def test_cli_sequence_and_tag_datasets(dataset, tmp_path):
             "--run_dir", str(tmp_path / dataset)]
     summary = main(argv)
     assert np.isfinite(summary.get("train_loss", np.inf))
+
+
+def test_cli_profiler_trace(tmp_path):
+    """--profile_dir captures a jax profiler trace alongside the run
+    (SURVEY §5.1 observability; the reference has no profiling at all)."""
+    prof = tmp_path / "trace"
+    main(["--algo", "fedavg", "--model", "lr", "--dataset", "mnist",
+          "--profile_dir", str(prof)] + _BASE)
+    captured = list(prof.rglob("*.pb")) + list(prof.rglob("*.json.gz"))
+    assert captured, f"no trace artifacts under {prof}"

@@ -328,3 +328,26 @@ def test_bf16_compute_dtype_mixed_precision():
     assert runs["bf16"][1] > 0.9 and runs["f32"][1] > 0.9
     jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=0.08),
                  runs["f32"][0], runs["bf16"][0])
+
+
+def test_top5_metric_reported_for_wide_label_spaces():
+    """accTop5 parity with the reference's stored curves: reported when
+    class_num > 5, bounded below by top-1."""
+    import flax.linen as nn
+
+    class Wide(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(20)(x.reshape((x.shape[0], -1)))
+
+    xs, ys = _synthetic_clients(n_clients=4, classes=4)
+    ys = [np.minimum(y + 10, 19).astype(np.int32) for y in ys]
+    data = _make_fed_data(xs, ys, batch_size=8, classes=20)
+    wl = ClassificationWorkload(Wide(), num_classes=20, grad_clip_norm=None)
+    algo = FedAvg(wl, data, FedAvgConfig(
+        comm_round=3, client_num_per_round=4, epochs=1, batch_size=8,
+        lr=0.3, frequency_of_the_test=100))
+    p = algo.run(rng=jax.random.key(1))
+    stats = algo.evaluate_global(p)
+    assert "train_acc_top5" in stats
+    assert stats["train_acc_top5"] >= stats["train_acc"]
