@@ -55,6 +55,25 @@ def make_two_level_mesh(group_axis: int, client_axis: Optional[int] = None,
     return Mesh(arr, ("groups", "clients"))
 
 
+def tp_shard_params(params: Any, mesh: Mesh, axis: str = "model",
+                    min_size: int = 4096) -> Any:
+    """GSPMD tensor-parallel placement: put each large 2-D kernel's output
+    dim on the ``axis`` mesh axis (replicate everything else) and let XLA
+    insert the collectives when the (vmapped) training step is jitted over
+    the same mesh — dp over ``clients`` x tp over ``axis`` with no manual
+    shard_map (SURVEY.md §2.5: tensor parallel is "a config knob, not an
+    algorithm").  Works with the PLAIN make_cohort_step (mesh=None form)."""
+    n = mesh.shape[axis]
+
+    def place(x):
+        if (getattr(x, "ndim", 0) == 2 and x.shape[-1] % n == 0
+                and x.size >= min_size):
+            return jax.device_put(x, NamedSharding(mesh, P(None, axis)))
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return jax.tree.map(place, params)
+
+
 def client_axis_size(mesh: Optional[Mesh]) -> int:
     if mesh is None:
         return 1
