@@ -76,11 +76,11 @@ def lr_predict(params: Pytree, x: jax.Array) -> jax.Array:
 
 
 def bce_with_logits(logit: jax.Array, y: jax.Array) -> jax.Array:
-    """Numerically-stable sigmoid + BCE via log_sigmoid (smooth everywhere —
-    the max(z,0)-z*y+log1p(exp(-|z|)) form has an ambiguous subgradient at
-    z=0, exactly where zero-init starts)."""
-    return -(y * jax.nn.log_sigmoid(logit)
-             + (1.0 - y) * jax.nn.log_sigmoid(-logit))
+    """Numerically-stable sigmoid + BCE (optax's log_sigmoid formulation —
+    smooth everywhere, unlike the max(z,0)-z*y+log1p(exp(-|z|)) form whose
+    subgradient is ambiguous at z=0, exactly where zero-init starts)."""
+    import optax
+    return optax.sigmoid_binary_cross_entropy(logit, y)
 
 
 # --------------------------------------------------------------------------
@@ -108,6 +108,12 @@ def _topology_bank(cfg: DecentralizedOnlineConfig, n: int,
     n_iter); static keeps ONE matrix (K = 1) so the scan doesn't haul
     n_iter copies of W through HBM."""
     if cfg.time_varying:
+        if cfg.b_symmetric:
+            raise ValueError(
+                "time_varying topology requires b_symmetric=False: the "
+                "symmetric Watts-Strogatz(p=0) graph is deterministic, so "
+                "'regenerating' it every iteration would silently produce "
+                "an identical (static) topology")
         return np.stack([make_topology(cfg, n, seed=t)
                          for t in range(n_iter)])
     return make_topology(cfg, n)[None]

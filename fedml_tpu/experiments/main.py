@@ -576,9 +576,14 @@ def main(argv=None) -> Dict[str, Any]:
         raise ValueError(
             f"--compute_dtype is not wired into --algo {cfg.algo}; "
             f"supported: {sorted(_DTYPE_RUNNERS)}")
-    data = load_experiment_data(cfg)
-    logger.info("algo=%s model=%s dataset=%s clients=%d (%s data)",
-                cfg.algo, cfg.model, cfg.dataset, data.client_num,
+    # decentralized_online consumes a streaming dataset (UCI SUSY/RO or a
+    # synthetic stream) that the registry doesn't serve — its runner builds
+    # it; loading here would KeyError on --dataset SUSY
+    data = (None if cfg.algo == "decentralized_online"
+            else load_experiment_data(cfg))
+    logger.info("algo=%s model=%s dataset=%s clients=%s (%s data)",
+                cfg.algo, cfg.model, cfg.dataset,
+                "stream" if data is None else data.client_num,
                 "real" if cfg.data_dir else "synthetic-twin")
 
     # multi-host: only process 0 writes run artifacts / prints the summary

@@ -197,9 +197,9 @@ def test_multiprocess_distributed_matches_single(tmp_path):
 @pytest.mark.parametrize("dataset", [
     "shakespeare",
     pytest.param("stackoverflow_nwp", marks=pytest.mark.slow),
-    "stackoverflow_lr"])
-def test_cli_sequence_and_tag_datasets(dataset, tmp_path):
-    """The NWP/tag dataset axis end-to-end through the CLI (this path held
+    "stackoverflow_lr", "fed_cifar100", "cinic10"])
+def test_cli_dataset_axis(dataset, tmp_path):
+    """The dataset axis end-to-end through the CLI (this path held
     a latent logits-shape bug precisely because only --dataset mnist was
     smoke-tested)."""
     argv = ["--algo", "fedavg", "--dataset", dataset,
