@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 
 from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.core.pallas_agg import make_fused_robust_aggregate
 from fedml_tpu.core.robust import add_gaussian_noise, clip_update
 from fedml_tpu.parallel.cohort import make_cohort_step
 from fedml_tpu.trainer.local_sgd import make_local_trainer
@@ -26,6 +27,8 @@ class FedAvgRobustConfig(FedAvgConfig):
     defense: str = "weak_dp"     # "norm_diff_clipping" | "weak_dp" | "none"
     norm_bound: float = 5.0
     stddev: float = 0.025        # reference default for weak DP
+    defense_backend: str = "xla"  # "xla" | "pallas" (fused kernel,
+    #                                core/pallas_agg.py; single-chip only)
 
 
 class FedAvgRobust(FedAvg):
@@ -37,6 +40,31 @@ class FedAvgRobust(FedAvg):
         if cfg.defense not in self.DEFENSES:
             raise ValueError(f"unknown defense {cfg.defense!r}; "
                              f"available: {self.DEFENSES}")
+        if cfg.defense_backend not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown defense_backend {cfg.defense_backend!r}; "
+                f"available: ('xla', 'pallas')")
+
+        opt = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
+        local_train = make_local_trainer(workload, opt, cfg.epochs)
+
+        if cfg.defense_backend == "pallas" and cfg.defense != "none":
+            # fused clip+noise+mean: one VMEM pass, no transformed [N, D]
+            # copies in HBM (core/pallas_agg.py).  The clip norm is global
+            # across the cohort, so this path is single-chip; mesh-sharded
+            # runs use the XLA transform hook.
+            if mesh is not None:
+                raise ValueError("defense_backend='pallas' does not shard "
+                                 "over a mesh; drop --mesh_clients or use "
+                                 "the xla backend")
+            import jax
+            fused = make_fused_robust_aggregate(
+                norm_bound=(cfg.norm_bound if cfg.defense in
+                            ("norm_diff_clipping", "weak_dp") else None),
+                noise_std=(cfg.stddev if cfg.defense == "weak_dp" else 0.0),
+                interpret=jax.default_backend() != "tpu")
+            self.cohort_step = make_cohort_step(local_train, aggregate=fused)
+            return
 
         def transform(client_params, global_params, rng):
             p = client_params
@@ -46,8 +74,6 @@ class FedAvgRobust(FedAvg):
                 p = add_gaussian_noise(p, rng, cfg.stddev)
             return p
 
-        opt = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
-        local_train = make_local_trainer(workload, opt, cfg.epochs)
         self.cohort_step = make_cohort_step(
             local_train, mesh=mesh,
             transform_update=None if cfg.defense == "none" else transform)

@@ -55,6 +55,8 @@ class ExperimentConfig:
     norm_bound: float = 5.0              # robust: clip threshold
     stddev: float = 0.025                # robust: weak-DP noise
     defense: str = "weak_dp"             # robust: defense type | "none"
+    defense_backend: str = "xla"         # robust: "xla" | "pallas" (fused
+    #                                      clip+noise+mean, core/pallas_agg)
     # robust: backdoor attack evaluation (poison_type pipeline,
     # FedAvgRobustAggregator.py:14-45, 270)
     backdoor: bool = False               # poison attacker shards + eval

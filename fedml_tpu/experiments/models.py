@@ -17,8 +17,8 @@ import numpy as np
 from fedml_tpu.data.stacking import FederatedData
 from fedml_tpu.models import (
     CNNDropOut, CNNOriginalFedAvg, LogisticRegression, RNNOriginalFedAvg,
-    RNNStackOverflow, efficientnet, mobilenet, mobilenet_v3, resnet18_gn,
-    resnet56, resnet110, vgg11, vgg13, vgg16)
+    RNNStackOverflow, TransformerLM, efficientnet, mobilenet, mobilenet_v3,
+    resnet18_gn, resnet56, resnet110, vgg11, vgg13, vgg16)
 from fedml_tpu.trainer.workload import (
     ClassificationWorkload, NWPWorkload, TagPredictionWorkload, Workload)
 
@@ -40,7 +40,12 @@ def create_workload(model_name: str, dataset: str, class_num: int,
             f"--compute_dtype is not wired into the tag-prediction "
             f"workload; dataset {dataset!r} would silently ignore it")
     if dataset in _NWP_DATASETS:
-        if dataset == "stackoverflow_nwp":
+        if model_name == "transformer":
+            # the attention member of the NLP family (no reference analog —
+            # its zoo stops at LSTMs, rnn.py:18-22); per-position logits,
+            # same NWPWorkload contract, ring-attention capable
+            model = TransformerLM(vocab_size=class_num, dtype=dtype)
+        elif dataset == "stackoverflow_nwp":
             model = RNNStackOverflow(dtype=dtype)          # rnn.py:39-70
         else:
             model = RNNOriginalFedAvg(vocab_size=class_num,

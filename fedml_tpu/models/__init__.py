@@ -20,3 +20,4 @@ from fedml_tpu.models.gan import (
     Generator, Discriminator, CondGenerator, PatchDiscriminator)
 from fedml_tpu.models.segmentation import (
     DeepLabV3Plus, UNet, AlignedXception, ResNetBackbone, ASPP)
+from fedml_tpu.models.transformer import TransformerLM, CausalSelfAttention

@@ -1,0 +1,92 @@
+"""Decoder-only transformer LM — the attention member of the NLP family.
+
+The reference's NLP zoo stops at LSTMs (fedml_api/model/nlp/rnn.py:4-70);
+this model is the modern drop-in for the same next-word/char-prediction
+workloads ([B, T] tokens in, [B, T, V] per-position logits out — the
+NWPWorkload contract), and the carrier for the framework's long-context
+story: pass ``ring_axis`` (inside a shard_map over a ``sequence`` mesh axis,
+see fedml_tpu.parallel.ring_attention) and the same parameters run with the
+sequence sharded across devices and exact ring attention over ICI.
+
+Architecture: pre-LN blocks (LN → causal MHA → residual, LN → GELU MLP →
+residual), learned positional embeddings, final LN → vocab head.  ``dtype``
+enables bf16 mixed precision the same way as the rest of the zoo (params
+stay f32; softmax/logits accumulate f32).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.parallel.ring_attention import full_attention, ring_attention
+
+
+class CausalSelfAttention(nn.Module):
+    n_heads: int
+    d_model: int
+    dtype: object = None
+
+    @nn.compact
+    def __call__(self, x, positions, ring_axis: Optional[str] = None):
+        d_head = self.d_model // self.n_heads
+        q = nn.DenseGeneral((self.n_heads, d_head), dtype=self.dtype,
+                            name="query")(x)
+        k = nn.DenseGeneral((self.n_heads, d_head), dtype=self.dtype,
+                            name="key")(x)
+        v = nn.DenseGeneral((self.n_heads, d_head), dtype=self.dtype,
+                            name="value")(x)
+        if ring_axis is None:
+            out = full_attention(q, k, v, positions, positions)
+        else:
+            out = ring_attention(q, k, v, positions, positions, ring_axis)
+        out = out.astype(x.dtype)
+        return nn.DenseGeneral(self.d_model, axis=(-2, -1),
+                               dtype=self.dtype, name="out")(out)
+
+
+class TransformerLM(nn.Module):
+    """Per-position next-token logits, causal.
+
+    ``positions`` are global token indices (default ``arange(T)``); under
+    sequence parallelism each shard passes its own offset block so the
+    positional embedding and causal mask stay globally correct."""
+    vocab_size: int
+    d_model: int = 128
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 512
+    max_len: int = 2048
+    dropout_rate: float = 0.0
+    dtype: object = None
+
+    @nn.compact
+    def __call__(self, input_seq, train: bool = False, positions=None,
+                 ring_axis: Optional[str] = None):
+        _, t = input_seq.shape
+        if positions is None:
+            positions = jnp.arange(t)
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                     name="tok_embed")(input_seq)
+        x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                         name="pos_embed")(positions)[None, :, :]
+        for i in range(self.n_layers):
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            h = CausalSelfAttention(self.n_heads, self.d_model,
+                                    dtype=self.dtype,
+                                    name=f"attn_{i}")(h, positions, ring_axis)
+            if self.dropout_rate:
+                h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+            x = x + h
+            h = nn.LayerNorm(dtype=self.dtype)(x)
+            h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.d_model, dtype=self.dtype)(h)
+            if self.dropout_rate:
+                h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+            x = x + h
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.vocab_size, dtype=self.dtype,
+                        name="lm_head")(x)

@@ -57,6 +57,15 @@ def train_cohort(local_train, params: Pytree, data: CohortData,
     return new_params, metrics
 
 
+def _call_aggregate(aggregate, stacked, weights, global_params, rng):
+    """Aggregates normally take (stacked, weights); fused kernels that also
+    need the round context (e.g. core.pallas_agg — clip is relative to the
+    global params, noise is keyed by the round rng) set ``needs_global``."""
+    if getattr(aggregate, "needs_global", False):
+        return aggregate(stacked, weights, global_params, rng)
+    return aggregate(stacked, weights)
+
+
 def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
                      aggregate=tree_weighted_mean,
                      transform_update=None) -> CohortStep:
@@ -82,7 +91,9 @@ def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
     if mesh is None:
         def step(global_params, cohort_data, rng):
             stacked, metrics = _train_cohort(global_params, cohort_data, rng)
-            new_global = aggregate(stacked, cohort_data["num_samples"])
+            new_global = _call_aggregate(aggregate, stacked,
+                                         cohort_data["num_samples"],
+                                         global_params, rng)
             return new_global, metrics
         return jax.jit(step)
 
@@ -163,7 +174,8 @@ def _device_round_body(local_train, aggregate, transform_update):
         stacked_out, metrics = train_cohort(
             local_train, params, cohort, rng,
             transform_update=transform_update)
-        return aggregate(stacked_out, cohort["num_samples"]), metrics
+        return _call_aggregate(aggregate, stacked_out,
+                               cohort["num_samples"], params, rng), metrics
 
     return body
 
