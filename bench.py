@@ -276,6 +276,49 @@ def bench_shakespeare_rnn(rounds, clients_per_round=10):
                     rounds)
 
 
+def bench_robust_backends(rounds, clients_per_round=10):
+    """Defended FedAvg round (clip + weak-DP), XLA transform hook vs the
+    fused Pallas aggregation kernel (core/pallas_agg.py) — same model and
+    hparams as the femnist headline so the delta is the defense path."""
+    import jax
+    from fedml_tpu.core.pallas_agg import make_fused_robust_aggregate
+    from fedml_tpu.core.robust import add_gaussian_noise, clip_update
+    from fedml_tpu.models import CNNOriginalFedAvg
+    from fedml_tpu.parallel.cohort import make_cohort_step
+    from fedml_tpu.trainer.local_sgd import make_local_trainer
+    from fedml_tpu.trainer.workload import (ClassificationWorkload,
+                                            make_client_optimizer)
+
+    xs, ys = _femnist_data(clients_per_round)
+    workload = ClassificationWorkload(CNNOriginalFedAvg(only_digits=False),
+                                      num_classes=FEMNIST_CLASSES,
+                                      compute_dtype=_compute_dtype())
+    local = make_local_trainer(
+        workload, make_client_optimizer("sgd", FEMNIST_LR), FEMNIST_EPOCHS)
+
+    def transform(client_params, global_params, rng):
+        p = clip_update(client_params, global_params, 5.0)
+        return add_gaussian_noise(p, rng, 0.025)
+
+    fused = make_fused_robust_aggregate(
+        norm_bound=5.0, noise_std=0.025,
+        interpret=jax.default_backend() != "tpu")
+    from fedml_tpu.data.stacking import stack_client_data
+    import jax.numpy as jnp
+    stacked = stack_client_data(xs, ys, FEMNIST_BATCH)
+    params = workload.init(jax.random.key(0), jax.tree.map(
+        lambda v: jnp.asarray(v[0, 0]),
+        {k: stacked[k] for k in ("x", "y", "mask")}))
+    out = {}
+    for name, step in (
+            ("xla", make_cohort_step(local, transform_update=transform)),
+            ("pallas", make_cohort_step(local, aggregate=fused))):
+        round_s, _ = _measure(step, params, stacked, clients_per_round,
+                              len(xs), rounds)
+        out[name] = round_s
+    return out
+
+
 def bench_torch_baseline(clients_per_round=10, batch_size=20):
     """The reference's standalone simulator loop (sequential clients,
     fedavg_api.py:52-66) in torch on this host's CPU — an architectural
@@ -374,6 +417,14 @@ def main():
         details["configs"]["shakespeare_rnn_c10_b4"] = {
             "round_s": rnn_s, "rounds_per_s": 1.0 / rnn_s,
             "flops_per_round": rnn_fl, "mfu": _mfu(rnn_fl, rnn_s)}
+
+    # 2c) defended aggregation: XLA transform hook vs fused Pallas kernel
+    # (skipped on CPU fallback: the interpreter path is not a perf number)
+    if not on_cpu:
+        rb = bench_robust_backends(max(3, rounds // 4))
+        details["configs"]["fedavg_robust_weakdp_c10"] = {
+            "round_s_xla": rb["xla"], "round_s_pallas": rb["pallas"],
+            "pallas_speedup": rb["xla"] / rb["pallas"]}
 
     # 3) cohort scaling curve
     if os.environ.get("BENCH_SCALING", "1") != "0":

@@ -108,13 +108,12 @@ def _agg_kernel(scales_ref, ratios_ref, seed_ref, x_ref, g_ref, o_ref, *,
 
 
 @functools.partial(jax.jit, static_argnames=("num_clients", "noise_std",
-                                             "interpret"))
+                                             "rows", "interpret"))
 def _agg_leaf(x3d, g2d, scales, ratios, seed, *, num_clients, noise_std,
-              interpret):
+              rows, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    rows = _rows_per_block(num_clients)
     total_rows = x3d.shape[1]
     grid = total_rows // rows
     kernel = functools.partial(_agg_kernel, num_clients=num_clients,
@@ -196,14 +195,17 @@ def make_fused_robust_aggregate(norm_bound: Optional[float] = None,
             # running stats are never clipped (robust_aggregation.py:28-30)
             leaf_scales = scales if is_weight(path) else ones
             flat = x.reshape(n, -1)
-            rows_mult = _rows_per_block(n) * _LANES
-            pad = (-flat.shape[1]) % rows_mult
+            # block rows: the VMEM budget cap, shrunk for small leaves so a
+            # 62-element bias pads to one 8x128 tile, not 256x128
+            leaf_rows = -(-flat.shape[1] // _LANES)       # ceil(size/128)
+            rows = min(_rows_per_block(n), leaf_rows + (-leaf_rows) % 8)
+            pad = (-flat.shape[1]) % (rows * _LANES)
             x3d = jnp.pad(flat, ((0, 0), (0, pad))).reshape(n, -1, _LANES)
             g2d = jnp.pad(g.reshape(-1), (0, pad)).reshape(-1, _LANES)
             agg = _agg_leaf(x3d, g2d, leaf_scales, ratios,
                             seed + jnp.int32(li * 31337),
                             num_clients=n, noise_std=float(noise_std),
-                            interpret=interpret)
+                            rows=rows, interpret=interpret)
             out.append(agg.reshape(-1)[:g.size].reshape(g.shape))
         return jax.tree.unflatten(treedef, out)
 

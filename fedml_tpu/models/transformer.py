@@ -21,13 +21,16 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedml_tpu.parallel.ring_attention import full_attention, ring_attention
+from fedml_tpu.parallel.ring_attention import (
+    blockwise_attention, full_attention, ring_attention)
 
 
 class CausalSelfAttention(nn.Module):
     n_heads: int
     d_model: int
     dtype: object = None
+    block_size: Optional[int] = None  # flash-style kv blocking (single-chip
+    #                                   long context); None = dense scores
 
     @nn.compact
     def __call__(self, x, positions, ring_axis: Optional[str] = None):
@@ -38,10 +41,13 @@ class CausalSelfAttention(nn.Module):
                             name="key")(x)
         v = nn.DenseGeneral((self.n_heads, d_head), dtype=self.dtype,
                             name="value")(x)
-        if ring_axis is None:
-            out = full_attention(q, k, v, positions, positions)
-        else:
+        if ring_axis is not None:
             out = ring_attention(q, k, v, positions, positions, ring_axis)
+        elif self.block_size is not None:
+            out = blockwise_attention(q, k, v, positions, positions,
+                                      self.block_size)
+        else:
+            out = full_attention(q, k, v, positions, positions)
         out = out.astype(x.dtype)
         return nn.DenseGeneral(self.d_model, axis=(-2, -1),
                                dtype=self.dtype, name="out")(out)
@@ -61,6 +67,7 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dropout_rate: float = 0.0
     dtype: object = None
+    block_size: Optional[int] = None  # see CausalSelfAttention
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False, positions=None,
@@ -76,6 +83,7 @@ class TransformerLM(nn.Module):
             h = nn.LayerNorm(dtype=self.dtype)(x)
             h = CausalSelfAttention(self.n_heads, self.d_model,
                                     dtype=self.dtype,
+                                    block_size=self.block_size,
                                     name=f"attn_{i}")(h, positions, ring_axis)
             if self.dropout_rate:
                 h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)

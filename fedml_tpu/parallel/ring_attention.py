@@ -96,6 +96,43 @@ def full_attention(q, k, v, q_pos, kv_pos, causal: bool = True) -> jax.Array:
     return out.transpose(0, 2, 1, 3)
 
 
+def blockwise_attention(q, k, v, q_pos, kv_pos, block_size: int,
+                        causal: bool = True) -> jax.Array:
+    """Single-device flash-style attention: `lax.scan` over key/value blocks
+    with the same online-softmax state as the ring — O(T·block) peak memory
+    for the scores instead of the dense path's O(T²), so one chip can run
+    sequences far past the [B, H, T, T] materialization limit.  Exact (same
+    accumulation as `full_attention`); the backward pass rematerializes each
+    block's scores through the scan's VJP.
+
+    ``block_size`` must divide the key length.
+    """
+    B, Tk, H, d = k.shape
+    if Tk % block_size:
+        raise ValueError(f"block_size {block_size} must divide key length "
+                         f"{Tk}")
+    Tq = q.shape[1]
+    n_blocks = Tk // block_size
+    k_b = k.reshape(B, n_blocks, block_size, H, d).transpose(1, 0, 2, 3, 4)
+    v_b = v.reshape(B, n_blocks, block_size, H, d).transpose(1, 0, 2, 3, 4)
+    pos_b = kv_pos.reshape(n_blocks, block_size)
+
+    m0 = jnp.full((B, H, Tq), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq), jnp.float32)
+    o0 = jnp.zeros((B, H, Tq, d), jnp.float32)
+
+    def scan_body(carry, blk):
+        m, l, o = carry
+        kb, vb, pb = blk
+        m, l, o = _online_softmax_block(q, kb, vb, q_pos, pb, m, l, o,
+                                        causal)
+        return (m, l, o), None
+
+    (m, l, o), _ = jax.lax.scan(scan_body, (m0, l0, o0), (k_b, v_b, pos_b))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3)
+
+
 def make_sequence_parallel_apply(model, mesh: Mesh,
                                  axis_name: str = "sequence"):
     """Jit ``model.apply`` with activations sharded on the sequence axis.
