@@ -41,7 +41,16 @@ class GrpcTransport(Transport):
     """One endpoint of a full gRPC mesh (every node runs a server)."""
 
     def __init__(self, node_id: int, ip_table: Dict[int, str],
-                 base_port: int = 50000, max_message_mb: int = 1000):
+                 base_port: int = 50000, max_message_mb: int = 1000,
+                 send_timeout_s: float = 120.0,
+                 idle_timeout_s: float = 0.0):
+        """``send_timeout_s`` bounds each unary send; sends also set
+        ``wait_for_ready`` so a broadcast to a peer that is still booting
+        blocks until its server binds instead of failing UNAVAILABLE (the
+        reference has the same race and papers over it with sleep-ordered
+        launches).  ``idle_timeout_s`` > 0 makes ``run()`` return after that
+        long with no traffic — without it a silo whose server died leaks
+        forever in the receive loop."""
         super().__init__()
         import grpc  # deferred: optional at import time of the package
         self._grpc = grpc
@@ -74,6 +83,8 @@ class GrpcTransport(Transport):
                 f"grpc transport node {node_id}: failed to bind port "
                 f"{base_port + node_id} (already in use?)")
         self._opts = opts
+        self._send_timeout_s = send_timeout_s
+        self._idle_timeout_s = idle_timeout_s
         self._server.start()
         log.info("grpc transport node %d listening on :%d", node_id, self._port)
 
@@ -88,11 +99,20 @@ class GrpcTransport(Transport):
         return self._channels[receiver_id][1]
 
     def send_message(self, msg: Message) -> None:
-        self._stub(msg.receiver_id)(msg.to_bytes())
+        self._stub(msg.receiver_id)(
+            msg.to_bytes(), wait_for_ready=True,
+            timeout=self._send_timeout_s or None)
 
     def run(self) -> None:
         while True:
-            item = self._inbox.get()
+            try:
+                item = self._inbox.get(
+                    timeout=self._idle_timeout_s or None)
+            except queue.Empty:
+                log.warning("grpc transport node %d: no traffic for %.0fs; "
+                            "shutting down receive loop", self.node_id,
+                            self._idle_timeout_s)
+                return
             if item is _STOP:
                 return
             self._notify(item)

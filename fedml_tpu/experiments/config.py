@@ -69,6 +69,15 @@ class ExperimentConfig:
     drop_tolerance: int = 1              # turboaggregate
     secagg_backend: str = "xla"          # turboaggregate: "xla" | "pallas"
     neighbor_num: int = 2                # decentralized topology
+    # cross-silo actor mode (distributed FedAvg over host transports;
+    # reference: run_fedavg_distributed_pytorch.sh + grpc_ipconfig.csv)
+    silo_backend: str = "local"          # "local" (in-process hub) | "grpc"
+    node_id: int = 0                     # grpc: 0=server, 1..N=silos
+    ip_config: str = ""                  # grpc: rank→IP csv (reference fmt)
+    base_port: int = 50000               # grpc: port = base_port + node_id
+    straggler_policy: str = "wait"       # wait | drop | abort
+    round_timeout_s: float = 0.0         # 0 = no straggler timer
+    min_silo_frac: float = 0.5           # drop-policy quorum
     # decentralized online learning (standalone/decentralized main_dol.py)
     mode: str = "DOL"                    # "DOL" | "PUSHSUM" | "LOCAL"
     iteration_number: int = 100          # stream length T per client
@@ -87,6 +96,13 @@ class ExperimentConfig:
     # ---- TPU placement (replaces gpu_mapping / mpirun) -----------------
     mesh_clients: int = 0     # >0: shard the cohort over this many devices
     mesh_groups: int = 0      # >0 (hierarchical): [groups, clients] mesh
+    mesh_sequence: int = 0    # >0 (fedavg + transformer): dp x sp
+    #                           [clients, sequence] mesh with ring attention
+    attn_block_size: int = 0  # >0 (transformer): flash-style kv blocking —
+    #                           O(T*block) attention memory for single-chip
+    #                           train/eval at long context
+    silo_idle_timeout_s: float = 0.0  # grpc silos: exit after this long
+    #                                   with no traffic (0 = wait forever)
     platform: Optional[str] = None       # force jax platform (e.g. "cpu")
     host_device_count: int = 0           # virtual CPU devices (simulation)
     coordinator_address: Optional[str] = None  # multi-host bootstrap

@@ -86,7 +86,8 @@ def _make_workload(cfg: ExperimentConfig, data):
     new construction knob is a one-line change here, not 9 edits)."""
     return create_workload(cfg.model, cfg.dataset, data.class_num,
                            sample_shape_of(data),
-                           compute_dtype=cfg.compute_dtype)
+                           compute_dtype=cfg.compute_dtype,
+                           attn_block_size=cfg.attn_block_size)
 
 
 def _make_checkpointer(cfg: ExperimentConfig):
@@ -140,8 +141,44 @@ def _image_sample_shape(cfg, data, algo: str):
 def run_fedavg(cfg, data, mesh, sink):
     from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
     wl = _make_workload(cfg, data)
-    algo = FedAvg(wl, data, FedAvgConfig(**_fedavg_cfg_kwargs(cfg)),
-                  mesh=mesh, sink=sink)
+    if cfg.mesh_sequence > 0:
+        # dp x sp: long-context federated training over a [clients,
+        # sequence] mesh (parallel/sequence.py) — ring attention + psum'd
+        # loss/grads inside each client, weighted psum across the cohort.
+        # The dense workload still drives init + eval (params identical).
+        from fedml_tpu.models import TransformerLM
+        from fedml_tpu.parallel.sequence import (
+            make_sp_cohort_step, make_sp_mesh, make_sp_nwp_workload)
+        from fedml_tpu.trainer.workload import make_client_optimizer
+        if cfg.model != "transformer":
+            raise ValueError("--mesh_sequence requires --model transformer "
+                             "(the ring-attention-capable model)")
+        if not cfg.attn_block_size:
+            logging.getLogger(__name__).warning(
+                "--mesh_sequence without --attn_block_size: init/eval run "
+                "DENSE attention on one chip (O(T^2) scores); set "
+                "--attn_block_size for sequence lengths that only fit "
+                "sharded")
+        if mesh is not None:
+            raise ValueError("--mesh_sequence and --mesh_clients build one "
+                             "combined [clients, sequence] mesh; pass "
+                             "--mesh_sequence S with client sharding "
+                             "implied by the remaining devices")
+        import jax
+        n_dev = len(jax.devices())
+        n_cli = max(1, n_dev // cfg.mesh_sequence)
+        algo = FedAvg(wl, data, FedAvgConfig(**_fedavg_cfg_kwargs(cfg)),
+                      mesh=None, sink=sink)
+        sp_wl = make_sp_nwp_workload(wl.model)
+        algo.cohort_step = make_sp_cohort_step(
+            sp_wl, make_client_optimizer(cfg.client_optimizer, cfg.lr,
+                                         cfg.wd),
+            cfg.epochs, mesh=make_sp_mesh(
+                n_cli, cfg.mesh_sequence,
+                devices=jax.devices()[:n_cli * cfg.mesh_sequence]))
+    else:
+        algo = FedAvg(wl, data, FedAvgConfig(**_fedavg_cfg_kwargs(cfg)),
+                      mesh=mesh, sink=sink)
     algo.run(checkpointer=_make_checkpointer(cfg))
     return algo.history[-1] if algo.history else {}
 
@@ -311,6 +348,123 @@ def run_decentralized_online(cfg, data, mesh, sink):
         sink.log(h, step=h["iteration"])
     return {"final_regret": out["final_regret"],
             "accuracy": out["accuracy"]}
+
+
+@runner("cross_silo")
+def run_cross_silo(cfg, data, mesh, sink):
+    """Distributed FedAvg over the host-edge actor/transport layer — the
+    reference's ``mpirun -np N+1 main_fedavg.py`` deployment
+    (run_fedavg_distributed_pytorch.sh:17-21).
+
+    ``--silo_backend local`` runs server + N silo actors in-process over the
+    deterministic hub (the reference's localhost-MPI CI analog);
+    ``--silo_backend grpc`` runs THIS process as ``--node_id`` k (0=server,
+    1..N=silos) with peers from ``--ip_config`` (the reference's
+    grpc_ipconfig.csv format, ip_config_utils.py:4-14) at
+    ``--base_port``+rank.  Each silo trains its sampled client's shard with
+    a jit'd local-SGD program; only aggregation rides messages.
+    """
+    import jax
+    import jax.numpy as jnp
+    from fedml_tpu.algorithms.cross_silo import (FedAvgClientActor,
+                                                 FedAvgServerActor)
+    from fedml_tpu.trainer.local_sgd import make_local_trainer
+    from fedml_tpu.trainer.workload import make_client_optimizer
+
+    if mesh is not None:
+        raise ValueError("--mesh_clients does not apply to the cross-silo "
+                         "actor mode (each silo trains single-chip); drop "
+                         "the flag or use --algo fedavg for on-pod sharding")
+
+    wl = _make_workload(cfg, data)
+    local = jax.jit(make_local_trainer(
+        wl, make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd),
+        cfg.epochs))
+
+    # reproduce FedAvg.run's exact rng chain (key(seed) -> init split ->
+    # one split per round -> per-cohort-slot fold_in) so the message
+    # choreography lands bit-comparably with the in-jit cohort engine —
+    # every node derives the chain deterministically from (seed, round).
+    # The chain advances incrementally (O(R) total, not O(R^2)); a
+    # backwards query (never happens in a normal run) restarts it.
+    _chain = {"next_round": 0,
+              "rng": jax.random.split(jax.random.key(cfg.seed))[0]}
+
+    def _round_rng(round_idx):
+        if round_idx < _chain["next_round"] - 1:
+            _chain["next_round"] = 0
+            _chain["rng"] = jax.random.split(jax.random.key(cfg.seed))[0]
+        if round_idx == _chain["next_round"] - 1:
+            return _chain["last"]
+        while _chain["next_round"] <= round_idx:
+            _chain["rng"], _chain["last"] = jax.random.split(_chain["rng"])
+            _chain["next_round"] += 1
+        return _chain["last"]
+
+    def make_train_fn(silo_id):
+        def train_fn(params, client_idx, round_idx):
+            shard = {k: jnp.asarray(data.train[k][client_idx])
+                     for k in ("x", "y", "mask")}
+            rng = jax.random.fold_in(_round_rng(round_idx), silo_id - 1)
+            new, _ = local(params, shard, rng)
+            return new, float(data.train["num_samples"][client_idx])
+        return train_fn
+
+    sample = jax.tree.map(lambda v: jnp.asarray(v[0, 0]),
+                          {k: data.train[k] for k in ("x", "y", "mask")})
+    _, init_rng = jax.random.split(jax.random.key(cfg.seed))
+    init = wl.init(init_rng, sample)
+    n_silos = min(cfg.client_num_per_round, data.client_num)
+    timeout = cfg.round_timeout_s or None
+
+    history = []
+
+    def on_round_done(r, params):
+        if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
+            stats = _eval_global(wl, params, data)
+            stats["round"] = r
+            history.append(stats)
+            sink.log(stats, step=r)
+
+    def make_server(transport):
+        s = FedAvgServerActor(
+            transport, init, data.client_num, n_silos, cfg.comm_round,
+            on_round_done=on_round_done,
+            straggler_policy=cfg.straggler_policy,
+            round_timeout_s=timeout, min_silo_frac=cfg.min_silo_frac)
+        s.register_handlers()
+        return s
+
+    if cfg.silo_backend == "local":
+        from fedml_tpu.comm.local import LocalHub
+        hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
+        server = make_server(hub.transport(0))
+        silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i))
+                 for i in range(1, n_silos + 1)]
+        for s in silos:
+            s.register_handlers()
+        server.start()
+        hub.pump()
+        return history[-1] if history else {}
+    if cfg.silo_backend == "grpc":
+        from fedml_tpu.comm.grpc_transport import GrpcTransport, load_ip_table
+        table = (load_ip_table(cfg.ip_config) if cfg.ip_config
+                 else {i: "127.0.0.1" for i in range(n_silos + 1)})
+        transport = GrpcTransport(cfg.node_id, table,
+                                  base_port=cfg.base_port,
+                                  idle_timeout_s=cfg.silo_idle_timeout_s)
+        if cfg.node_id == 0:
+            server = make_server(transport)
+            server.start()
+            transport.run()   # blocks until the final round's FINISH
+            return history[-1] if history else {}
+        silo = FedAvgClientActor(cfg.node_id, transport,
+                                 make_train_fn(cfg.node_id))
+        silo.register_handlers()
+        transport.run()
+        return {}
+    raise ValueError(f"unknown silo_backend {cfg.silo_backend!r}; "
+                     f"available: ('local', 'grpc')")
 
 
 @runner("turboaggregate")

@@ -28,13 +28,19 @@ _NWP_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
 
 def create_workload(model_name: str, dataset: str, class_num: int,
                     sample_shape: Sequence[int],
-                    compute_dtype: str = "") -> Workload:
+                    compute_dtype: str = "",
+                    attn_block_size: int = 0) -> Workload:
     """main_fedavg.py:224-259 switch, flax edition.
 
     ``compute_dtype="bfloat16"`` enables MXU-native mixed precision on the
-    classification workloads (f32 master params, bf16 model compute)."""
+    classification workloads (f32 master params, bf16 model compute).
+    ``attn_block_size`` > 0 gives the transformer flash-style kv blocking
+    (O(T*block) attention memory) for long-context train/eval."""
     import jax.numpy as jnp
     dtype = jnp.dtype(compute_dtype) if compute_dtype else None
+    if attn_block_size and model_name != "transformer":
+        raise ValueError("--attn_block_size only applies to "
+                         "--model transformer")
     if dtype is not None and dataset == "stackoverflow_lr":
         raise ValueError(
             f"--compute_dtype is not wired into the tag-prediction "
@@ -44,7 +50,8 @@ def create_workload(model_name: str, dataset: str, class_num: int,
             # the attention member of the NLP family (no reference analog —
             # its zoo stops at LSTMs, rnn.py:18-22); per-position logits,
             # same NWPWorkload contract, ring-attention capable
-            model = TransformerLM(vocab_size=class_num, dtype=dtype)
+            model = TransformerLM(vocab_size=class_num, dtype=dtype,
+                                  block_size=attn_block_size or None)
         elif dataset == "stackoverflow_nwp":
             model = RNNStackOverflow(dtype=dtype)          # rnn.py:39-70
         else:

@@ -30,7 +30,8 @@ Pytree = Any
 
 def make_local_trainer(workload: Workload,
                        optimizer: optax.GradientTransformation,
-                       epochs: int, prox_mu: float = 0.0):
+                       epochs: int, prox_mu: float = 0.0,
+                       grad_reduce=None):
     """Returns ``train(params, data, rng) -> (new_params, metrics)``.
 
     ``data`` leaves are [S, B, ...] (S batches of size B) with ``mask``
@@ -42,7 +43,13 @@ def make_local_trainer(workload: Workload,
     *distributed fedprox* omits this term entirely (SURVEY.md §2.2 caveat —
     its trainer is vanilla SGD); we implement the actual algorithm (Li et al.
     2020), matching the mu usage in the reference's FedNova optimizer
-    (fednova.py:133-136)."""
+    (fednova.py:133-136).
+
+    ``grad_reduce(grads) -> grads`` runs right after the backward pass,
+    before prox/clip/optimizer.  Sequence-parallel training uses it to
+    `psum` the per-shard partial gradients over the ``sequence`` mesh axis
+    (each shard's backward only sees its own logits' contribution to the
+    psum'd loss; parallel/sequence.py)."""
     clip = (optax.clip_by_global_norm(workload.grad_clip_norm)
             if workload.grad_clip_norm is not None else None)
     stateful = workload.stateful
@@ -78,6 +85,8 @@ def make_local_trainer(workload: Workload,
             rng, dropout_rng = jax.random.split(rng)
             batch = jax.tree.map(lambda x: x[step_idx % num_steps], data)
             (loss, aux), grads = grad_fn(trained, state, batch, dropout_rng)
+            if grad_reduce is not None:
+                grads = grad_reduce(grads)
             if prox_mu:
                 grads = jax.tree.map(lambda g, p, p0: g + prox_mu * (p - p0),
                                      grads, trained, init_trained)

@@ -42,7 +42,7 @@ def test_all_algorithms_registered():
     expected = {"fedavg", "fedprox", "fedopt", "fednova", "fedavg_robust",
                 "hierarchical", "centralized", "decentralized",
                 "turboaggregate", "fednas", "fedgkt", "fedgan", "asdgan",
-                "fedseg", "split_nn", "vfl"}
+                "fedseg", "split_nn", "vfl", "cross_silo"}
     assert expected <= set(RUNNERS), sorted(expected - set(RUNNERS))
 
 
@@ -123,6 +123,57 @@ def test_cli_every_algorithm(algo, tmp_path):
     summary = main(argv)
     assert isinstance(summary, dict) and summary
     assert os.path.exists(tmp_path / algo / "summary.json")
+
+
+def test_cli_cross_silo_matches_fedavg(tmp_path):
+    """The actor-choreography path (local hub, wire codec on) must land at
+    the same aggregate as the in-jit fedavg cohort for one full-batch
+    round: same seeded sampling, same local SGD, same weighted mean."""
+    argv = ["--model", "lr", "--dataset", "mnist",
+            "--client_num_in_total", "4", "--client_num_per_round", "4",
+            "--comm_round", "1", "--frequency_of_the_test", "1",
+            "--batch_size", "64", "--epochs", "1", "--log_stdout", "false"]
+    silo = main(["--algo", "cross_silo"] + argv)
+    fed = main(["--algo", "fedavg"] + argv)
+    np.testing.assert_allclose(silo["train_acc"], fed["train_acc"], rtol=1e-6)
+    np.testing.assert_allclose(silo["train_loss"], fed["train_loss"],
+                               rtol=1e-5)
+
+
+@pytest.mark.slow
+def test_cli_cross_silo_grpc_loopback(tmp_path):
+    """True multi-process federation: server + 2 silo processes over gRPC
+    on 127.0.0.1 (the reference's localhost-MPI strategy, SURVEY.md §4.3,
+    with grpc_ipconfig.csv-style peers)."""
+    import subprocess
+    import sys
+    base = ["--algo", "cross_silo", "--silo_backend", "grpc",
+            "--platform", "cpu", "--model", "lr", "--dataset", "mnist",
+            "--client_num_in_total", "8", "--client_num_per_round", "2",
+            "--comm_round", "2", "--frequency_of_the_test", "1",
+            "--batch_size", "4", "--base_port", "52310",
+            "--log_stdout", "false"]
+    base += ["--silo_idle_timeout_s", "120"]  # no leaked silos on failure
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    silos = [subprocess.Popen(
+        [sys.executable, "-m", "fedml_tpu", "--node_id", str(i)] + base,
+        cwd=repo, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT) for i in (1, 2)]
+    try:
+        # no sleep: the server's INIT broadcast uses wait_for_ready, so it
+        # blocks until each silo's grpc server binds
+        server = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu", "--node_id", "0"] + base,
+            cwd=repo, env=env, capture_output=True, text=True, timeout=240)
+        for p in silos:
+            p.wait(timeout=60)
+    finally:
+        for p in silos:
+            if p.poll() is None:
+                p.kill()
+    assert server.returncode == 0, server.stdout + server.stderr
+    assert '"train_acc"' in server.stdout
 
 
 def test_metrics_sink(tmp_path):
