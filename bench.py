@@ -276,6 +276,43 @@ def bench_shakespeare_rnn(rounds, clients_per_round=10):
                     rounds)
 
 
+def bench_longcontext_transformer(steps=10, seq_len=2048, batch=2,
+                                  block=256, use_flash=False):
+    """Long-context single-chip training step (the capability the
+    reference's LSTM zoo caps at 80 tokens): TransformerLM grad step at
+    ``seq_len`` with flash-style kv blocking (or the pallas flash kernel
+    when ``use_flash``).  Returns (step_s, tokens_per_s)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from fedml_tpu.models import TransformerLM
+
+    model = TransformerLM(vocab_size=256, d_model=256, n_heads=8,
+                          n_layers=2, d_ff=1024, max_len=seq_len,
+                          block_size=None if use_flash else block,
+                          use_flash=use_flash,
+                          dtype=_compute_dtype())
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, 256, (batch, seq_len)), jnp.int32)
+    params = model.init(jax.random.key(0), toks)["params"]
+
+    def loss_fn(p, x):
+        logits = model.apply({"params": p}, x).astype(jnp.float32)
+        y = jnp.concatenate([x[:, 1:], x[:, :1]], axis=1)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    grad = jax.jit(jax.grad(loss_fn))
+    g = grad(params, toks)
+    jax.block_until_ready(g)
+    t0 = _now()
+    for _ in range(steps):
+        g = grad(params, toks)
+    jax.block_until_ready(g)
+    step_s = (_now() - t0) / steps
+    return step_s, batch * seq_len / step_s
+
+
 def bench_robust_backends(rounds, clients_per_round=10):
     """Defended FedAvg round (clip + weak-DP), XLA transform hook vs the
     fused Pallas aggregation kernel (core/pallas_agg.py) — same model and
@@ -425,6 +462,20 @@ def main():
         details["configs"]["fedavg_robust_weakdp_c10"] = {
             "round_s_xla": rb["xla"], "round_s_pallas": rb["pallas"],
             "pallas_speedup": rb["xla"] / rb["pallas"]}
+
+    # 2d) long-context transformer grad step (blockwise kv scan; the
+    # reference has no comparable capability).  CPU fallback: skipped.
+    if not on_cpu:
+        lc_s, lc_tok = bench_longcontext_transformer()
+        details["configs"]["transformer_T2048_blockwise"] = {
+            "step_s": lc_s, "tokens_per_s": lc_tok}
+        try:
+            fl_s, fl_tok = bench_longcontext_transformer(use_flash=True)
+            details["configs"]["transformer_T2048_flash"] = {
+                "step_s": fl_s, "tokens_per_s": fl_tok}
+        except Exception as e:  # pallas kernel unavailable on this backend
+            details["configs"]["transformer_T2048_flash"] = {
+                "skipped": str(e)[:120]}
 
     # 3) cohort scaling curve
     if os.environ.get("BENCH_SCALING", "1") != "0":

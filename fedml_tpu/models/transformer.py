@@ -25,12 +25,32 @@ from fedml_tpu.parallel.ring_attention import (
     blockwise_attention, full_attention, ring_attention)
 
 
+def _pallas_flash(q, k, v):
+    """TPU-fused flash attention (jax.experimental.pallas.ops.tpu) for the
+    dense causal case — one VMEM-tiled kernel instead of XLA-scheduled
+    matmul+softmax.  TPU backend only; q/k/v are [B, T, H, d]."""
+    import jax
+    if jax.default_backend() != "tpu":
+        raise RuntimeError(
+            "use_flash=True needs a TPU backend (the pallas flash kernel "
+            "does not run on CPU); use block_size= for a backend-neutral "
+            "memory-efficient path")
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention)
+    # kernel layout is [B, H, T, d]
+    out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3), causal=True,
+                          sm_scale=1.0 / (q.shape[-1] ** 0.5))
+    return out.transpose(0, 2, 1, 3)
+
+
 class CausalSelfAttention(nn.Module):
     n_heads: int
     d_model: int
     dtype: object = None
     block_size: Optional[int] = None  # flash-style kv blocking (single-chip
     #                                   long context); None = dense scores
+    use_flash: bool = False  # TPU pallas flash kernel (dense causal only)
 
     @nn.compact
     def __call__(self, x, positions, ring_axis: Optional[str] = None):
@@ -43,6 +63,8 @@ class CausalSelfAttention(nn.Module):
                             name="value")(x)
         if ring_axis is not None:
             out = ring_attention(q, k, v, positions, positions, ring_axis)
+        elif self.use_flash:
+            out = _pallas_flash(q, k, v)
         elif self.block_size is not None:
             out = blockwise_attention(q, k, v, positions, positions,
                                       self.block_size)
@@ -68,6 +90,7 @@ class TransformerLM(nn.Module):
     dropout_rate: float = 0.0
     dtype: object = None
     block_size: Optional[int] = None  # see CausalSelfAttention
+    use_flash: bool = False           # see CausalSelfAttention
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False, positions=None,
@@ -84,6 +107,7 @@ class TransformerLM(nn.Module):
             h = CausalSelfAttention(self.n_heads, self.d_model,
                                     dtype=self.dtype,
                                     block_size=self.block_size,
+                                    use_flash=self.use_flash,
                                     name=f"attn_{i}")(h, positions, ring_axis)
             if self.dropout_rate:
                 h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)

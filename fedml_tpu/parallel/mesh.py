@@ -57,18 +57,36 @@ def make_two_level_mesh(group_axis: int, client_axis: Optional[int] = None,
 
 def tp_shard_params(params: Any, mesh: Mesh, axis: str = "model",
                     min_size: int = 4096) -> Any:
-    """GSPMD tensor-parallel placement: put each large 2-D kernel's output
+    """GSPMD tensor-parallel placement: put each large kernel's output
     dim on the ``axis`` mesh axis (replicate everything else) and let XLA
     insert the collectives when the (vmapped) training step is jitted over
     the same mesh — dp over ``clients`` x tp over ``axis`` with no manual
     shard_map (SURVEY.md §2.5: tensor parallel is "a config knob, not an
-    algorithm").  Works with the PLAIN make_cohort_step (mesh=None form)."""
+    algorithm").  Works with the PLAIN make_cohort_step (mesh=None form).
+
+    2-D Dense kernels shard the output dim; 3-D DenseGeneral kernels
+    (the transformer's [d_model, heads, d_head] q/k/v projections) shard
+    the heads dim — the classic Megatron head-parallel split."""
     n = mesh.shape[axis]
 
     def place(x):
-        if (getattr(x, "ndim", 0) == 2 and x.shape[-1] % n == 0
-                and x.size >= min_size):
+        nd = getattr(x, "ndim", 0)
+        if nd == 2 and x.shape[-1] % n == 0 and x.size >= min_size:
             return jax.device_put(x, NamedSharding(mesh, P(None, axis)))
+        if nd == 3 and x.size >= min_size:
+            # Megatron head-parallel split for DenseGeneral kernels: the
+            # in-projections are [d_model, H, dh] (large dim FIRST — shard
+            # H at dim 1, column-parallel) and the out-projection is
+            # [H, dh, d_model] (large dim LAST — shard H at dim 0,
+            # row-parallel).  Discriminating by large-dim position keeps
+            # the q/k/v and out splits consistent so XLA needs one psum
+            # per attention block, not a reshard.  GSPMD guarantees
+            # correctness either way — the spec is a layout hint.
+            dim = 1 if x.shape[0] >= x.shape[-1] else 0
+            if x.shape[dim] % n == 0:
+                spec = [None, None, None]
+                spec[dim] = axis
+                return jax.device_put(x, NamedSharding(mesh, P(*spec)))
         return jax.device_put(x, NamedSharding(mesh, P()))
 
     return jax.tree.map(place, params)

@@ -104,6 +104,49 @@ def test_transformer_blockwise_matches_dense():
                                atol=1e-4)
 
 
+def test_transformer_tp_sharded_matches_dense(devices):
+    """GSPMD dp×tp on the transformer: with q/k/v DenseGeneral kernels
+    head-sharded over a model axis, the jitted forward equals the
+    replicated one (XLA inserts the tensor-parallel collectives)."""
+    from fedml_tpu.parallel.mesh import make_mesh, tp_shard_params
+
+    model = TransformerLM(vocab_size=40, d_model=32, n_heads=2, n_layers=1,
+                         d_ff=64, max_len=32)
+    toks = jnp.asarray(np.random.RandomState(9).randint(0, 40, (4, 32)),
+                       jnp.int32)
+    params = model.init(jax.random.key(0), toks)["params"]
+    want = model.apply({"params": params}, toks)
+
+    mesh = make_mesh(client_axis=4, model_axis=2)
+    params_tp = tp_shard_params(params, mesh, min_size=512)
+    # every large 3-D DenseGeneral kernel must shard its HEADS dim (size 2
+    # here) — in-projections at dim 1, the out-projection at dim 0 — so
+    # the column/row-parallel pair needs one psum, not a reshard
+    n_sharded = 0
+    for p in jax.tree.leaves(params_tp):
+        if getattr(p, "ndim", 0) != 3:
+            continue
+        spec = p.sharding.spec
+        sharded_dims = [i for i, s in enumerate(spec) if s == "model"]
+        assert sharded_dims, (p.shape, spec)
+        assert p.shape[sharded_dims[0]] == 2, (p.shape, spec)
+        n_sharded += 1
+    assert n_sharded >= 4  # q, k, v, out
+    got = jax.jit(lambda p, x: model.apply({"params": p}, x))(params_tp, toks)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_transformer_flash_backend_rejects_cpu():
+    """use_flash is the TPU pallas kernel; off-TPU it must fail loudly with
+    guidance, never fall back silently (a silent fallback would fake a
+    flash benchmark)."""
+    model = TransformerLM(vocab_size=16, d_model=32, n_heads=2, n_layers=1,
+                         d_ff=64, max_len=16, use_flash=True)
+    toks = jnp.zeros((1, 16), jnp.int32)
+    with pytest.raises(RuntimeError, match="needs a TPU backend"):
+        model.init(jax.random.key(0), toks)
+
+
 def test_transformer_sequence_parallel_parity(devices):
     """The FULL model (embeddings, LN, MLP, attention, head) under a
     sequence-sharded shard_map equals the single-device forward."""
