@@ -55,13 +55,20 @@ class FedAvgConfig:
     # when a round is sub-ms; rng schedule is fold_in(round) instead of the
     # loop path's sequential splits, so trajectories differ (both
     # deterministic). Eval cadence still honored; ignored with a
-    # checkpointer (per-round save cadence needs the host loop).
+    # checkpointer (per-round save cadence needs the host loop) or a
+    # _server_update hook (per-round host-side server state, e.g. FedOpt).
     rounds_per_dispatch: int = 1
 
 
 class FedAvg:
     def __init__(self, workload: Workload, data: FederatedData,
-                 config: FedAvgConfig, mesh=None, sink=None):
+                 config: FedAvgConfig, mesh=None, sink=None,
+                 local_train=None):
+        """``local_train`` overrides the client trainer while keeping ALL of
+        FedAvg's execution machinery — including the HBM-resident device
+        round and the scanned multi-round dispatch, which subclasses that
+        replace ``cohort_step`` wholesale forfeit.  FedProx uses it (its
+        only delta is the prox term inside local SGD)."""
         self.workload = workload
         self.data = data
         self.cfg = config
@@ -73,11 +80,20 @@ class FedAvg:
                 raise ValueError(
                     f"client_num_per_round={config.client_num_per_round} "
                     f"must be a multiple of the mesh clients axis ({n_dev})")
-        opt = make_client_optimizer(config.client_optimizer, config.lr, config.wd)
-        local_train = make_local_trainer(workload, opt, config.epochs)
+        if local_train is None:
+            opt = make_client_optimizer(config.client_optimizer, config.lr,
+                                        config.wd)
+            local_train = make_local_trainer(workload, opt, config.epochs)
         self._local_train = local_train
         self.cohort_step = make_cohort_step(local_train, mesh=mesh)
         self._base_cohort_step = self.cohort_step  # fast-path eligibility
+        # optional server-side hook applied AFTER each round's aggregation:
+        # server_update(prev_params, w_avg) -> new_params (FedOpt's
+        # pseudo-gradient optimizer).  Runs outside the round jit, so the
+        # HBM-resident device path still serves hooked algorithms; the
+        # scanned multi-round path cannot (the hook is per-round host state)
+        # and is gated off when set.
+        self._server_update = None
         # single-chip fast path: dataset resident in HBM, cohort gathered
         # by ids inside the jit (see make_device_round); built lazily on
         # first run, only when the stacked data fits on device
@@ -145,13 +161,14 @@ class FedAvg:
         # global jax.Arrays (no-op single-process)
         params = stage_global(params, self.mesh)
         # the HBM-resident fast path only serves the BASE cohort step —
-        # subclasses (FedOpt/FedNova/FedProx/Robust) replace cohort_step
-        # with their own server logic, which must not be bypassed
+        # subclasses that replace cohort_step wholesale (FedNova, Robust
+        # with defenses) must not be bypassed.  FedProx rides it via the
+        # local_train seam; FedOpt via the _server_update hook.
         use_device_data = (self.mesh is None
                            and self.cohort_step is self._base_cohort_step
                            and self._stage_train_on_device())
         if (use_device_data and cfg.rounds_per_dispatch > 1
-                and checkpointer is None):
+                and checkpointer is None and self._server_update is None):
             return self._run_scanned(params, rng, start_round)
         for round_idx in range(start_round, cfg.comm_round):
             t0 = time.time()
@@ -164,7 +181,7 @@ class FedAvg:
                 live[len(ids):] = 0.0
                 padded_ids = np.zeros(m, np.int32)
                 padded_ids[:len(ids)] = ids
-                params, _ = self._device_round(
+                w_agg, _ = self._device_round(
                     params, self._train_dev, jax.numpy.asarray(padded_ids),
                     jax.numpy.asarray(live), round_rng)
             else:
@@ -172,7 +189,10 @@ class FedAvg:
                                        pad_to=cfg.client_num_per_round)
                 cohort = stage_global(cohort, self.mesh, P("clients"))
                 round_rng = stage_global(round_rng, self.mesh)
-                params, _ = self.cohort_step(params, cohort, round_rng)
+                w_agg, _ = self.cohort_step(params, cohort, round_rng)
+            if self._server_update is not None:
+                w_agg = self._server_update(params, w_agg)
+            params = w_agg
             jax.block_until_ready(params)
             round_s = time.time() - t0
 

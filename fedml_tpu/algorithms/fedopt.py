@@ -9,9 +9,10 @@ torch server optimizer.  The reference resolves optimizers by reflection over
 registry maps names to optax transforms.
 
 TPU design: the server step is pure — (w_old, w_avg, opt_state) →
-(w_new, opt_state') — and jits together with the cohort step, so a whole
-FedOpt round (local SGD on the cohort + psum aggregation + Adam server step)
-is still one compiled program.
+(w_new, opt_state') — jitted on its own and applied through FedAvg's
+``_server_update`` hook, so the cohort phase keeps ALL of FedAvg's fast
+paths (HBM-resident device round with in-jit cohort gather); only the
+cheap tree-op server step runs as a second dispatch.
 """
 
 from __future__ import annotations
@@ -61,27 +62,21 @@ class FedOpt(FedAvg):
         self.server_opt = factory(config.server_lr, config.server_momentum)
         self.server_opt_state = None
 
-        base_step = self.cohort_step
-
         @jax.jit
-        def step(global_params, cohort_data, rng, opt_state):
-            w_avg, metrics = base_step(global_params, cohort_data, rng)
-            delta = tree_sub(global_params, w_avg)  # pseudo-gradient
+        def srv_step(w_old, w_avg, opt_state):
+            delta = tree_sub(w_old, w_avg)  # pseudo-gradient
             updates, opt_state = self.server_opt.update(
-                delta, opt_state, global_params)
-            new_params = optax.apply_updates(global_params, updates)
-            return new_params, metrics, opt_state
+                delta, opt_state, w_old)
+            return optax.apply_updates(w_old, updates), opt_state
 
-        self._fedopt_step = step
-        # FedAvg.run drives self.cohort_step(params, cohort, rng)
-        self.cohort_step = self._stateful_step
+        def server_update(w_old, w_avg):
+            if self.server_opt_state is None:
+                self.server_opt_state = self.server_opt.init(w_old)
+            new_params, self.server_opt_state = srv_step(
+                w_old, w_avg, self.server_opt_state)
+            return new_params
 
-    def _stateful_step(self, params, cohort, rng):
-        if self.server_opt_state is None:
-            self.server_opt_state = self.server_opt.init(params)
-        params, metrics, self.server_opt_state = self._fedopt_step(
-            params, cohort, rng, self.server_opt_state)
-        return params, metrics
+        self._server_update = server_update
 
     # server optimizer state (momentum / Adam moments) rides the round
     # checkpoint so a resumed run continues the same trajectory

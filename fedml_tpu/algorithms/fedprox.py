@@ -14,7 +14,6 @@ from __future__ import annotations
 import dataclasses
 
 from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
-from fedml_tpu.parallel.cohort import make_cohort_step
 from fedml_tpu.trainer.local_sgd import make_local_trainer
 from fedml_tpu.trainer.workload import make_client_optimizer
 
@@ -26,9 +25,12 @@ class FedProxConfig(FedAvgConfig):
 
 class FedProx(FedAvg):
     def __init__(self, workload, data, config: FedProxConfig, mesh=None, sink=None):
-        super().__init__(workload, data, config, mesh=mesh, sink=sink)
+        # the only delta vs FedAvg is the prox term inside local SGD, so it
+        # rides FedAvg's machinery via the local_train seam — including the
+        # HBM-resident device round and scanned multi-round dispatch
         opt = make_client_optimizer(config.client_optimizer, config.lr,
                                     config.wd)
         local_train = make_local_trainer(workload, opt, config.epochs,
                                          prox_mu=config.mu)
-        self.cohort_step = make_cohort_step(local_train, mesh=mesh)
+        super().__init__(workload, data, config, mesh=mesh, sink=sink,
+                         local_train=local_train)

@@ -92,6 +92,21 @@ def test_fedprox_mu0_equals_fedavg(workload):
     _tree_close(fa, fp, rtol=1e-6, atol=1e-7)
 
 
+def test_fedprox_fedopt_ride_device_fast_path(workload):
+    """FedProx (local_train seam) and FedOpt (_server_update hook) keep the
+    base cohort step, so FedAvg.run serves them from the HBM-resident
+    device round — regression guard for the seam refactor."""
+    data = _data()
+    for cls, cfg in ((FedProx, FedProxConfig(**BASE, mu=0.1)),
+                     (FedOpt, FedOptConfig(**BASE, server_optimizer="adam",
+                                           server_lr=0.01))):
+        algo = cls(workload, data, cfg)
+        assert algo.cohort_step is algo._base_cohort_step
+        algo.run(params=algo.init_params(jax.random.key(0)))
+        assert algo._train_dev is not None, (
+            f"{cls.__name__} fell back to the host-gather path")
+
+
 def test_fedprox_mu_pulls_towards_global(workload):
     data = _data()
     cfg = dict(BASE, epochs=5)
