@@ -94,6 +94,10 @@ class FedAvg:
         # scanned multi-round path cannot (the hook is per-round host state)
         # and is gated off when set.
         self._server_update = None
+        # subclasses whose whole round is custom (FedNova) can still ride
+        # the HBM-resident path by providing their own device round with
+        # the make_device_round signature (params, stacked, ids, live, rng)
+        self._device_round_override = None
         # single-chip fast path: dataset resident in HBM, cohort gathered
         # by ids inside the jit (see make_device_round); built lazily on
         # first run, only when the stacked data fits on device
@@ -165,10 +169,12 @@ class FedAvg:
         # with defenses) must not be bypassed.  FedProx rides it via the
         # local_train seam; FedOpt via the _server_update hook.
         use_device_data = (self.mesh is None
-                           and self.cohort_step is self._base_cohort_step
+                           and (self.cohort_step is self._base_cohort_step
+                                or self._device_round_override is not None)
                            and self._stage_train_on_device())
         if (use_device_data and cfg.rounds_per_dispatch > 1
-                and checkpointer is None and self._server_update is None):
+                and checkpointer is None and self._server_update is None
+                and self.cohort_step is self._base_cohort_step):
             return self._run_scanned(params, rng, start_round)
         for round_idx in range(start_round, cfg.comm_round):
             t0 = time.time()
@@ -268,8 +274,10 @@ class FedAvg:
                         "gather", nbytes / 1e6)
             return False
         if self._device_round is None:
-            self._device_round = make_device_round(
-                self._local_train, self.cfg.client_num_per_round)
+            self._device_round = (self._device_round_override
+                                  or make_device_round(
+                                      self._local_train,
+                                      self.cfg.client_num_per_round))
         self._train_dev = {k: jax.numpy.asarray(v)
                            for k, v in self.data.train.items()}
         return True

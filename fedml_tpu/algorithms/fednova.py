@@ -176,6 +176,27 @@ class FedNova(FedAvg):
         self._nova_step = step
         self.cohort_step = self._stateful_step
 
+        if mesh is None:
+            # HBM-resident fast path: same _nova_core, cohort gathered by
+            # ids inside the jit (the make_device_round pattern) — FedNova
+            # joins FedAvg/FedProx/FedOpt on the zero-host-traffic round
+            from fedml_tpu.parallel.cohort import gather_live_cohort
+
+            @jax.jit
+            def device_step(params, stacked, ids, live, rng, gmf_buf):
+                cohort = gather_live_cohort(stacked, ids, live)
+                return _nova_core(params, cohort, rng, gmf_buf,
+                                  psum_axis=None)
+
+            def _device_wrapper(params, stacked, ids, live, rng):
+                if self._gmf_buf is None:
+                    self._gmf_buf = jax.tree.map(jnp.zeros_like, params)
+                params, self._gmf_buf = device_step(
+                    params, stacked, ids, live, rng, self._gmf_buf)
+                return params, {}
+
+            self._device_round_override = _device_wrapper
+
     def _stateful_step(self, params, cohort, rng):
         if self._gmf_buf is None:
             self._gmf_buf = jax.tree.map(jnp.zeros_like, params)

@@ -161,6 +161,18 @@ def make_device_round(local_train, clients_per_round: int,
     return jax.jit(body)
 
 
+def gather_live_cohort(stacked: CohortData, ids, live) -> CohortData:
+    """In-jit cohort materialization from the HBM-resident dataset: gather
+    by ``ids`` and zero out padded slots via the ``live`` mask.  THE one
+    definition of the live-masking convention — every HBM fast path
+    (make_device_round, make_scanned_rounds, FedNova's device round) calls
+    this, so the convention cannot drift between them."""
+    cohort = jax.tree.map(lambda v: jnp.take(v, ids, axis=0), stacked)
+    cohort["mask"] = cohort["mask"] * live[:, None, None]
+    cohort["num_samples"] = cohort["num_samples"] * live
+    return cohort
+
+
 def _device_round_body(local_train, aggregate, transform_update):
     """One HBM-resident round: in-jit id gather + live masking + cohort
     train + aggregate.  Shared by make_device_round (K=1, jitted directly)
@@ -168,9 +180,7 @@ def _device_round_body(local_train, aggregate, transform_update):
     never drift apart."""
 
     def body(params, stacked, ids, live, rng):
-        cohort = jax.tree.map(lambda v: jnp.take(v, ids, axis=0), stacked)
-        cohort["mask"] = cohort["mask"] * live[:, None, None]
-        cohort["num_samples"] = cohort["num_samples"] * live
+        cohort = gather_live_cohort(stacked, ids, live)
         stacked_out, metrics = train_cohort(
             local_train, params, cohort, rng,
             transform_update=transform_update)

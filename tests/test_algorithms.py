@@ -92,19 +92,28 @@ def test_fedprox_mu0_equals_fedavg(workload):
     _tree_close(fa, fp, rtol=1e-6, atol=1e-7)
 
 
-def test_fedprox_fedopt_ride_device_fast_path(workload):
-    """FedProx (local_train seam) and FedOpt (_server_update hook) keep the
-    base cohort step, so FedAvg.run serves them from the HBM-resident
-    device round — regression guard for the seam refactor."""
+def test_fedprox_fedopt_fednova_ride_device_fast_path(workload, monkeypatch):
+    """FedProx (local_train seam), FedOpt (_server_update hook), and
+    FedNova (_device_round_override) are all served from the HBM-resident
+    device round — and the device round lands on the SAME parameters as
+    the host-gather path (identical sampling and rng, so bit-comparable)."""
+    from fedml_tpu.algorithms import FedNova, FedNovaConfig
     data = _data()
     for cls, cfg in ((FedProx, FedProxConfig(**BASE, mu=0.1)),
                      (FedOpt, FedOptConfig(**BASE, server_optimizer="adam",
-                                           server_lr=0.01))):
+                                           server_lr=0.01)),
+                     (FedNova, FedNovaConfig(**BASE, gmf=0.9))):
         algo = cls(workload, data, cfg)
-        assert algo.cohort_step is algo._base_cohort_step
-        algo.run(params=algo.init_params(jax.random.key(0)))
+        dev = algo.run(params=algo.init_params(jax.random.key(0)))
         assert algo._train_dev is not None, (
             f"{cls.__name__} fell back to the host-gather path")
+        # force the host path (device budget 0) and compare trajectories
+        monkeypatch.setenv("FEDML_TPU_DEVICE_DATA_BYTES", "0")
+        host_algo = cls(workload, data, cfg)
+        host = host_algo.run(params=host_algo.init_params(jax.random.key(0)))
+        monkeypatch.delenv("FEDML_TPU_DEVICE_DATA_BYTES")
+        assert host_algo._train_dev is None
+        _tree_close(dev, host, rtol=1e-6, atol=1e-6)
 
 
 def test_fedprox_mu_pulls_towards_global(workload):
