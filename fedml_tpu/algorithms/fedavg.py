@@ -103,6 +103,7 @@ class FedAvg:
         # first run, only when the stacked data fits on device
         self._device_round = None
         self._train_dev = None
+        self._test_dev = None  # eval-split device cache (mirrors _train_dev)
         self.evaluate = make_evaluator(workload)
         # global eval over ALL clients rides the mesh too (each device
         # evaluates its shard of clients; metric psum over ICI)
@@ -282,6 +283,18 @@ class FedAvg:
                            for k, v in self.data.train.items()}
         return True
 
+    def _fits_with_train(self, stacked) -> bool:
+        """True when this split fits in the device-data budget ALONGSIDE
+        the already-resident train split (same knob as
+        _stage_train_on_device)."""
+        import os
+        budget = int(os.environ.get("FEDML_TPU_DEVICE_DATA_BYTES",
+                                    str(4 << 30)))
+        train_b = sum(np.asarray(v).nbytes
+                      for v in self.data.train.values())
+        split_b = sum(np.asarray(v).nbytes for v in stacked.values())
+        return train_b + split_b <= budget
+
     def evaluate_global(self, params) -> Dict[str, float]:
         """Weighted train/test metrics over ALL clients' shards (parity with
         _local_test_on_all_clients, fedavg_api.py:118-171)."""
@@ -290,7 +303,19 @@ class FedAvg:
         for split, stacked in (("train", self.data.train), ("test", self.data.test)):
             if stacked is None:
                 continue
-            batch = {k: jax.numpy.asarray(v) for k, v in stacked.items()}
+            # once the train set is device-resident, reuse it; cache the
+            # test split too when train+test together stay inside the
+            # device-data budget (else upload per eval and let it free)
+            if split == "train" and self._train_dev is not None:
+                batch = self._train_dev
+            elif split == "test" and self._train_dev is not None:
+                if self._test_dev is None and self._fits_with_train(stacked):
+                    self._test_dev = {k: jax.numpy.asarray(v)
+                                      for k, v in stacked.items()}
+                batch = self._test_dev if self._test_dev is not None else {
+                    k: jax.numpy.asarray(v) for k, v in stacked.items()}
+            else:
+                batch = {k: jax.numpy.asarray(v) for k, v in stacked.items()}
             if self.mesh is not None and jax.process_count() > 1:
                 # cohort_eval pads to the device count internally, but global
                 # staging must happen pre-jit, so pad here first

@@ -101,6 +101,8 @@ class ExperimentConfig:
     attn_block_size: int = 0  # >0 (transformer): flash-style kv blocking —
     #                           O(T*block) attention memory for single-chip
     #                           train/eval at long context
+    attn_flash: bool = False  # transformer: TPU pallas flash-attention
+    #                           kernel (fails loudly off-TPU)
     silo_idle_timeout_s: float = 0.0  # grpc silos: exit after this long
     #                                   with no traffic (0 = wait forever)
     platform: Optional[str] = None       # force jax platform (e.g. "cpu")

@@ -87,7 +87,8 @@ def _make_workload(cfg: ExperimentConfig, data):
     return create_workload(cfg.model, cfg.dataset, data.class_num,
                            sample_shape_of(data),
                            compute_dtype=cfg.compute_dtype,
-                           attn_block_size=cfg.attn_block_size)
+                           attn_block_size=cfg.attn_block_size,
+                           attn_flash=cfg.attn_flash)
 
 
 def _make_checkpointer(cfg: ExperimentConfig):

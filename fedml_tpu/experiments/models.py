@@ -29,18 +29,23 @@ _NWP_DATASETS = {"shakespeare", "fed_shakespeare", "stackoverflow_nwp"}
 def create_workload(model_name: str, dataset: str, class_num: int,
                     sample_shape: Sequence[int],
                     compute_dtype: str = "",
-                    attn_block_size: int = 0) -> Workload:
+                    attn_block_size: int = 0,
+                    attn_flash: bool = False) -> Workload:
     """main_fedavg.py:224-259 switch, flax edition.
 
     ``compute_dtype="bfloat16"`` enables MXU-native mixed precision on the
     classification workloads (f32 master params, bf16 model compute).
     ``attn_block_size`` > 0 gives the transformer flash-style kv blocking
-    (O(T*block) attention memory) for long-context train/eval."""
+    (O(T*block) attention memory) for long-context train/eval;
+    ``attn_flash`` swaps in the TPU pallas flash kernel instead."""
     import jax.numpy as jnp
     dtype = jnp.dtype(compute_dtype) if compute_dtype else None
-    if attn_block_size and model_name != "transformer":
-        raise ValueError("--attn_block_size only applies to "
+    if (attn_block_size or attn_flash) and model_name != "transformer":
+        raise ValueError("--attn_block_size/--attn_flash only apply to "
                          "--model transformer")
+    if attn_block_size and attn_flash:
+        raise ValueError("--attn_block_size and --attn_flash are mutually "
+                         "exclusive attention backends; pick one")
     if dtype is not None and dataset == "stackoverflow_lr":
         raise ValueError(
             f"--compute_dtype is not wired into the tag-prediction "
@@ -51,7 +56,8 @@ def create_workload(model_name: str, dataset: str, class_num: int,
             # its zoo stops at LSTMs, rnn.py:18-22); per-position logits,
             # same NWPWorkload contract, ring-attention capable
             model = TransformerLM(vocab_size=class_num, dtype=dtype,
-                                  block_size=attn_block_size or None)
+                                  block_size=attn_block_size or None,
+                                  use_flash=attn_flash)
         elif dataset == "stackoverflow_nwp":
             model = RNNStackOverflow(dtype=dtype)          # rnn.py:39-70
         else:

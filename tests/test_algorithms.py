@@ -97,7 +97,6 @@ def test_fedprox_fedopt_fednova_ride_device_fast_path(workload, monkeypatch):
     FedNova (_device_round_override) are all served from the HBM-resident
     device round — and the device round lands on the SAME parameters as
     the host-gather path (identical sampling and rng, so bit-comparable)."""
-    from fedml_tpu.algorithms import FedNova, FedNovaConfig
     data = _data()
     for cls, cfg in ((FedProx, FedProxConfig(**BASE, mu=0.1)),
                      (FedOpt, FedOptConfig(**BASE, server_optimizer="adam",
