@@ -54,3 +54,19 @@ def devices():
 @pytest.fixture
 def rng():
     return np.random.RandomState(0)
+
+
+def identity_lm_data(vocab=12, clients=4, samples=16, seq=8, batch=8,
+                     seed=13):
+    """Deterministic next-token (y_t = x_t) federated LM dataset — the
+    shared learning-proof task for the NLP families (RNN + transformer):
+    any sequence model must drive token accuracy to ~1.  Tokens start at 2
+    so labels never collide with NWPWorkload's pad_id=0 mask."""
+    from fedml_tpu.data.stacking import FederatedData, stack_client_data
+    rs = np.random.RandomState(seed)
+    xs = [rs.randint(2, vocab, (samples, seq)).astype(np.int32)
+          for _ in range(clients)]
+    ys = [x.copy() for x in xs]
+    train = stack_client_data(xs, ys, batch_size=batch)
+    return FederatedData(client_num=clients, class_num=vocab, train=train,
+                         test=train)

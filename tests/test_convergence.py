@@ -8,9 +8,13 @@ its round budgets (benchmark/README.md:12-14).
   (generate_synthetic.py) — target >60 train acc within 200 rounds;
 * MNIST-LR twin (hermetic learnable stand-in, power-law sizes, label skew)
   — reference target >75 train acc within 100+ rounds at the reference
-  hyperparameters (1000 clients, 10/round, B=10, SGD lr=0.03, E=1).
+  hyperparameters (1000 clients, 10/round, B=10, SGD lr=0.03, E=1);
+* RNN char-LM (the shakespeare trainer flavor) on a deterministic
+  next-token task — >90% token accuracy, proving the NLP family learns
+  federatedly (mirrors the transformer learning test in
+  test_ring_attention.py via the shared identity_lm_data fixture).
 
-Both are slow-marked: they run hundreds of cohort rounds on CPU.
+All are slow-marked: they run tens-to-hundreds of cohort rounds on CPU.
 """
 
 import pytest
@@ -37,6 +41,26 @@ def test_synthetic_alpha_beta_lr_to_60():
     params = algo.run()
     acc = algo.evaluate_global(params)["train_acc"]
     assert acc > 0.60, f"synthetic(0.5,0.5) train acc {acc:.3f} <= 0.60"
+
+
+@pytest.mark.slow
+def test_rnn_charlm_federated_learning_to_target():
+    """The RNN family LEARNS federatedly, not just runs (the shakespeare
+    trainer flavor): a 2-layer LSTM char-LM on a deterministic
+    next-token task (y_t = x_t) must reach >90% token accuracy — the same
+    learning-proof pattern as the transformer test
+    (test_ring_attention.py)."""
+    from conftest import identity_lm_data
+    from fedml_tpu.models import RNNOriginalFedAvg
+    from fedml_tpu.trainer.workload import NWPWorkload
+
+    model = RNNOriginalFedAvg(vocab_size=12, embedding_dim=8, hidden_size=32)
+    data = identity_lm_data()
+    cfg = FedAvgConfig(comm_round=100, client_num_per_round=4, epochs=2,
+                       batch_size=8, lr=0.5, frequency_of_the_test=99)
+    algo = FedAvg(NWPWorkload(model), data, cfg)
+    algo.run()
+    assert algo.history[-1]["train_acc"] > 0.9, algo.history[-1]
 
 
 @pytest.mark.slow

@@ -223,17 +223,13 @@ def test_transformer_federated_learning_to_target():
     """The attention path LEARNS, not just runs: federated training on a
     deterministic next-token task (y_t = x_t) must reach >90% token accuracy
     — the convergence-suite pattern applied to the transformer family."""
+    from conftest import identity_lm_data
     from fedml_tpu.algorithms import FedAvg, FedAvgConfig
-    from fedml_tpu.data.stacking import FederatedData, stack_client_data
     from fedml_tpu.trainer.workload import NWPWorkload
 
-    rng = np.random.RandomState(11)
     model = TransformerLM(vocab_size=12, d_model=32, n_heads=2, n_layers=1,
                          d_ff=64, max_len=16)
-    xs = [rng.randint(2, 12, (16, 8)).astype(np.int32) for _ in range(4)]
-    ys = [x.copy() for x in xs]          # next-token target = input token
-    train = stack_client_data(xs, ys, batch_size=8)
-    data = FederatedData(client_num=4, class_num=12, train=train, test=train)
+    data = identity_lm_data()
     cfg = FedAvgConfig(comm_round=30, client_num_per_round=4, epochs=2,
                        batch_size=8, lr=0.3, frequency_of_the_test=29)
     algo = FedAvg(NWPWorkload(model), data, cfg)
