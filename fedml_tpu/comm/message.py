@@ -86,8 +86,11 @@ class Message:
             else:
                 descr = []
                 for leaf in leaves:
-                    arr = np.ascontiguousarray(np.asarray(leaf))
-                    descr.append({"dtype": arr.dtype.str, "shape": arr.shape,
+                    src = np.asarray(leaf)
+                    arr = np.ascontiguousarray(src)
+                    # ascontiguousarray promotes 0-d to shape (1,) — record
+                    # the ORIGINAL shape so 0-d leaves round-trip exactly
+                    descr.append({"dtype": arr.dtype.str, "shape": src.shape,
                                   "idx": len(buffers)})
                     buffers.append(arr)
                 header["arrays"][key] = {"spec": spec, "leaves": descr}
