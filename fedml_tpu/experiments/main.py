@@ -157,9 +157,10 @@ def run_fedavg(cfg, data, mesh, sink):
         if not cfg.attn_block_size:
             logging.getLogger(__name__).warning(
                 "--mesh_sequence without --attn_block_size: init/eval run "
-                "DENSE attention on one chip (O(T^2) scores); set "
-                "--attn_block_size for sequence lengths that only fit "
-                "sharded")
+                "single-chip attention (auto-blockwise past 1024 tokens "
+                "when a block of 64-512 divides T, DENSE O(T^2) scores "
+                "otherwise); set --attn_block_size to pin the "
+                "memory-efficient path")
         if mesh is not None:
             raise ValueError("--mesh_sequence and --mesh_clients build one "
                              "combined [clients, sequence] mesh; pass "

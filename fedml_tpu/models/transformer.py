@@ -25,6 +25,20 @@ from fedml_tpu.parallel.ring_attention import (
     blockwise_attention, full_attention, ring_attention)
 
 
+def _auto_block(t: int, threshold: int, max_block: int = 512,
+                min_block: int = 64) -> Optional[int]:
+    """Largest kv-block size in [min_block, max_block] dividing ``t``, or
+    None when ``t <= threshold`` (dense is fine) or no usable divisor
+    exists (a sub-64 block would make the scan slower than it saves —
+    realistic sequence lengths have power-of-two factors)."""
+    if t <= threshold:
+        return None
+    for b in range(min(max_block, t), min_block - 1, -1):
+        if t % b == 0:
+            return b
+    return None
+
+
 def _pallas_flash(q, k, v):
     """TPU-fused flash attention (jax.experimental.pallas.ops.tpu) for the
     dense causal case — one VMEM-tiled kernel instead of XLA-scheduled
@@ -51,6 +65,10 @@ class CausalSelfAttention(nn.Module):
     block_size: Optional[int] = None  # flash-style kv blocking (single-chip
     #                                   long context); None = dense scores
     use_flash: bool = False  # TPU pallas flash kernel (dense causal only)
+    # dense attention materializes [B, H, T, T] scores; past this length
+    # switch to blockwise automatically (exact same math) so long-context
+    # eval/init can't OOM just because no backend flag was passed
+    auto_block_len: int = 1024
 
     @nn.compact
     def __call__(self, x, positions, ring_axis: Optional[str] = None):
@@ -61,6 +79,7 @@ class CausalSelfAttention(nn.Module):
                             name="key")(x)
         v = nn.DenseGeneral((self.n_heads, d_head), dtype=self.dtype,
                             name="value")(x)
+        t = x.shape[1]
         if ring_axis is not None:
             out = ring_attention(q, k, v, positions, positions, ring_axis)
         elif self.use_flash:
@@ -68,6 +87,8 @@ class CausalSelfAttention(nn.Module):
         elif self.block_size is not None:
             out = blockwise_attention(q, k, v, positions, positions,
                                       self.block_size)
+        elif (blk := _auto_block(t, self.auto_block_len)) is not None:
+            out = blockwise_attention(q, k, v, positions, positions, blk)
         else:
             out = full_attention(q, k, v, positions, positions)
         out = out.astype(x.dtype)
@@ -91,6 +112,7 @@ class TransformerLM(nn.Module):
     dtype: object = None
     block_size: Optional[int] = None  # see CausalSelfAttention
     use_flash: bool = False           # see CausalSelfAttention
+    auto_block_len: int = 1024        # see CausalSelfAttention
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False, positions=None,
@@ -108,6 +130,7 @@ class TransformerLM(nn.Module):
                                     dtype=self.dtype,
                                     block_size=self.block_size,
                                     use_flash=self.use_flash,
+                                    auto_block_len=self.auto_block_len,
                                     name=f"attn_{i}")(h, positions, ring_axis)
             if self.dropout_rate:
                 h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)

@@ -136,6 +136,31 @@ def test_transformer_tp_sharded_matches_dense(devices):
     np.testing.assert_allclose(got, want, atol=1e-5)
 
 
+@pytest.mark.parametrize("t", [1024, 2000])  # 2000: largest divisor is 500
+def test_transformer_auto_blockwise_past_threshold(t):
+    """With no backend flag, sequences past auto_block_len silently switch
+    to blockwise — including lengths not divisible by 512 (the block is
+    the largest 64-512 divisor of T) — with exact parity vs dense."""
+    dense = TransformerLM(vocab_size=20, d_model=16, n_heads=2, n_layers=1,
+                          d_ff=32, max_len=2048, auto_block_len=1 << 30)
+    auto = TransformerLM(vocab_size=20, d_model=16, n_heads=2, n_layers=1,
+                         d_ff=32, max_len=2048, auto_block_len=512)
+    toks = jnp.asarray(np.random.RandomState(8).randint(0, 20, (1, t)),
+                       jnp.int32)
+    params = dense.init(jax.random.key(0), toks)["params"]
+    np.testing.assert_allclose(auto.apply({"params": params}, toks),
+                               dense.apply({"params": params}, toks),
+                               atol=1e-4)
+
+
+def test_auto_block_divisor_choice():
+    from fedml_tpu.models.transformer import _auto_block
+    assert _auto_block(1024, 1 << 30) is None          # under threshold
+    assert _auto_block(2048, 1024) == 512
+    assert _auto_block(2000, 1024) == 500
+    assert _auto_block(1031, 1024) is None             # prime: stay dense
+
+
 def test_transformer_flash_backend_rejects_cpu():
     """use_flash is the TPU pallas kernel; off-TPU it must fail loudly with
     guidance, never fall back silently (a silent fallback would fake a
