@@ -61,7 +61,8 @@ class FedAvgServerActor(ServerManager):
                  on_round_done: Optional[Callable[[int, object], None]] = None,
                  straggler_policy: str = "wait",
                  round_timeout_s: Optional[float] = None,
-                 min_silo_frac: float = 0.5):
+                 min_silo_frac: float = 0.5,
+                 decode_upload: Optional[Callable] = None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -88,6 +89,10 @@ class FedAvgServerActor(ServerManager):
         self.round_timeout_s = round_timeout_s
         self.min_silo_frac = min_silo_frac
         self.aborted = False
+        # optional wire decompression: decode_upload(payload, global_params)
+        # -> params (comm/compress.py rides here — uploads compressed, the
+        # down-link broadcast stays exact)
+        self.decode_upload = decode_upload
         self.dropped_silos: Dict[int, list] = {}  # round -> missing silo ids
         self._received: Dict[int, tuple] = {}
         self._num_silos = 0  # silos contacted this round (= sampled cohort)
@@ -176,8 +181,25 @@ class FedAvgServerActor(ServerManager):
             return
         # barrier semantics: wait for every sampled silo
         # (check_whether_all_receive, FedAvgServerManager.py:51)
+        upload = msg.get(Message.ARG_MODEL_PARAMS)
+        # compression-scheme handshake: a payload with a "scheme" tag is a
+        # compressed frame (comm/compress.py) — both mismatch directions
+        # would otherwise crash far from the misconfiguration
+        is_compressed = isinstance(upload, dict) and "scheme" in upload
+        if self.decode_upload is None and is_compressed:
+            raise ValueError(
+                f"silo {msg.sender_id} sent a compressed upload "
+                f"(scheme={upload['scheme']!r}) but the server has no "
+                f"--wire_compression configured")
+        if self.decode_upload is not None:
+            if not is_compressed:
+                raise ValueError(
+                    f"server expects compressed uploads but silo "
+                    f"{msg.sender_id} sent plain parameters; launch silos "
+                    f"with the same --wire_compression")
+            upload = self.decode_upload(upload, self.params)
         self._received[msg.sender_id] = (
-            msg.get(Message.ARG_MODEL_PARAMS), msg.get(Message.ARG_NUM_SAMPLES))
+            upload, msg.get(Message.ARG_NUM_SAMPLES))
         if len(self._received) < self._num_silos:
             return
         self._complete_round()
@@ -208,9 +230,13 @@ class FedAvgClientActor(ClientManager):
     """Silo-side trainer actor (reference FedAvgClientManager.py:18-75)."""
 
     def __init__(self, node_id: int, transport: Transport,
-                 train_fn: SiloTrainFn):
+                 train_fn: SiloTrainFn,
+                 encode_upload: Optional[Callable] = None):
         super().__init__(node_id, transport)
         self.train_fn = train_fn
+        # optional wire compression: encode_upload(new_params,
+        # global_params) -> payload (comm/compress.py)
+        self.encode_upload = encode_upload
 
     def register_handlers(self) -> None:
         self.register_handler(MsgType.S2C_INIT, self._on_sync)
@@ -222,8 +248,10 @@ class FedAvgClientActor(ClientManager):
         client_idx = msg.get(Message.ARG_CLIENT_INDEX)
         round_idx = msg.get(Message.ARG_ROUND)
         new_params, num_samples = self.train_fn(params, client_idx, round_idx)
+        upload = jax.tree.map(np.asarray, new_params)
+        if self.encode_upload is not None:
+            upload = self.encode_upload(upload, params)
         self.send(MsgType.C2S_MODEL, 0,
-                  **{Message.ARG_MODEL_PARAMS: jax.tree.map(np.asarray,
-                                                            new_params),
+                  **{Message.ARG_MODEL_PARAMS: upload,
                      Message.ARG_NUM_SAMPLES: int(num_samples),
                      Message.ARG_ROUND: round_idx})

@@ -105,6 +105,8 @@ class ExperimentConfig:
     #                           kernel (fails loudly off-TPU)
     silo_idle_timeout_s: float = 0.0  # grpc silos: exit after this long
     #                                   with no traffic (0 = wait forever)
+    wire_compression: str = "none"    # cross_silo uploads: none|topk|int8
+    topk_frac: float = 0.1            # topk: fraction of entries kept
     platform: Optional[str] = None       # force jax platform (e.g. "cpu")
     host_device_count: int = 0           # virtual CPU devices (simulation)
     coordinator_address: Optional[str] = None  # multi-host bootstrap

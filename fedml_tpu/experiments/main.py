@@ -419,6 +419,37 @@ def run_cross_silo(cfg, data, mesh, sink):
     n_silos = min(cfg.client_num_per_round, data.client_num)
     timeout = cfg.round_timeout_s or None
 
+    # optional lossy upload compression (comm/compress.py): silos send the
+    # compressed DELTA to the global model; the server reconstructs.  The
+    # down-link broadcast stays exact.
+    encode = decode = None
+    if cfg.wire_compression != "none":
+        # host-side numpy throughout — compression is a wire-boundary op
+        # and must not bounce the model through the accelerator
+        from fedml_tpu.comm.compress import (compress_update,
+                                             decompress_update)
+
+        def encode(new_params, global_params):
+            delta = jax.tree.map(
+                lambda a, b: np.asarray(a) - np.asarray(b),
+                new_params, global_params)
+            return compress_update(delta, cfg.wire_compression,
+                                   cfg.topk_frac)
+
+        _decode_cache = {"ref": None, "host": None}
+
+        def decode(payload, global_params):
+            # one host copy of the globals per round, not one per silo
+            # (cache keyed by object identity; holding "ref" prevents id
+            # reuse of a collected params tree)
+            if _decode_cache["ref"] is not global_params:
+                _decode_cache["host"] = jax.tree.map(np.asarray,
+                                                     global_params)
+                _decode_cache["ref"] = global_params
+            host_global = _decode_cache["host"]
+            delta = decompress_update(payload, host_global)
+            return jax.tree.map(np.add, host_global, delta)
+
     history = []
 
     def on_round_done(r, params):
@@ -433,7 +464,8 @@ def run_cross_silo(cfg, data, mesh, sink):
             transport, init, data.client_num, n_silos, cfg.comm_round,
             on_round_done=on_round_done,
             straggler_policy=cfg.straggler_policy,
-            round_timeout_s=timeout, min_silo_frac=cfg.min_silo_frac)
+            round_timeout_s=timeout, min_silo_frac=cfg.min_silo_frac,
+            decode_upload=decode)
         s.register_handlers()
         return s
 
@@ -441,7 +473,8 @@ def run_cross_silo(cfg, data, mesh, sink):
         from fedml_tpu.comm.local import LocalHub
         hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
         server = make_server(hub.transport(0))
-        silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i))
+        silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
+                                   encode_upload=encode)
                  for i in range(1, n_silos + 1)]
         for s in silos:
             s.register_handlers()
@@ -461,7 +494,8 @@ def run_cross_silo(cfg, data, mesh, sink):
             transport.run()   # blocks until the final round's FINISH
             return history[-1] if history else {}
         silo = FedAvgClientActor(cfg.node_id, transport,
-                                 make_train_fn(cfg.node_id))
+                                 make_train_fn(cfg.node_id),
+                                 encode_upload=encode)
         silo.register_handlers()
         transport.run()
         return {}
