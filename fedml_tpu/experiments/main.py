@@ -423,11 +423,12 @@ def run_cross_silo(cfg, data, mesh, sink):
     # compressed DELTA to the global model; the server reconstructs.  The
     # down-link broadcast stays exact.
     encode = decode = None
+    wire_stats = {"bytes": 0}
     if cfg.wire_compression != "none":
         # host-side numpy throughout — compression is a wire-boundary op
         # and must not bounce the model through the accelerator
         from fedml_tpu.comm.compress import (compress_update,
-                                             decompress_update)
+                                             decompress_update, wire_bytes)
 
         def encode(new_params, global_params):
             delta = jax.tree.map(
@@ -447,6 +448,7 @@ def run_cross_silo(cfg, data, mesh, sink):
                                                      global_params)
                 _decode_cache["ref"] = global_params
             host_global = _decode_cache["host"]
+            wire_stats["bytes"] += wire_bytes(payload)
             delta = decompress_update(payload, host_global)
             return jax.tree.map(np.add, host_global, delta)
 
@@ -456,6 +458,10 @@ def run_cross_silo(cfg, data, mesh, sink):
         if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
             stats = _eval_global(wl, params, data)
             stats["round"] = r
+            if cfg.wire_compression != "none":
+                # compressed bytes received since the last eval round
+                stats["upload_bytes"] = wire_stats["bytes"]
+                wire_stats["bytes"] = 0
             history.append(stats)
             sink.log(stats, step=r)
 

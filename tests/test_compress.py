@@ -132,3 +132,6 @@ def test_cli_cross_silo_with_compression(scheme):
     # int8 quantizes a small delta: accuracies should be near-identical;
     # topk at 50% keeps the dominant directions
     assert abs(comp["train_acc"] - plain["train_acc"]) < 0.15
+    # observability: compressed runs report received upload bytes
+    assert comp["upload_bytes"] > 0
+    assert "upload_bytes" not in plain
