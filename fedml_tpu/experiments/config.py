@@ -107,6 +107,10 @@ class ExperimentConfig:
     #                                   with no traffic (0 = wait forever)
     wire_compression: str = "none"    # cross_silo uploads: none|topk|int8
     topk_frac: float = 0.1            # topk: fraction of entries kept
+    error_feedback: bool = False      # carry the compression residual into
+    #                                   the next round's delta (EF-SGD style;
+    #                                   silo-local state, so gRPC silos must
+    #                                   be persistent processes — they are)
     platform: Optional[str] = None       # force jax platform (e.g. "cpu")
     host_device_count: int = 0           # virtual CPU devices (simulation)
     coordinator_address: Optional[str] = None  # multi-host bootstrap

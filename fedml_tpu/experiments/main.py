@@ -430,12 +430,26 @@ def run_cross_silo(cfg, data, mesh, sink):
         from fedml_tpu.comm.compress import (compress_update,
                                              decompress_update, wire_bytes)
 
-        def encode(new_params, global_params):
+        # error feedback (Seide'14 / Karimireddy'19): the part of the delta
+        # the compressor dropped is kept silo-side and added to the NEXT
+        # round's delta, so small topk fractions stop systematically losing
+        # the same small coordinates.  State is per-silo (closure dict) —
+        # fine for persistent silo processes, intentionally beyond the
+        # reference's stateless-client contract (flag-gated).
+        _residual = {}
+
+        def encode(new_params, global_params, _silo=None):
             delta = jax.tree.map(
                 lambda a, b: np.asarray(a) - np.asarray(b),
                 new_params, global_params)
-            return compress_update(delta, cfg.wire_compression,
-                                   cfg.topk_frac)
+            if cfg.error_feedback and _silo in _residual:
+                delta = jax.tree.map(np.add, delta, _residual[_silo])
+            payload = compress_update(delta, cfg.wire_compression,
+                                      cfg.topk_frac)
+            if cfg.error_feedback:
+                sent = decompress_update(payload, delta)
+                _residual[_silo] = jax.tree.map(np.subtract, delta, sent)
+            return payload
 
         _decode_cache = {"ref": None, "host": None}
 
@@ -451,6 +465,11 @@ def run_cross_silo(cfg, data, mesh, sink):
             wire_stats["bytes"] += wire_bytes(payload)
             delta = decompress_update(payload, host_global)
             return jax.tree.map(np.add, host_global, delta)
+
+    def make_encode(silo_id):
+        if encode is None:
+            return None
+        return lambda new, g: encode(new, g, _silo=silo_id)
 
     history = []
 
@@ -480,7 +499,7 @@ def run_cross_silo(cfg, data, mesh, sink):
         hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
         server = make_server(hub.transport(0))
         silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
-                                   encode_upload=encode)
+                                   encode_upload=make_encode(i))
                  for i in range(1, n_silos + 1)]
         for s in silos:
             s.register_handlers()
@@ -501,7 +520,7 @@ def run_cross_silo(cfg, data, mesh, sink):
             return history[-1] if history else {}
         silo = FedAvgClientActor(cfg.node_id, transport,
                                  make_train_fn(cfg.node_id),
-                                 encode_upload=encode)
+                                 encode_upload=make_encode(cfg.node_id))
         silo.register_handlers()
         transport.run()
         return {}

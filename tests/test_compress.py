@@ -116,6 +116,25 @@ def test_unknown_scheme():
         compress_update({}, "gzip")
 
 
+def test_error_feedback_recovers_aggressive_topk():
+    """EF-SGD property: at an aggressive top-k fraction over multiple
+    rounds, carrying the dropped residual forward must track the
+    uncompressed run more closely than plain top-k (same seeds)."""
+    from fedml_tpu.experiments.main import main
+    argv = ["--algo", "cross_silo", "--model", "lr", "--dataset", "mnist",
+            "--client_num_in_total", "4", "--client_num_per_round", "4",
+            "--comm_round", "8", "--frequency_of_the_test", "7",
+            "--batch_size", "16", "--epochs", "1", "--lr", "0.1",
+            "--log_stdout", "false"]
+    plain = main(argv)
+    topk = ["--wire_compression", "topk", "--topk_frac", "0.02"]
+    noef = main(argv + topk)
+    ef = main(argv + topk + ["--error_feedback", "true"])
+    gap_noef = abs(noef["train_loss"] - plain["train_loss"])
+    gap_ef = abs(ef["train_loss"] - plain["train_loss"])
+    assert gap_ef < gap_noef, (gap_ef, gap_noef)
+
+
 @pytest.mark.parametrize("scheme", ["int8", "topk"])
 def test_cli_cross_silo_with_compression(scheme):
     """End-to-end: compressed-upload federation still learns (loss finite,
