@@ -111,6 +111,9 @@ class ExperimentConfig:
     #                                   the next round's delta (EF-SGD style;
     #                                   silo-local state, so gRPC silos must
     #                                   be persistent processes — they are)
+    completion_signal: str = ""       # write the final summary line here on
+    #                                   completion (FIFO or file; parity with
+    #                                   the reference's ./tmp/fedml pipe)
     platform: Optional[str] = None       # force jax platform (e.g. "cpu")
     host_device_count: int = 0           # virtual CPU devices (simulation)
     coordinator_address: Optional[str] = None  # multi-host bootstrap

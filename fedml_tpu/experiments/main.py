@@ -792,6 +792,14 @@ def main(argv=None) -> Dict[str, Any]:
         raise ValueError(
             f"--compute_dtype is not wired into --algo {cfg.algo}; "
             f"supported: {sorted(_DTYPE_RUNNERS)}")
+    # same fail-loudly convention: a silently-ignored EF flag would label
+    # uncompressed numbers as EF results
+    if cfg.wire_compression != "none" and cfg.algo != "cross_silo":
+        raise ValueError("--wire_compression only applies to "
+                         "--algo cross_silo (the host-edge wire)")
+    if cfg.error_feedback and cfg.wire_compression == "none":
+        raise ValueError("--error_feedback requires --wire_compression "
+                         "topk or int8")
     # decentralized_online consumes a streaming dataset (UCI SUSY/RO or a
     # synthetic stream) that the registry doesn't serve — its runner builds
     # it; loading here would KeyError on --dataset SUSY
@@ -815,10 +823,20 @@ def main(argv=None) -> Dict[str, Any]:
             summary = RUNNERS[cfg.algo](cfg, data, mesh, sink)
         sink.log({"final": summary})
     if is_main:
-        print(json.dumps({"algo": cfg.algo, "dataset": cfg.dataset,
-                          "model": cfg.model,
-                          **{k: v for k, v in summary.items()
-                             if isinstance(v, (int, float, str))}}))
+        line = json.dumps({"algo": cfg.algo, "dataset": cfg.dataset,
+                           "model": cfg.model,
+                           **{k: v for k, v in summary.items()
+                              if isinstance(v, (int, float, str))}})
+        print(line)
+        # sweep-orchestration completion signal (parity:
+        # post_complete_message_to_sweep_process writes to the named
+        # pipe ./tmp/fedml, fedavg/utils.py:19-27); works with a FIFO
+        # or a plain file.  Gated on a non-empty summary so a gRPC silo
+        # process (returns {}) can't prematurely unblock the orchestrator
+        # or truncate the server's real summary.
+        if cfg.completion_signal and summary:
+            with open(cfg.completion_signal, "w") as f:
+                f.write(line + "\n")
     return summary
 
 

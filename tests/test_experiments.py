@@ -176,6 +176,17 @@ def test_cli_cross_silo_grpc_loopback(tmp_path):
     assert '"train_acc"' in server.stdout
 
 
+def test_completion_signal_file(tmp_path):
+    """--completion_signal writes the final summary line (the reference's
+    sweep-orchestration named-pipe contract, fedavg/utils.py:19-27)."""
+    sig = tmp_path / "done"
+    summary = main(["--algo", "fedavg", "--model", "lr", "--dataset",
+                    "mnist", "--completion_signal", str(sig)] + _BASE)
+    line = json.loads(sig.read_text())
+    assert line["algo"] == "fedavg"
+    assert line["train_acc"] == summary["train_acc"]
+
+
 def test_metrics_sink(tmp_path):
     with MetricsSink(str(tmp_path)) as sink:
         sink.log({"acc": 0.5}, step=0)
