@@ -1,0 +1,205 @@
+"""SCAFFOLD (Karimireddy et al. 2020, arXiv:1910.06378) — control-variate
+FL that corrects client drift under heterogeneity.
+
+Beyond the reference's algorithm list (its closest is FedProx's proximal
+pull), included because the cohort engine makes the hard part — per-client
+persistent state — native: the control variates c_i live as ONE stacked
+pytree [client_num_in_total, ...] (host-side between rounds, a cohort
+gather/scatter per round), and the per-round math is a vmap'd local scan +
+weighted psum-able means, same shapes as every other cohort algorithm.
+
+Option II of the paper:
+
+    local step:   y ← y − lr·(∇f_i(y) + c − c_i)
+    c_i⁺        = c_i − c + (x − y_i)/(K·lr)
+    x⁺          = x + mean_{i∈S}(y_i − x)
+    c⁺          = c + (|S|/N)·mean_{i∈S}(c_i⁺ − c_i)
+
+Cohort sampling reuses the deterministic seeded chain
+(core/sampling.sample_clients), so the stateful step can re-derive the
+round's client ids exactly as FedAvg.run gathered them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.trainer.workload import Workload
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class ScaffoldConfig(FedAvgConfig):
+    pass  # lr/epochs/batch_size/... carry the SCAFFOLD meaning directly
+
+
+def make_scaffold_local(workload: Workload, lr: float, epochs: int):
+    """train(params, data, rng, c_diff) -> (y_i, steps_taken).
+
+    ``c_diff = c − c_i`` is added to every gradient (the drift correction);
+    plain SGD per the paper.  The workload's ``grad_clip_norm`` is honored
+    AFTER the correction — the same corrected-then-clipped ordering the
+    FedAvg local trainer uses for its prox term (local_sgd.py), which is
+    what keeps the round-1 == FedAvg property exact for clipped workloads.
+    Fully-padded batches freeze the carry AND don't count toward K, so
+    (x − y)/(K·lr) stays exact for ragged clients."""
+    import optax
+    clip = (optax.clip_by_global_norm(workload.grad_clip_norm)
+            if workload.grad_clip_norm is not None else None)
+
+    grad_fn = jax.grad(lambda p, b, r: workload.loss_fn(p, b, r, True)[0])
+
+    def train(params: Pytree, data: Dict[str, jax.Array], rng: jax.Array,
+              c_diff: Pytree):
+        num_steps = jax.tree.leaves(data)[0].shape[0]
+        clip_state = clip.init(params) if clip is not None else None
+
+        def step(carry, step_idx):
+            y, k, rng = carry
+            rng, drng = jax.random.split(rng)
+            batch = jax.tree.map(lambda x: x[step_idx % num_steps], data)
+            grads = grad_fn(y, batch, drng)
+            grads = jax.tree.map(jnp.add, grads, c_diff)
+            if clip is not None:
+                grads, _ = clip.update(grads, clip_state)
+            got_data = jnp.sum(batch["mask"]) > 0
+            gd = got_data.astype(jnp.float32)
+            y = jax.tree.map(lambda p, g: p - lr * gd * g, y, grads)
+            return (y, k + gd, rng), None
+
+        (y, k, _), _ = jax.lax.scan(
+            step, (params, jnp.float32(0.0), rng),
+            jnp.arange(epochs * num_steps))
+        return y, k
+
+    return train
+
+
+class Scaffold(FedAvg):
+    """FedAvg.run drives this via the replaced ``cohort_step`` (host-gather
+    path — the stacked c_i state is scattered back per round, which the
+    HBM fast paths don't model).  The step re-derives the round's client
+    ids from the same seeded sampling chain run() used to gather the
+    cohort, tracked by an internal round counter."""
+
+    def __init__(self, workload, data, config: ScaffoldConfig, mesh=None,
+                 sink=None):
+        if mesh is not None:
+            raise ValueError("scaffold tracks per-client control variates "
+                             "host-side; mesh sharding is not wired — run "
+                             "single-chip")
+        if config.client_optimizer != "sgd":
+            raise ValueError(
+                "scaffold's local update is plain SGD with control-variate "
+                "correction (Karimireddy'20); --client_optimizer sgd only — "
+                "other optimizers would be silently ignored.  (wd is a "
+                "no-op for sgd framework-wide, matching "
+                "make_client_optimizer)")
+        if getattr(workload, "stateful", False):
+            raise ValueError(
+                "scaffold does not support stateful (BatchNorm) workloads: "
+                "control variates over running statistics are undefined — "
+                "use a GroupNorm model (e.g. resnet18_gn)")
+        super().__init__(workload, data, config, mesh=mesh, sink=sink)
+        cfg = config
+        self._round_counter = 0
+        self.c_global = None
+        self.c_locals = None  # stacked [client_num_in_total, ...]
+        local = make_scaffold_local(workload, cfg.lr, cfg.epochs)
+
+        @jax.jit
+        def round_step(params, cohort, rng, c_global, c_cohort):
+            n_clients = cohort["num_samples"].shape[0]
+            rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+                jnp.arange(n_clients))
+            c_diffs = jax.tree.map(lambda cg, ci: cg[None] - ci,
+                                   c_global, c_cohort)
+            batches = {k: v for k, v in cohort.items()
+                       if k != "num_samples"}
+            ys, ks = jax.vmap(local, in_axes=(None, 0, 0, 0))(
+                params, batches, rngs, c_diffs)
+            w = cohort["num_samples"].astype(jnp.float32)
+            live = (w > 0).astype(jnp.float32)
+            ratio = (w / jnp.maximum(jnp.sum(w), 1.0))
+            # x+ = x + Σ_i r_i (y_i − x)  (sample-weighted server step)
+            new_params = jax.tree.map(
+                lambda x, y: x + jnp.sum(
+                    (y - x[None])
+                    * ratio.reshape((-1,) + (1,) * (x.ndim)), axis=0),
+                params, ys)
+            # c_i+ = c_i − c + (x − y_i)/(K·lr); frozen for padded slots
+            k_safe = jnp.maximum(ks, 1.0)
+            new_c_cohort = jax.tree.map(
+                lambda ci, cg, x, y: jnp.where(
+                    live.reshape((-1,) + (1,) * x.ndim) > 0,
+                    ci - cg[None] + (x[None] - y)
+                    / (k_safe.reshape((-1,) + (1,) * x.ndim) * cfg.lr),
+                    ci),
+                c_cohort, c_global, params, ys)
+            # c+ = c + (|S|/N)·mean_{i∈S}(c_i+ − c_i)
+            m = jnp.maximum(jnp.sum(live), 1.0)
+            frac = m / self.data.client_num
+            new_c_global = jax.tree.map(
+                lambda cg, nci, ci: cg + frac * jnp.sum(
+                    (nci - ci) * live.reshape((-1,) + (1,) * (nci.ndim - 1)),
+                    axis=0) / m,
+                c_global, new_c_cohort, c_cohort)
+            return new_params, new_c_cohort, new_c_global
+
+        self._round_step = round_step
+        self.cohort_step = self._stateful_step
+
+    def run(self, params=None, rng=None, checkpointer=None):
+        # fresh runs restart the sampling-chain mirror; a checkpoint resume
+        # restores the true counter via _load_extra_state afterwards
+        self._round_counter = 0
+        return super().run(params=params, rng=rng, checkpointer=checkpointer)
+
+    def _stateful_step(self, params, cohort, rng):
+        if self.c_global is None:
+            self.c_global = jax.tree.map(jnp.zeros_like, params)
+            self.c_locals = jax.tree.map(
+                lambda x: jnp.zeros((self.data.client_num,) + x.shape,
+                                    x.dtype), params)
+        ids = sample_clients(self._round_counter, self.data.client_num,
+                             self.cfg.client_num_per_round)
+        self._round_counter += 1
+        m = cohort["num_samples"].shape[0]
+        padded = jnp.zeros(m, jnp.int32).at[:len(ids)].set(
+            jnp.asarray(ids, jnp.int32))
+        c_cohort = jax.tree.map(lambda c: jnp.take(c, padded, axis=0),
+                                self.c_locals)
+        params, new_c_cohort, self.c_global = self._round_step(
+            params, cohort, rng, self.c_global, c_cohort)
+        # scatter updated control variates back (live slots only — the
+        # round_step froze padded ones, but a padded slot aliases client 0)
+        live_n = len(ids)
+        self.c_locals = jax.tree.map(
+            lambda c, nc: c.at[jnp.asarray(ids, jnp.int32)].set(
+                nc[:live_n]),
+            self.c_locals, new_c_cohort)
+        return params, {}
+
+    # control-variate state rides the round checkpoint
+    def _extra_state(self):
+        return {"c_global": self.c_global, "c_locals": self.c_locals,
+                "round_counter": self._round_counter}
+
+    def _extra_state_template(self, params):
+        return {"c_global": jax.tree.map(jnp.zeros_like, params),
+                "c_locals": jax.tree.map(
+                    lambda x: jnp.zeros((self.data.client_num,) + x.shape,
+                                        x.dtype), params),
+                "round_counter": 0}
+
+    def _load_extra_state(self, extra) -> None:
+        self.c_global = extra["c_global"]
+        self.c_locals = extra["c_locals"]
+        self._round_counter = int(extra["round_counter"])

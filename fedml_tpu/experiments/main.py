@@ -352,6 +352,18 @@ def run_decentralized_online(cfg, data, mesh, sink):
             "accuracy": out["accuracy"]}
 
 
+@runner("scaffold")
+def run_scaffold(cfg, data, mesh, sink):
+    """SCAFFOLD control-variate FL (beyond the reference's list —
+    algorithms/scaffold.py)."""
+    from fedml_tpu.algorithms.scaffold import Scaffold, ScaffoldConfig
+    wl = _make_workload(cfg, data)
+    algo = Scaffold(wl, data, ScaffoldConfig(**_fedavg_cfg_kwargs(cfg)),
+                    mesh=mesh, sink=sink)
+    algo.run(checkpointer=_make_checkpointer(cfg))
+    return algo.history[-1] if algo.history else {}
+
+
 @runner("cross_silo")
 def run_cross_silo(cfg, data, mesh, sink):
     """Distributed FedAvg over the host-edge actor/transport layer — the
