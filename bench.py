@@ -11,17 +11,26 @@ Configs (BASELINE.md):
 * multi-device — the same cohort step sharded over a mesh when >1 device
   is visible (skipped on single-chip hosts).
 
-FLOPs come from XLA's own cost analysis of the compiled round program
-(``jit(...).lower().compile().cost_analysis()``), not hand math.  MFU =
-achieved FLOP/s ÷ peak; peak defaults to 197 TFLOP/s (TPU v5e bf16 — the
-computation runs f32, so reported MFU is conservative) and is overridable
-via BENCH_PEAK_TFLOPS.
+FLOPs come from XLA cost analysis of TWIN compiled programs
+(``_honest_flops``): cost analysis counts a ``lax.scan`` body ONCE
+regardless of trip count (verified empirically; the round-2 artifact
+under-reported the scanned-dispatch MFU by exactly its trip count this
+way), so per-round FLOPs are extrapolated from two rounds differing only
+in local-step count, with recurrent cells unrolled in the cost twin.
+MFU = achieved FLOP/s ÷ peak; peak comes from the detected device kind
+(bf16 peak — the computation runs f32 unless BENCH_DTYPE=bfloat16, so
+reported MFU is conservative), overridable via BENCH_PEAK_TFLOPS.
 
 stdout carries ONE JSON line (driver contract): the femnist_cnn rounds/s
 with vs_baseline = measured sequential-torch-CPU round time ratio (the
 reference's standalone simulator loop, fedavg_api.py:52-66 — an
 architectural baseline, not a hardware-parity one; see BENCH_DETAILS.json
 for the honest per-config breakdown, which is also written per-run).
+When the accelerator backend is unreachable (wedged tunnel) NOTHING is
+measured: the line carries ``skipped`` + the committed last-known-good
+TPU figures marked ``stale`` — never a CPU number dressed as a
+comparison, and BENCH_DETAILS.json is never overwritten.  An explicit
+``BENCH_PLATFORM=cpu`` run writes BENCH_DETAILS_cpu.json instead.
 
 Env knobs: BENCH_ROUNDS (default 20), BENCH_MODE=quick|full,
 BENCH_SCALING=0 to skip the curve, BENCH_PLATFORM to force a jax platform.
@@ -34,7 +43,29 @@ import time
 
 import numpy as np
 
-PEAK_TFLOPS = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
+# bf16 dense peak by TPU generation (public spec sheets); matched as a
+# substring of jax's device_kind.  The round-2 cohort-scaling numbers
+# exceeded the blanket v5e assumption (197) at 128 clients — the attached
+# chip's kind must be recorded, not assumed.
+_PEAK_BY_KIND = (("v6", 918.0), ("trillium", 918.0), ("v5p", 459.0),
+                 ("v5e", 197.0), ("v5lite", 197.0), ("v4", 275.0),
+                 ("v3", 123.0), ("v2", 45.0))
+
+
+def _peak_for_device(dev) -> float:
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = str(getattr(dev, "device_kind", "")).lower().replace(" ", "")
+    for key, peak in _PEAK_BY_KIND:
+        if key in kind:
+            return peak
+    return 197.0  # unknown accelerator: keep the v5e assumption
+
+
+# device-independent default (env override or v5e); main() re-resolves
+# from the attached chip's device_kind through the same parse path
+PEAK_TFLOPS = _peak_for_device(None)
 
 
 def _compute_dtype():
@@ -62,6 +93,94 @@ def _compiled_flops(jitted, *args) -> float:
         return 0.0
 
 
+def _honest_flops(model, classes, lr, epochs, batch_size, xs, ys,
+                  clients_per_round, workload=None):
+    """Per-round FLOPs that count every local step: (flops, total_steps).
+
+    XLA cost analysis counts a `lax.scan`/while body ONCE regardless of
+    trip count, and the local trainer runs its whole epochs*S-step run as
+    one scan (local_sgd.py) — so the full program's own number misses the
+    steps loop entirely.  Instead compile two TWIN rounds whose step scan
+    is fully UNROLLED (scan_unroll=S, so every step is present in the HLO
+    that cost analysis sees) at S=1 and S=2 batches, and extrapolate:
+
+        F(round) = F1 + (epochs*S - 1) * (F2 - F1)
+
+    F2 - F1 is exactly one step body (batch gather + fwd/bwd + optimizer);
+    F1 carries the per-round overhead (aggregation, weighing) once.  A
+    model whose SINGLE step hides another scan (the LSTM recurrence) needs
+    _rnn_round_flops instead — unrolling 80 cells makes a twin that takes
+    minutes to compile, so the recurrent cost is extrapolated over
+    sequence length too.  Twins always use the plain vmap cohort step:
+    mesh collectives add negligible FLOPs.
+    """
+    import jax
+    from fedml_tpu.data.stacking import gather_cohort
+
+    def f_for(nb):
+        need = nb * batch_size
+        xs_t, ys_t = [], []
+        for x, y in zip(xs[:clients_per_round], ys[:clients_per_round]):
+            reps = max(1, -(-need // len(x)))
+            xs_t.append(np.concatenate([x] * reps)[:need])
+            ys_t.append(np.concatenate([y] * reps)[:need])
+        step, params, stacked = _build_step(
+            model, classes, lr, 1, batch_size, xs_t, ys_t,
+            workload=workload, scan_unroll=nb)
+        cohort = gather_cohort(stacked, np.arange(clients_per_round),
+                               pad_to=clients_per_round)
+        return _compiled_flops(step, params, cohort, jax.random.key(0))
+
+    f1, f2 = f_for(1), f_for(2)
+    total_steps = epochs * max(1, -(-max(len(x) for x in xs) // batch_size))
+    flops = f1 + (total_steps - 1) * max(f2 - f1, 0.0)
+    return flops, total_steps
+
+
+def _rnn_round_flops(dtype, clients_per_round, n_steps, seq_len=80,
+                     batch=4, vocab=90, t_lo=4, t_hi=8):
+    """Exact per-round FLOPs for the LSTM config: (flops, n_steps).
+
+    The recurrence is a second scan INSIDE the training step, so the
+    _honest_flops twins alone still count the T-step cell chain once.
+    Unrolling all ``seq_len`` cells makes a twin that takes minutes to
+    compile; instead, per-step cost is affine in T (embed + cell + logits
+    + loss are all per-position; the optimizer update is T-independent),
+    so three SMALL fully-unrolled twins pin both lines:
+
+        A = (S=1, T=t_lo)   B = (S=2, T=t_lo)   C = (S=1, T=t_hi)
+        per_token = (C - A) / (t_hi - t_lo)
+        step(T)   = (B - A) + (T - t_lo) * per_token
+        round     = (2A - B) + n_steps * step(seq_len)
+
+    where 2A - B is the per-round overhead (aggregation) and B - A one
+    t_lo-length step.  All scans (steps and cells) are unrolled in the
+    twins so cost analysis sees every body."""
+    import jax
+    from fedml_tpu.data.stacking import gather_cohort
+    from fedml_tpu.models import RNNOriginalFedAvg
+    from fedml_tpu.trainer.workload import NWPWorkload
+
+    def f_at(nb, t):
+        rng = np.random.RandomState(0)
+        xs = [rng.randint(1, vocab, (nb * batch, t)).astype(np.int32)
+              for _ in range(clients_per_round)]
+        ys = [np.concatenate([x[:, 1:], x[:, :1]], axis=1) for x in xs]
+        wl = NWPWorkload(
+            RNNOriginalFedAvg(vocab_size=vocab, dtype=dtype, unroll=t),
+            compute_dtype=dtype)
+        step, params, stacked = _build_step(
+            None, vocab, 0.8, 1, batch, xs, ys, workload=wl, scan_unroll=nb)
+        cohort = gather_cohort(stacked, np.arange(clients_per_round),
+                               pad_to=clients_per_round)
+        return _compiled_flops(step, params, cohort, jax.random.key(0))
+
+    a, b, c = f_at(1, t_lo), f_at(2, t_lo), f_at(1, t_hi)
+    per_token = max(c - a, 0.0) / (t_hi - t_lo)
+    step_t = max(b - a, 0.0) + (seq_len - t_lo) * per_token
+    return max(2 * a - b, 0.0) + n_steps * step_t, n_steps
+
+
 def _synth_clients(n_clients, samples, shape, classes, seed=0):
     rng = np.random.RandomState(seed)
     xs = [rng.randn(samples, *shape).astype(np.float32)
@@ -72,7 +191,7 @@ def _synth_clients(n_clients, samples, shape, classes, seed=0):
 
 
 def _build_step(model, classes, lr, epochs, batch_size, xs, ys, mesh=None,
-                workload=None):
+                workload=None, scan_unroll=1):
     import jax
     import jax.numpy as jnp
     from fedml_tpu.data.stacking import stack_client_data, gather_cohort
@@ -86,7 +205,8 @@ def _build_step(model, classes, lr, epochs, batch_size, xs, ys, mesh=None,
         workload = ClassificationWorkload(model, num_classes=classes,
                                           compute_dtype=_compute_dtype())
     local = make_local_trainer(workload,
-                               make_client_optimizer("sgd", lr), epochs)
+                               make_client_optimizer("sgd", lr), epochs,
+                               scan_unroll=scan_unroll)
     step = make_cohort_step(local, mesh=mesh)
     params = workload.init(jax.random.key(0), jax.tree.map(
         lambda v: jnp.asarray(v[0, 0]),
@@ -96,7 +216,9 @@ def _build_step(model, classes, lr, epochs, batch_size, xs, ys, mesh=None,
 
 def _measure(step, params, stacked, clients_per_round, total_clients,
              rounds):
-    """Compile once, then time `rounds` rounds; returns (round_s, flops)."""
+    """Compile once, then time `rounds` rounds; returns round_s.  (FLOPs
+    come separately from _honest_flops — the full program's cost analysis
+    counts its scan bodies once and is NOT a per-round number.)"""
     import jax
     from fedml_tpu.core.sampling import sample_clients
     from fedml_tpu.data.stacking import gather_cohort
@@ -107,7 +229,6 @@ def _measure(step, params, stacked, clients_per_round, total_clients,
                 jax.random.key(i))
 
     cohort, rng = round_args(0)
-    flops = _compiled_flops(step, params, cohort, rng)
     params, _ = step(params, cohort, rng)          # warmup/compile
     jax.block_until_ready(params)
     t0 = _now()
@@ -115,7 +236,7 @@ def _measure(step, params, stacked, clients_per_round, total_clients,
         cohort, rng = round_args(i)
         params, _ = step(params, cohort, rng)
     jax.block_until_ready(params)
-    return (_now() - t0) / rounds, flops
+    return (_now() - t0) / rounds
 
 
 # the FEMNIST headline config, shared by the dispatch and scanned benches so
@@ -134,25 +255,38 @@ def _femnist_data(clients_per_round):
 
 
 def bench_femnist_cnn(rounds, clients_per_round=10, mesh=None,
-                      on_device=True):
+                      on_device=True, flops_base=None):
     """benchmark/README.md:54 config on synthetic FEMNIST-shaped data.
+    Returns (round_s, flops_per_round, steps_per_round).
 
     ``on_device`` (single-chip only): HBM-resident dataset + in-jit cohort
     gather (make_device_round) — the production fast path; False measures
-    the host-gather + re-upload path for comparison."""
+    the host-gather + re-upload path for comparison.  ``flops_base`` is an
+    optional (flops, steps, base_clients) from a previous call — per-round
+    FLOPs are linear in cohort size (per-client training and aggregation
+    both scale with clients), so the scaling curve reuses one twin
+    measurement instead of recompiling twins per cohort size."""
     from fedml_tpu.models import CNNOriginalFedAvg
     xs, ys = _femnist_data(clients_per_round)
+    model = CNNOriginalFedAvg(only_digits=False)
+    if flops_base is None:
+        flops, steps = _honest_flops(
+            model, FEMNIST_CLASSES, FEMNIST_LR, FEMNIST_EPOCHS,
+            FEMNIST_BATCH, xs, ys, clients_per_round)
+    else:
+        f0, steps, base_clients = flops_base
+        flops = f0 * clients_per_round / base_clients
     if on_device and mesh is None:
-        return _measure_device(
-            CNNOriginalFedAvg(only_digits=False), FEMNIST_CLASSES,
-            FEMNIST_LR, FEMNIST_EPOCHS, FEMNIST_BATCH, xs, ys,
-            clients_per_round, rounds)
+        round_s = _measure_device(
+            model, FEMNIST_CLASSES, FEMNIST_LR, FEMNIST_EPOCHS,
+            FEMNIST_BATCH, xs, ys, clients_per_round, rounds)
+        return round_s, flops, steps
     step, params, stacked = _build_step(
-        CNNOriginalFedAvg(only_digits=False), FEMNIST_CLASSES,
-        lr=FEMNIST_LR, epochs=FEMNIST_EPOCHS, batch_size=FEMNIST_BATCH,
-        xs=xs, ys=ys, mesh=mesh)
-    return _measure(step, params, stacked, clients_per_round, len(xs),
-                    rounds)
+        model, FEMNIST_CLASSES, lr=FEMNIST_LR, epochs=FEMNIST_EPOCHS,
+        batch_size=FEMNIST_BATCH, xs=xs, ys=ys, mesh=mesh)
+    round_s = _measure(step, params, stacked, clients_per_round, len(xs),
+                       rounds)
+    return round_s, flops, steps
 
 
 def _device_setup(model, classes, lr, epochs, batch_size, xs, ys):
@@ -194,7 +328,6 @@ def _measure_device(model, classes, lr, epochs, batch_size, xs, ys,
         return jnp.asarray(ids.astype(np.int32))
 
     args0 = (params, stacked_dev, ids_for(0), live, jax.random.key(0))
-    flops = _compiled_flops(round_fn, *args0)
     params, _ = round_fn(*args0)
     jax.block_until_ready(params)
     t0 = _now()
@@ -202,13 +335,15 @@ def _measure_device(model, classes, lr, epochs, batch_size, xs, ys,
         params, _ = round_fn(params, stacked_dev, ids_for(i), live,
                              jax.random.key(i))
     jax.block_until_ready(params)
-    return (_now() - t0) / rounds, flops
+    return (_now() - t0) / rounds
 
 
 def bench_femnist_cnn_scanned(rounds, clients_per_round=10, k=20):
     """The dispatch-amortised fast path: lax.scan over K rounds per device
     dispatch (make_scanned_rounds).  At sub-ms round times the host loop is
-    latency-bound — this measures the true on-chip round rate."""
+    latency-bound — this measures the true on-chip round rate.  Returns
+    round_s only; per-round FLOPs are the dispatch config's (identical
+    hyperparameters by construction — shared FEMNIST_* constants)."""
     import jax
     import jax.numpy as jnp
     from fedml_tpu.core.sampling import sample_clients
@@ -231,7 +366,6 @@ def bench_femnist_cnn_scanned(rounds, clients_per_round=10, k=20):
 
     ids, live = ids_for(0)
     args0 = (params, stacked_dev, ids, live, jax.random.key(0))
-    flops = _compiled_flops(rounds_fn, *args0)
     params, _ = rounds_fn(*args0)     # warmup/compile
     jax.block_until_ready(params)
     n_chunks = max(1, rounds // k)
@@ -241,25 +375,33 @@ def bench_femnist_cnn_scanned(rounds, clients_per_round=10, k=20):
         params, _ = rounds_fn(params, stacked_dev, ids, live,
                               jax.random.key(c))
     jax.block_until_ready(params)
-    per_round = (_now() - t0) / (n_chunks * k)
-    return per_round, (flops / k if flops else 0.0)
+    return (_now() - t0) / (n_chunks * k)
 
 
 def bench_resnet56_cifar10(rounds, mesh=None, samples=512):
     """Flagship cross-silo config (benchmark/README.md:105): 10 clients,
     B=64; one local epoch measured (published runs use E=20 of 5000
-    samples — scale linearly)."""
+    samples — scale linearly).  Returns (round_s, flops, steps)."""
     from fedml_tpu.models import resnet56
     xs, ys = _synth_clients(10, samples, (32, 32, 3), 10)
+    flops, steps = _honest_flops(resnet56(10), 10, 0.001, 1, 64, xs, ys, 10)
     step, params, stacked = _build_step(
         resnet56(10), 10, lr=0.001, epochs=1, batch_size=64, xs=xs, ys=ys,
         mesh=mesh)
-    return _measure(step, params, stacked, 10, 10, rounds)
+    round_s = _measure(step, params, stacked, 10, 10, rounds)
+    return round_s, flops, steps
 
 
 def bench_shakespeare_rnn(rounds, clients_per_round=10):
     """The NLP family config (benchmark/README.md shakespeare row): 2-layer
-    LSTM(256) char LM, B=4, seq 80 — recurrence compiles to lax.scan."""
+    LSTM(256) char LM, B=4, seq 80 — recurrence compiles to lax.scan.
+    Returns (round_s, flops, steps).
+
+    The FLOPs come from _rnn_round_flops (cell scan extrapolated over
+    sequence length): without it, cost analysis counts the 80-step cell
+    scan once and the honest per-step cost is off by ~T (the round-2
+    artifact's 0.14% "MFU" was this accounting artifact, not a slow
+    kernel)."""
     from fedml_tpu.experiments.models import create_workload
 
     rng = np.random.RandomState(0)
@@ -270,10 +412,14 @@ def bench_shakespeare_rnn(rounds, clients_per_round=10):
     # create_workload owns the model-dtype/workload-dtype coupling
     wl = create_workload("rnn", "shakespeare", 90, (80,),
                          compute_dtype=os.environ.get("BENCH_DTYPE", ""))
+    n_steps = max(1, -(-samples // 4))
+    flops, steps = _rnn_round_flops(_compute_dtype(), clients_per_round,
+                                    n_steps)
     step, params, stacked = _build_step(
         None, 90, lr=0.8, epochs=1, batch_size=4, xs=xs, ys=ys, workload=wl)
-    return _measure(step, params, stacked, clients_per_round, len(xs),
-                    rounds)
+    round_s = _measure(step, params, stacked, clients_per_round, len(xs),
+                       rounds)
+    return round_s, flops, steps
 
 
 def bench_longcontext_transformer(steps=10, seq_len=2048, batch=2,
@@ -350,9 +496,8 @@ def bench_robust_backends(rounds, clients_per_round=10):
     for name, step in (
             ("xla", make_cohort_step(local, transform_update=transform)),
             ("pallas", make_cohort_step(local, aggregate=fused))):
-        round_s, _ = _measure(step, params, stacked, clients_per_round,
-                              len(xs), rounds)
-        out[name] = round_s
+        out[name] = _measure(step, params, stacked, clients_per_round,
+                             len(xs), rounds)
     return out
 
 
@@ -423,72 +568,117 @@ def _backend_alive(timeout_s: float = 120.0) -> bool:
     return proc.returncode == 0 and b"alive" in proc.stdout
 
 
+def _repo_path(name):
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+
+
+def _emit_skipped():
+    """Backend unreachable: measure NOTHING.  Emit a skipped marker plus
+    the committed last-known-good TPU figures clearly labeled stale — never
+    CPU numbers dressed as a comparison (round-2 verdict), and never a
+    vs_baseline."""
+    line = {"metric": "fedavg_round_time_femnist_cnn", "value": None,
+            "unit": "rounds/sec", "stale": True,
+            "skipped": "accelerator backend unreachable (wedged tunnel?); "
+                       "nothing measured this run"}
+    try:
+        with open(_repo_path("BENCH_DETAILS.json")) as f:
+            last = json.load(f)
+        cfgs = last.get("configs", {})
+        if last.get("platform") not in (None, "cpu"):
+            scan = cfgs.get("femnist_cnn_c10_scan20", {}).get("rounds_per_s")
+            disp = cfgs.get("femnist_cnn_c10", {}).get("rounds_per_s")
+            line["value"] = max(filter(None, (scan, disp)), default=None)
+            line["last_good_tpu"] = {
+                "platform": last.get("platform"),
+                "rounds_per_s_dispatch": disp,
+                "rounds_per_s_scan20": scan,
+                "source": "committed BENCH_DETAILS.json — STALE, from a "
+                          "previous clean TPU run, not this one"}
+    except Exception:
+        pass
+    print(json.dumps(line))
+
+
 def main():
-    fallback = False
     if not os.environ.get("BENCH_PLATFORM") and not _backend_alive():
-        # wedged/unreachable accelerator: produce honest CPU numbers
-        # (clearly labeled) instead of hanging the driver
-        fallback = True
-        os.environ["BENCH_PLATFORM"] = "cpu"
-        os.environ.setdefault("BENCH_FEMNIST_SAMPLES", "20")
-        os.environ.setdefault("BENCH_SCALING", "0")
+        _emit_skipped()
+        return
     if os.environ.get("BENCH_PLATFORM"):
         import jax
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     import jax
 
+    dev = jax.devices()[0]
+    on_cpu = dev.platform == "cpu"
+    if on_cpu:
+        # explicit BENCH_PLATFORM=cpu developer run: shrink so it terminates
+        # (a CNN round is ~7-14 s on CPU) — results go to
+        # BENCH_DETAILS_cpu.json, never over the TPU artifact
+        os.environ.setdefault("BENCH_FEMNIST_SAMPLES", "20")
+        os.environ.setdefault("BENCH_SCALING", "0")
+    global PEAK_TFLOPS
+    PEAK_TFLOPS = _peak_for_device(dev)
+
     rounds = int(os.environ.get("BENCH_ROUNDS", "20"))
     full = os.environ.get("BENCH_MODE", "quick") == "full"
-    details = {"platform": jax.devices()[0].platform,
+    details = {"platform": dev.platform,
+               "device_kind": str(getattr(dev, "device_kind", "unknown")),
                "n_devices": len(jax.devices()),
                "peak_tflops_assumed": PEAK_TFLOPS,
+               "femnist_samples_per_client": int(os.environ.get(
+                   "BENCH_FEMNIST_SAMPLES", "200")),
+               "flops_accounting": (
+                   "twin-program extrapolation (_honest_flops): scan "
+                   "bodies counted per trip, LSTM recurrence unrolled in "
+                   "the cost twin"),
                "configs": {}}
-    if fallback:
-        details["platform_fallback"] = (
-            "default accelerator backend unreachable (wedged tunnel?); "
-            "CPU fallback numbers — not comparable to TPU runs")
 
     # 1) cross-device headline
-    round_s, flops = bench_femnist_cnn(rounds)
+    round_s, flops, steps = bench_femnist_cnn(rounds)
     details["configs"]["femnist_cnn_c10"] = {
         "round_s": round_s, "rounds_per_s": 1.0 / round_s,
+        "steps_per_round": steps,
         "flops_per_round": flops, "mfu": _mfu(flops, round_s)}
 
-    # 1b) dispatch-amortised headline (scan K rounds per dispatch)
-    # (a CPU fallback run does ~14s/CNN-round — shrink so bench terminates)
-    on_cpu = details["platform"] == "cpu"
-    scan_round_s, scan_flops = bench_femnist_cnn_scanned(
+    # 1b) dispatch-amortised headline (scan K rounds per dispatch);
+    # identical hyperparameters to 1), so per-round FLOPs are shared
+    scan_round_s = bench_femnist_cnn_scanned(
         4 if on_cpu else max(rounds, 20), k=2 if on_cpu else 20)
     details["configs"]["femnist_cnn_c10_scan20"] = {
         "round_s": scan_round_s, "rounds_per_s": 1.0 / scan_round_s,
-        "flops_per_round": scan_flops, "mfu": _mfu(scan_flops, scan_round_s)}
+        "steps_per_round": steps,
+        "flops_per_round": flops, "mfu": _mfu(flops, scan_round_s)}
 
-    # 2) flagship cross-silo (skipped on a CPU fallback run: resnet56
+    # 2) flagship cross-silo (skipped on explicit-CPU runs: resnet56
     # training steps take tens of seconds per round there)
     if not on_cpu:
         r56_rounds = max(3, rounds // 4)
         samples = int(os.environ.get("BENCH_R56_SAMPLES",
                                      "5000" if full else "512"))
-        round_s56, flops56 = bench_resnet56_cifar10(r56_rounds,
-                                                    samples=samples)
-        steps = 10 * (samples // 64)
+        round_s56, flops56, steps56 = bench_resnet56_cifar10(
+            r56_rounds, samples=samples)
         details["configs"]["resnet56_cifar10_c10_b64"] = {
             "round_s": round_s56, "samples_per_client": samples,
-            "step_time_ms": 1e3 * round_s56 / max(steps, 1),
+            "steps_per_round": steps56,
+            # per vmapped step (10 clients' B=64 batches advance together)
+            "step_time_ms": 1e3 * round_s56 / max(steps56, 1),
             "flops_per_round": flops56, "mfu": _mfu(flops56, round_s56)}
     else:
         details["configs"]["resnet56_cifar10_c10_b64"] = {"mfu": 0.0,
                                                           "skipped": "cpu"}
 
-    # 2b) NLP family: shakespeare char-LM (skipped on CPU fallback)
+    # 2b) NLP family: shakespeare char-LM (skipped on explicit-CPU runs)
     if not on_cpu:
-        rnn_s, rnn_fl = bench_shakespeare_rnn(max(3, rounds // 4))
+        rnn_s, rnn_fl, rnn_steps = bench_shakespeare_rnn(
+            max(3, rounds // 4))
         details["configs"]["shakespeare_rnn_c10_b4"] = {
             "round_s": rnn_s, "rounds_per_s": 1.0 / rnn_s,
+            "steps_per_round": rnn_steps,
             "flops_per_round": rnn_fl, "mfu": _mfu(rnn_fl, rnn_s)}
 
     # 2c) defended aggregation: XLA transform hook vs fused Pallas kernel
-    # (skipped on CPU fallback: the interpreter path is not a perf number)
+    # (skipped on CPU: the interpreter path is not a perf number)
     if not on_cpu:
         rb = bench_robust_backends(max(3, rounds // 4))
         details["configs"]["fedavg_robust_weakdp_c10"] = {
@@ -496,7 +686,7 @@ def main():
             "pallas_speedup": rb["xla"] / rb["pallas"]}
 
     # 2d) long-context transformer grad step (blockwise kv scan; the
-    # reference has no comparable capability).  CPU fallback: skipped.
+    # reference has no comparable capability).  CPU: skipped.
     # The flash-kernel variant only runs in BENCH_MODE=full (a second
     # multi-minute XLA compile on the tunnel-attached chip).
     if not on_cpu:
@@ -512,12 +702,13 @@ def main():
                 details["configs"]["transformer_T2048_flash"] = {
                     "skipped": str(e)[:120]}
 
-    # 3) cohort scaling curve
+    # 3) cohort scaling curve (FLOPs scale linearly from the c=10 twins)
     if os.environ.get("BENCH_SCALING", "1") != "0":
         curve = {}
         for c in (10, 32, 64, 128):
-            rs, fl = bench_femnist_cnn(max(3, rounds // 4),
-                                       clients_per_round=c)
+            rs, fl, _ = bench_femnist_cnn(max(3, rounds // 4),
+                                          clients_per_round=c,
+                                          flops_base=(flops, steps, 10))
             curve[str(c)] = {"rounds_per_s": 1.0 / rs,
                              "mfu": _mfu(fl, rs)}
         details["cohort_scaling"] = curve
@@ -527,8 +718,10 @@ def main():
         from fedml_tpu.parallel.mesh import make_mesh
         n = len(jax.devices())
         mesh = make_mesh(client_axis=n)
-        rs, fl = bench_femnist_cnn(max(3, rounds // 4),
-                                   clients_per_round=max(16, n), mesh=mesh)
+        rs, fl, _ = bench_femnist_cnn(max(3, rounds // 4),
+                                      clients_per_round=max(16, n),
+                                      mesh=mesh,
+                                      flops_base=(flops, steps, 10))
         details["configs"][f"femnist_cnn_mesh{n}"] = {
             "rounds_per_s": 1.0 / rs, "mfu": _mfu(fl, rs)}
 
@@ -547,25 +740,34 @@ def main():
             "flops likely overcount vs the fused executable; treat these "
             "as upper bounds, trust round_s/step_time_ms")
 
-    # baseline + primary line
+    # baseline + primary line.  Explicit-CPU runs write a separate details
+    # file so the committed TPU artifact is never clobbered (verify-skill
+    # artifact-hygiene rule); their vs_baseline is still honest — torch CPU
+    # vs jax CPU on the same host is a same-platform comparison.
     torch_s = bench_torch_baseline()
     details["torch_cpu_sequential_round_s"] = torch_s
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_DETAILS.json"), "w") as f:
+    out_name = "BENCH_DETAILS_cpu.json" if on_cpu else "BENCH_DETAILS.json"
+    with open(_repo_path(out_name), "w") as f:
         json.dump(details, f, indent=2)
     best_round_s = min(round_s, scan_round_s)
-    print(json.dumps({
+    line = {
         "metric": "fedavg_round_time_femnist_cnn",
         "value": round(1.0 / best_round_s, 3),
         "unit": "rounds/sec",
-        "platform": details["platform"] + ("-FALLBACK" if fallback else ""),
+        "platform": details["platform"],
+        "device_kind": details["device_kind"],
         "vs_baseline": round((torch_s or best_round_s) / best_round_s, 3),
         "rounds_per_s_dispatch": round(1.0 / round_s, 3),
         "rounds_per_s_scan20": round(1.0 / scan_round_s, 3),
         "mfu_femnist": round(details["configs"]["femnist_cnn_c10"]["mfu"], 4),
         "mfu_resnet56": round(
             details["configs"]["resnet56_cifar10_c10_b64"]["mfu"], 4),
-    }))
+    }
+    if on_cpu:
+        line["note"] = ("explicit BENCH_PLATFORM=cpu run; vs_baseline is a "
+                        "same-host torch-vs-jax CPU comparison, not a TPU "
+                        "number")
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -97,6 +97,10 @@ class FedAvgServerActor(ServerManager):
         self._received: Dict[int, tuple] = {}
         self._num_silos = 0  # silos contacted this round (= sampled cohort)
         self._timer: Optional[threading.Timer] = None
+        # silo ids whose uploads were aggregated last round, sent with the
+        # next sync so silos can settle deferred error-feedback residuals
+        # (a dropped upload must carry its FULL delta forward)
+        self._last_accepted: Optional[np.ndarray] = None
 
     def register_handlers(self) -> None:
         self.register_handler(MsgType.C2S_MODEL, self._on_model)
@@ -119,11 +123,13 @@ class FedAvgServerActor(ServerManager):
         # receive barrier must track the actual cohort size, not the config
         self._num_silos = len(ids)
         host_params = jax.tree.map(np.asarray, self.params)
+        extra = ({} if self._last_accepted is None
+                 else {Message.ARG_ACCEPTED: self._last_accepted})
         for silo, client_idx in enumerate(ids, start=1):
             self.send(msg_type, silo,
                       **{Message.ARG_MODEL_PARAMS: host_params,
                          Message.ARG_CLIENT_INDEX: int(client_idx),
-                         Message.ARG_ROUND: self.round_idx})
+                         Message.ARG_ROUND: self.round_idx, **extra})
         self._arm_timer()
 
     # -- straggler timer ----------------------------------------------------
@@ -209,6 +215,7 @@ class FedAvgServerActor(ServerManager):
         trees = [self._received[s][0] for s in sorted(self._received)]
         weights = np.array([self._received[s][1] for s in sorted(self._received)],
                            dtype=np.float32)
+        self._last_accepted = np.asarray(sorted(self._received), np.int32)
         self._received.clear()
         self.params = tree_weighted_mean(trees, weights)
         if self.on_round_done is not None:
@@ -231,12 +238,17 @@ class FedAvgClientActor(ClientManager):
 
     def __init__(self, node_id: int, transport: Transport,
                  train_fn: SiloTrainFn,
-                 encode_upload: Optional[Callable] = None):
+                 encode_upload: Optional[Callable] = None,
+                 on_accepted: Optional[Callable] = None):
         super().__init__(node_id, transport)
         self.train_fn = train_fn
         # optional wire compression: encode_upload(new_params,
         # global_params) -> payload (comm/compress.py)
         self.encode_upload = encode_upload
+        # optional ack hook: on_accepted(accepted_silo_ids | None) fires on
+        # every sync BEFORE training, so deferred error-feedback residuals
+        # settle (ErrorFeedback.resolve) before the next encode reads them
+        self.on_accepted = on_accepted
 
     def register_handlers(self) -> None:
         self.register_handler(MsgType.S2C_INIT, self._on_sync)
@@ -247,6 +259,8 @@ class FedAvgClientActor(ClientManager):
         params = msg.get(Message.ARG_MODEL_PARAMS)
         client_idx = msg.get(Message.ARG_CLIENT_INDEX)
         round_idx = msg.get(Message.ARG_ROUND)
+        if self.on_accepted is not None:
+            self.on_accepted(msg.get(Message.ARG_ACCEPTED))
         new_params, num_samples = self.train_fn(params, client_idx, round_idx)
         upload = jax.tree.map(np.asarray, new_params)
         if self.encode_upload is not None:

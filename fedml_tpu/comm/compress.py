@@ -15,11 +15,11 @@ neither):
 
 Both are LOSSY; the cross-silo runner applies them to uploads only (the
 down-link broadcast stays exact so silos never drift from the true global
-model).  Error-feedback accumulation (keeping the residual client-side and
-adding it to the next round's delta) composes naturally with the silo
-train_fn closure but is deliberately not built in here — cross-round client
-state contradicts the reference's stateless-client contract
-(FedAVGTrainer re-pointed per round, FedAVGTrainer.py:25-29).
+model).  ``ErrorFeedback`` keeps the compressor's residual silo-side and
+adds it to the next round's delta (EF-SGD) — cross-round client state
+deliberately beyond the reference's stateless-client contract
+(FedAVGTrainer re-pointed per round, FedAVGTrainer.py:25-29), so it is
+flag-gated in the runner.
 
 Pure numpy on purpose: compression runs host-side at the wire boundary,
 never inside a jit.
@@ -50,6 +50,7 @@ def compress_update(tree: Pytree, scheme: str, topk_frac: float = 0.1):
             if not np.issubdtype(x.dtype, np.floating) or x.size < 16:
                 comp.append({"dense": x})
                 continue
+            _check_finite(x, scheme)
             flat = x.reshape(-1)
             k = max(1, int(round(topk_frac * flat.size)))
             idx = np.argpartition(np.abs(flat), -k)[-k:].astype(np.int32)
@@ -65,6 +66,7 @@ def compress_update(tree: Pytree, scheme: str, topk_frac: float = 0.1):
             if not np.issubdtype(x.dtype, np.floating) or x.size < 16:
                 comp.append({"dense": x})
                 continue
+            _check_finite(x, scheme)
             amax = float(np.max(np.abs(x)))
             scale = amax / 127.0 if amax > 0 else 1.0
             q = np.clip(np.round(x / scale), -127, 127).astype(np.int8)
@@ -100,6 +102,62 @@ def decompress_update(payload, like: Pytree) -> Pytree:
             out.append((np.asarray(d["q"], np.float32)
                         * float(d["scale"])).astype(d["dtype"]))
     return jax.tree.unflatten(treedef, out)
+
+
+def _check_finite(x, scheme: str) -> None:
+    """Fail loudly on NaN/Inf updates (module convention): a non-finite
+    amax makes int8 silently quantize the whole leaf to garbage, and topk's
+    argpartition over NaN silently picks arbitrary coordinates."""
+    if not np.isfinite(x).all():
+        raise ValueError(
+            f"non-finite values in update leaf (shape {x.shape}); "
+            f"refusing to {scheme}-compress a diverged update")
+
+
+class ErrorFeedback:
+    """Per-silo EF-SGD residual carry (Seide'14 / Karimireddy'19), ack-aware.
+
+    The naive update ``residual = delta - sent`` at encode time silently
+    loses the SENT part whenever the server drops the upload (straggler
+    policy "drop" / round timeout) — the compressed delta was never
+    aggregated, yet the silo forgets it.  So the residual update is
+    DEFERRED: ``record`` parks (delta, sent) until the next S2C sync
+    carries the server's accepted-silo list (Message.ARG_ACCEPTED) and
+    ``resolve`` settles it — accepted ⇒ carry delta - sent; dropped ⇒
+    carry the FULL delta forward.
+    """
+
+    def __init__(self):
+        self._residual: Dict[Any, Pytree] = {}
+        self._pending: Dict[Any, tuple] = {}
+
+    def apply(self, silo, delta: Pytree) -> Pytree:
+        """Add the carried residual to this round's delta."""
+        r = self._residual.get(silo)
+        if r is None:
+            return delta
+        import jax
+        return jax.tree.map(np.add, delta, r)
+
+    def record(self, silo, delta: Pytree, sent: Pytree) -> None:
+        """Park this round's (residual-augmented delta, decoded payload)
+        until the server's ack arrives."""
+        self._pending[silo] = (delta, sent)
+
+    def resolve(self, silo, accepted) -> None:
+        """Settle the parked residual once the next sync reveals whether
+        the upload was aggregated.  ``accepted=None`` (a server without the
+        ack field, or the INIT sync) assumes accepted — the pre-ack
+        behavior."""
+        if silo not in self._pending:
+            return
+        delta, sent = self._pending.pop(silo)
+        import jax
+        if accepted is None or int(silo) in np.asarray(accepted).astype(
+                np.int64).tolist():
+            self._residual[silo] = jax.tree.map(np.subtract, delta, sent)
+        else:
+            self._residual[silo] = delta
 
 
 def _treedef_token(treedef, tree) -> str:

@@ -112,6 +112,10 @@ class GrpcTransport(Transport):
                 log.warning("grpc transport node %d: no traffic for %.0fs; "
                             "shutting down receive loop", self.node_id,
                             self._idle_timeout_s)
+                # release the port and client channels now rather than at
+                # interpreter shutdown (stop() also enqueues _STOP, which
+                # is harmless — this loop is already returning)
+                self.stop()
                 return
             if item is _STOP:
                 return

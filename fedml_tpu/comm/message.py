@@ -40,6 +40,7 @@ class Message:
     ARG_NUM_SAMPLES = "num_samples"
     ARG_CLIENT_INDEX = "client_idx"
     ARG_ROUND = "round_idx"
+    ARG_ACCEPTED = "accepted_silos"  # silo ids aggregated last round (EF ack)
 
     def __init__(self, msg_type: int | str = 0, sender_id: int = 0,
                  receiver_id: int = 0):

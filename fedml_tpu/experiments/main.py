@@ -445,22 +445,26 @@ def run_cross_silo(cfg, data, mesh, sink):
         # error feedback (Seide'14 / Karimireddy'19): the part of the delta
         # the compressor dropped is kept silo-side and added to the NEXT
         # round's delta, so small topk fractions stop systematically losing
-        # the same small coordinates.  State is per-silo (closure dict) —
-        # fine for persistent silo processes, intentionally beyond the
-        # reference's stateless-client contract (flag-gated).
-        _residual = {}
+        # the same small coordinates.  Residual settlement is DEFERRED
+        # until the server's accepted-silos ack arrives with the next sync
+        # (ErrorFeedback.resolve via on_accepted): a dropped upload
+        # (straggler policy) carries its FULL delta forward instead of
+        # losing the sent part.  State is per-silo — fine for persistent
+        # silo processes, intentionally beyond the reference's
+        # stateless-client contract (flag-gated).
+        from fedml_tpu.comm.compress import ErrorFeedback
+        _ef = ErrorFeedback()
 
         def encode(new_params, global_params, _silo=None):
             delta = jax.tree.map(
                 lambda a, b: np.asarray(a) - np.asarray(b),
                 new_params, global_params)
-            if cfg.error_feedback and _silo in _residual:
-                delta = jax.tree.map(np.add, delta, _residual[_silo])
+            if cfg.error_feedback:
+                delta = _ef.apply(_silo, delta)
             payload = compress_update(delta, cfg.wire_compression,
                                       cfg.topk_frac)
             if cfg.error_feedback:
-                sent = decompress_update(payload, delta)
-                _residual[_silo] = jax.tree.map(np.subtract, delta, sent)
+                _ef.record(_silo, delta, decompress_update(payload, delta))
             return payload
 
         _decode_cache = {"ref": None, "host": None}
@@ -482,6 +486,11 @@ def run_cross_silo(cfg, data, mesh, sink):
         if encode is None:
             return None
         return lambda new, g: encode(new, g, _silo=silo_id)
+
+    def make_on_accepted(silo_id):
+        if encode is None or not cfg.error_feedback:
+            return None
+        return lambda accepted: _ef.resolve(silo_id, accepted)
 
     history = []
 
@@ -511,7 +520,8 @@ def run_cross_silo(cfg, data, mesh, sink):
         hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
         server = make_server(hub.transport(0))
         silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
-                                   encode_upload=make_encode(i))
+                                   encode_upload=make_encode(i),
+                                   on_accepted=make_on_accepted(i))
                  for i in range(1, n_silos + 1)]
         for s in silos:
             s.register_handlers()
@@ -532,7 +542,8 @@ def run_cross_silo(cfg, data, mesh, sink):
             return history[-1] if history else {}
         silo = FedAvgClientActor(cfg.node_id, transport,
                                  make_train_fn(cfg.node_id),
-                                 encode_upload=make_encode(cfg.node_id))
+                                 encode_upload=make_encode(cfg.node_id),
+                                 on_accepted=make_on_accepted(cfg.node_id))
         silo.register_handlers()
         transport.run()
         return {}

@@ -22,15 +22,20 @@ class RNNOriginalFedAvg(nn.Module):
     hidden_size: int = 256
     dtype: object = None    # bf16 mixed precision: compute dtype of every
                             # embed/LSTM/dense (params stay param_dtype f32)
+    unroll: int = 1         # lax.scan unroll of the recurrence; >1 only for
+                            # FLOPs accounting (XLA cost analysis counts a
+                            # scan body once — see bench.py _honest_flops)
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False):
         x = nn.Embed(self.vocab_size, self.embedding_dim,
                      dtype=self.dtype)(input_seq)
         x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size,
-                                        dtype=self.dtype))(x)
+                                        dtype=self.dtype),
+                   unroll=self.unroll)(x)
         x = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size,
-                                        dtype=self.dtype))(x)
+                                        dtype=self.dtype),
+                   unroll=self.unroll)(x)
         return nn.Dense(self.vocab_size, dtype=self.dtype)(x)
 
 

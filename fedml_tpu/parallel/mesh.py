@@ -82,8 +82,19 @@ def tp_shard_params(params: Any, mesh: Mesh, axis: str = "model",
             # the q/k/v and out splits consistent so XLA needs one psum
             # per attention block, not a reshard.  GSPMD guarantees
             # correctness either way — the spec is a layout hint.
-            dim = 1 if x.shape[0] >= x.shape[-1] else 0
-            if x.shape[dim] % n == 0:
+            # Gate on the Megatron shape signature — one STRICTLY large
+            # d_model dim at position 0 or -1, two small head dims — so
+            # e.g. a Conv1D kernel [k, c_in, c_out] (two comparable large
+            # dims) stays replicated instead of sharding a spatial/channel
+            # dim, which GSPMD would accept but pay resharding for.
+            d0, d1, d2 = x.shape
+            if d0 > max(d1, d2):          # [d_model, H, dh] in-projection
+                dim = 1
+            elif d2 > max(d0, d1):        # [H, dh, d_model] out-projection
+                dim = 0
+            else:
+                dim = None
+            if dim is not None and x.shape[dim] % n == 0:
                 spec = [None, None, None]
                 spec[dim] = axis
                 return jax.device_put(x, NamedSharding(mesh, P(*spec)))

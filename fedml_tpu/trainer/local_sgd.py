@@ -31,7 +31,7 @@ Pytree = Any
 def make_local_trainer(workload: Workload,
                        optimizer: optax.GradientTransformation,
                        epochs: int, prox_mu: float = 0.0,
-                       grad_reduce=None):
+                       grad_reduce=None, scan_unroll: int = 1):
     """Returns ``train(params, data, rng) -> (new_params, metrics)``.
 
     ``data`` leaves are [S, B, ...] (S batches of size B) with ``mask``
@@ -49,7 +49,12 @@ def make_local_trainer(workload: Workload,
     before prox/clip/optimizer.  Sequence-parallel training uses it to
     `psum` the per-shard partial gradients over the ``sequence`` mesh axis
     (each shard's backward only sees its own logits' contribution to the
-    psum'd loss; parallel/sequence.py)."""
+    psum'd loss; parallel/sequence.py).
+
+    ``scan_unroll`` is forwarded to the step `lax.scan` — the default 1
+    keeps the compiled program small; bench FLOPs twins pass the full trip
+    count so XLA cost analysis (which counts a scan body once) sees every
+    step (bench.py _honest_flops)."""
     clip = (optax.clip_by_global_norm(workload.grad_clip_norm)
             if workload.grad_clip_norm is not None else None)
     stateful = workload.stateful
@@ -105,7 +110,8 @@ def make_local_trainer(workload: Workload,
 
         total_steps = epochs * num_steps
         (trained, state, _, _), losses = jax.lax.scan(
-            step, (trained, state, opt_state, rng), jnp.arange(total_steps))
+            step, (trained, state, opt_state, rng), jnp.arange(total_steps),
+            unroll=scan_unroll)
         out = {"params": trained, **state} if stateful else trained
         return out, {"train_loss_per_step": losses}
 

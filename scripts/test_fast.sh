@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# One-command non-slow test tier for the driver (VERDICT r02 #7).
+#
+# pytest-xdist shards across workers; --dist loadfile keeps each test file
+# on one worker (transport tests bind fixed ports and share module
+# fixtures, so file granularity avoids cross-worker collisions).  On a
+# multi-core box this lands well under 10 min; on a 1-core container it
+# degrades to roughly sequential speed — xdist cannot beat nproc.
+#
+#   WORKERS=4 scripts/test_fast.sh          # explicit worker count
+#   scripts/test_fast.sh -k compress        # extra pytest args pass through
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m pytest tests/ -m "not slow" -q \
+  -n "${WORKERS:-auto}" --dist loadfile "$@"

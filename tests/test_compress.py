@@ -136,6 +136,50 @@ def test_error_feedback_recovers_aggressive_topk():
 
 
 @pytest.mark.parametrize("scheme", ["int8", "topk"])
+def test_nonfinite_update_fails_loudly(rng, scheme):
+    """A NaN/Inf leaf must raise, not silently quantize to garbage (int8's
+    scale goes non-finite; topk argpartitions over NaN)."""
+    bad = {"w": np.asarray(rng.randn(64), np.float32)}
+    bad["w"][7] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        compress_update(bad, scheme)
+    bad["w"][7] = np.inf
+    with pytest.raises(ValueError, match="non-finite"):
+        compress_update(bad, scheme)
+
+
+def test_error_feedback_drop_carries_full_delta(rng):
+    """Ack-aware EF (round-2 advisor): a dropped upload must carry its
+    FULL delta into the next round's residual; an accepted upload carries
+    only delta - sent; no ack field (legacy server) behaves as accepted."""
+    from fedml_tpu.comm.compress import ErrorFeedback
+    ef = ErrorFeedback()
+    delta = {"w": np.asarray(rng.randn(64), np.float32)}
+
+    def one_round(silo, accepted):
+        d = ef.apply(silo, delta)
+        payload = compress_update(d, "topk", topk_frac=0.1)
+        sent = decompress_update(payload, d)
+        ef.record(silo, d, sent)
+        ef.resolve(silo, accepted)
+        return d, sent
+
+    # accepted: residual = delta - sent (the classic EF update)
+    d, sent = one_round(1, np.asarray([1, 2], np.int32))
+    np.testing.assert_allclose(ef._residual[1]["w"], d["w"] - sent["w"])
+    # dropped: the FULL augmented delta carries forward
+    d2, _ = one_round(1, np.asarray([2], np.int32))
+    np.testing.assert_allclose(ef._residual[1]["w"], d2["w"])
+    # and the next round's delta starts from it
+    np.testing.assert_allclose(ef.apply(1, delta)["w"], delta["w"] + d2["w"])
+    # legacy server (no ack field): assume accepted
+    d3, sent3 = one_round(1, None)
+    np.testing.assert_allclose(ef._residual[1]["w"], d3["w"] - sent3["w"])
+    # resolve without a pending record is a no-op
+    ef.resolve(99, np.asarray([1], np.int32))
+
+
+@pytest.mark.parametrize("scheme", ["int8", "topk"])
 def test_cli_cross_silo_with_compression(scheme):
     """End-to-end: compressed-upload federation still learns (loss finite,
     close to the uncompressed run for one full-batch round)."""

@@ -383,3 +383,25 @@ def test_gspmd_dp_tp_matches_single_chip(workload, devices):
     got, _ = step(params_tp, cohort_tp, rng)
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5), want, got)
+
+
+def test_tp_shard_params_3d_gate(devices):
+    """The Megatron 3-D split only fires on the attention shape signature
+    (one strictly-large d_model dim at position 0 or -1): a Conv1D-style
+    kernel [k, c_in, c_out] with two comparable large dims must stay
+    replicated (round-2 advisor), while real in/out projections shard."""
+    from jax.sharding import PartitionSpec as P
+    from fedml_tpu.parallel.mesh import tp_shard_params
+
+    mesh = make_mesh(client_axis=4, model_axis=2, devices=devices)
+    params = {
+        "qkv": jnp.zeros((64, 4, 16)),      # [d_model, H, dh] in-proj
+        "out": jnp.zeros((4, 16, 64)),      # [H, dh, d_model] out-proj
+        "conv1d": jnp.zeros((3, 32, 32)),   # [k, c_in, c_out]
+        "square": jnp.zeros((32, 4, 32)),   # ambiguous: two equal larges
+    }
+    placed = tp_shard_params(params, mesh, min_size=8)
+    assert placed["qkv"].sharding.spec == P(None, "model", None)
+    assert placed["out"].sharding.spec == P("model", None, None)
+    assert placed["conv1d"].sharding.spec == P()
+    assert placed["square"].sharding.spec == P()

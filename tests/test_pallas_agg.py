@@ -14,15 +14,19 @@ from fedml_tpu.core.robust import clip_update
 
 
 def _stacked_params(rng, n=6):
-    """A params-like tree with a 'batch_stats'-keyed branch (never clipped)
-    and a ragged mix of leaf shapes."""
+    """A params-like tree with a 'batch_stats'-keyed branch (never clipped),
+    an INTEGER leaf (the torch-style BN step counter — int leaves must take
+    the same weighted-mean-truncate path in both backends), and a ragged
+    mix of leaf shapes."""
     mk = lambda *s: jnp.asarray(rng.randn(n, *s).astype(np.float32))
     return {
         "params": {
             "dense": {"kernel": mk(17, 33), "bias": mk(33)},
             "conv": {"kernel": mk(3, 3, 2, 8)},
         },
-        "batch_stats": {"bn": {"mean": mk(8), "var": jnp.abs(mk(8))}},
+        "batch_stats": {"bn": {"mean": mk(8), "var": jnp.abs(mk(8)),
+                               "num_batches_tracked": jnp.asarray(
+                                   rng.randint(0, 100, (n, 1)), jnp.int32)}},
     }
 
 
