@@ -768,6 +768,24 @@ def setup_platform(cfg: ExperimentConfig) -> None:
         jax.config.update("jax_platforms", cfg.platform)
 
 
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache, gated on the RESOLVED backend
+    (initializes it): TPU first-compiles run 20-40s+ per program
+    (multi-minute for the big models), so caching makes every rerun of the
+    same config start hot.  CPU backends stay uncached — compiles are
+    cheap there and tests churn shapes, which would just grow the cache.
+    ``FEDML_TPU_CACHE=path`` overrides the location; empty disables.
+    Call AFTER platform selection (setup_platform), at a point where
+    backend initialization is acceptable."""
+    import os
+    import jax
+    cache = os.environ.get("FEDML_TPU_CACHE",
+                           os.path.expanduser("~/.cache/fedml_tpu_xla"))
+    if cache and jax.default_backend() != "cpu":
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+
 def main(argv=None) -> Dict[str, Any]:
     cfg = config_from_argv(argv) if not isinstance(argv, ExperimentConfig) \
         else argv
@@ -779,6 +797,7 @@ def main(argv=None) -> Dict[str, Any]:
     from fedml_tpu.parallel.mesh import init_distributed, make_mesh
     init_distributed(cfg.coordinator_address, cfg.num_processes,
                      cfg.process_id)
+    enable_compile_cache()
     mesh = None
     if cfg.mesh_groups > 0:
         if cfg.algo != "hierarchical":

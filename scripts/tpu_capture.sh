@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Full TPU perf capture — run when the tunnel is alive and the machine is
+# otherwise IDLE (concurrent work contaminates both the TPU timings and
+# the torch CPU baseline; verify skill).  One command covers every
+# VERDICT-r02 pending item:
+#   1. bf16 comparison run   -> BENCH_DETAILS_bf16.json
+#   2. resnet56 repeat runs  -> BENCH_R56_SPREAD.json (variance methodology)
+#   3. clean full f32 bench  -> BENCH_DETAILS.json (honest FLOPs,
+#      device_kind, per-round spread medians, flash + blockwise T=2048)
+# Ordered so the committed artifact (BENCH_DETAILS.json) is written LAST
+# by the canonical f32 run.  Aborts before touching anything if the
+# backend probe fails.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== backend probe (120s watchdog) =="
+timeout 120 python - <<'EOF'
+import jax, jax.numpy as jnp
+jax.block_until_ready(jax.jit(lambda a: a + 1)(jnp.ones(8)))
+d = jax.devices()[0]
+print("alive:", d.platform, getattr(d, "device_kind", "?"))
+EOF
+
+echo "== 1/3 bf16 comparison =="
+BENCH_DTYPE=bfloat16 BENCH_SCALING=0 python bench.py
+cp BENCH_DETAILS.json BENCH_DETAILS_bf16.json
+echo "bf16 details -> BENCH_DETAILS_bf16.json"
+
+echo "== 2/3 resnet56 repeat spreads (tunnel-jitter methodology) =="
+python - <<'EOF'
+import json
+import bench
+rows = []
+for rep in range(3):
+    round_s, flops, steps, spread = bench.bench_resnet56_cifar10(8)
+    rows.append({"rep": rep, "round_s": round_s, "spread": spread,
+                 "step_time_ms": 1e3 * round_s / steps})
+    print("rep", rep, rows[-1])
+with open("BENCH_R56_SPREAD.json", "w") as f:
+    json.dump(rows, f, indent=2)
+print("wrote BENCH_R56_SPREAD.json")
+EOF
+
+echo "== 3/3 full clean f32 bench (canonical BENCH_DETAILS.json) =="
+BENCH_MODE=full python bench.py
+
+echo "done — inspect BENCH_DETAILS.json / BENCH_DETAILS_bf16.json /"
+echo "BENCH_R56_SPREAD.json, then commit the clean artifacts."
