@@ -232,11 +232,11 @@ def _round_spread(run_round, params, rounds):
         params, _ = run_round(params, i)
         jax.block_until_ready(params)
         times.append(_now() - t0)
-    ts = np.sort(np.asarray(times))
+    ts = np.asarray(times)
     return {"mean": float(ts.mean()), "median": float(np.median(ts)),
-            "p10": float(ts[int(0.1 * (len(ts) - 1))]),
-            "p90": float(ts[int(0.9 * (len(ts) - 1))]),
-            "max": float(ts[-1]), "n": len(ts)}
+            "p10": float(np.percentile(ts, 10)),
+            "p90": float(np.percentile(ts, 90)),
+            "max": float(ts.max()), "n": len(ts)}
 
 
 def _measure(step, params, stacked, clients_per_round, total_clients,

@@ -103,6 +103,9 @@ class ExperimentConfig:
     #                           train/eval at long context
     attn_flash: bool = False  # transformer: TPU pallas flash-attention
     #                           kernel (fails loudly off-TPU)
+    moe_experts: int = 0      # >0 (transformer): Switch MoE FFN with this
+    #                           many experts (models/moe.py); expert tables
+    #                           are ep-shardable (parallel/expert.py)
     silo_idle_timeout_s: float = 0.0  # grpc silos: exit after this long
     #                                   with no traffic (0 = wait forever)
     wire_compression: str = "none"    # cross_silo uploads: none|topk|int8

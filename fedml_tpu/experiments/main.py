@@ -88,7 +88,8 @@ def _make_workload(cfg: ExperimentConfig, data):
                            sample_shape_of(data),
                            compute_dtype=cfg.compute_dtype,
                            attn_block_size=cfg.attn_block_size,
-                           attn_flash=cfg.attn_flash)
+                           attn_flash=cfg.attn_flash,
+                           moe_experts=cfg.moe_experts)
 
 
 def _make_checkpointer(cfg: ExperimentConfig):
@@ -154,6 +155,12 @@ def run_fedavg(cfg, data, mesh, sink):
         if cfg.model != "transformer":
             raise ValueError("--mesh_sequence requires --model transformer "
                              "(the ring-attention-capable model)")
+        if cfg.moe_experts:
+            raise ValueError(
+                "--moe_experts with --mesh_sequence is not supported: the "
+                "sequence-parallel loss path does not capture the Switch "
+                "load-balance loss (it would silently train with zero "
+                "balancing pressure); drop one of the flags")
         if not cfg.attn_block_size:
             logging.getLogger(__name__).warning(
                 "--mesh_sequence without --attn_block_size: init/eval run "

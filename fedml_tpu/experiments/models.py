@@ -30,7 +30,8 @@ def create_workload(model_name: str, dataset: str, class_num: int,
                     sample_shape: Sequence[int],
                     compute_dtype: str = "",
                     attn_block_size: int = 0,
-                    attn_flash: bool = False) -> Workload:
+                    attn_flash: bool = False,
+                    moe_experts: int = 0) -> Workload:
     """main_fedavg.py:224-259 switch, flax edition.
 
     ``compute_dtype="bfloat16"`` enables MXU-native mixed precision on the
@@ -40,9 +41,10 @@ def create_workload(model_name: str, dataset: str, class_num: int,
     ``attn_flash`` swaps in the TPU pallas flash kernel instead."""
     import jax.numpy as jnp
     dtype = jnp.dtype(compute_dtype) if compute_dtype else None
-    if (attn_block_size or attn_flash) and model_name != "transformer":
-        raise ValueError("--attn_block_size/--attn_flash only apply to "
-                         "--model transformer")
+    if (attn_block_size or attn_flash or moe_experts) \
+            and model_name != "transformer":
+        raise ValueError("--attn_block_size/--attn_flash/--moe_experts "
+                         "only apply to --model transformer")
     if attn_block_size and attn_flash:
         raise ValueError("--attn_block_size and --attn_flash are mutually "
                          "exclusive attention backends; pick one")
@@ -57,7 +59,8 @@ def create_workload(model_name: str, dataset: str, class_num: int,
             # same NWPWorkload contract, ring-attention capable
             model = TransformerLM(vocab_size=class_num, dtype=dtype,
                                   block_size=attn_block_size or None,
-                                  use_flash=attn_flash)
+                                  use_flash=attn_flash,
+                                  moe_experts=moe_experts)
         elif dataset == "stackoverflow_nwp":
             model = RNNStackOverflow(dtype=dtype)          # rnn.py:39-70
         else:

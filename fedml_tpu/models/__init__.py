@@ -21,3 +21,4 @@ from fedml_tpu.models.gan import (
 from fedml_tpu.models.segmentation import (
     DeepLabV3Plus, UNet, AlignedXception, ResNetBackbone, ASPP)
 from fedml_tpu.models.transformer import TransformerLM, CausalSelfAttention
+from fedml_tpu.models.moe import SwitchFFN

@@ -1,0 +1,95 @@
+"""Switch-style mixture-of-experts FFN — the expert-parallel (ep) member
+of the parallelism family.
+
+The reference has no MoE capability (its NLP zoo stops at LSTMs,
+fedml_api/model/nlp/rnn.py); this layer exists because expert parallelism
+is a first-class sharding for the framework (alongside dp/tp/sp/pp): the
+expert tables carry an explicit leading ``[E, ...]`` axis and all routing
+is dense einsums over it, so GSPMD shards experts across an ``experts``
+mesh axis with no manual collectives (parallel/expert.py) — the
+all-to-all dispatch/combine falls out of the einsum shardings, the
+scaling-book way.
+
+Routing follows Fedus et al. 2021 (Switch Transformer): top-1 router,
+capacity-bounded dispatch (tokens over capacity are DROPPED and ride the
+residual connection), and the load-balancing auxiliary loss
+``E * Σ_e f_e·P_e`` sown into the ``losses`` collection (NWPWorkload adds
+it to the CE loss when the model carries experts; ``sow`` is a silent
+no-op under plain apply, so eval paths need no changes).
+
+Everything is static-shaped and scan/vmap-friendly: argmax + cumsum +
+one_hot + einsum — no sorting, no dynamic shapes, nothing that blocks the
+MXU (SURVEY.md "XLA semantics").
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class SwitchFFN(nn.Module):
+    """Top-1 MoE FFN: [B, T, D] -> [B, T, D] with E experts.
+
+    ``capacity_factor`` bounds each expert's token buffer at
+    ``ceil(cf * N / E)`` (N = B*T tokens): static shapes for XLA, graceful
+    drop for hot experts.  The router always runs f32 (softmax is
+    range-sensitive; matches the workloads' f32-loss convention)."""
+    n_experts: int
+    d_model: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    dtype: object = None
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        n_tok = b * t
+        e = self.n_experts
+        cap = max(1, int(-(-self.capacity_factor * n_tok // e)))
+        xt = x.reshape(n_tok, d)
+
+        # -- top-1 routing (f32) ------------------------------------------
+        router_logits = nn.Dense(e, dtype=jnp.float32, name="router")(
+            xt.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)          # [N, E]
+        expert = jnp.argmax(probs, axis=-1)                     # [N]
+        gate = jnp.max(probs, axis=-1)                          # [N]
+        oh = jax.nn.one_hot(expert, e, dtype=jnp.float32)       # [N, E]
+
+        # load-balance aux (Switch eq. 4): pushes f (dispatch fraction)
+        # and P (mean router prob) toward uniform
+        f_frac = jnp.mean(oh, axis=0)
+        p_mean = jnp.mean(probs, axis=0)
+        self.sow("losses", "load_balance", e * jnp.sum(f_frac * p_mean))
+
+        # -- capacity-bounded dispatch tensor [N, E, C] --------------------
+        # position of each token within its expert's buffer; one_hot of an
+        # out-of-range position is all-zero, which IS the token drop
+        pos = jnp.cumsum(oh, axis=0) - 1.0
+        pos_in_e = jnp.sum(pos * oh, axis=-1).astype(jnp.int32)  # [N]
+        disp = oh[:, :, None] * jax.nn.one_hot(
+            pos_in_e, cap, dtype=jnp.float32)[:, None, :]       # [N, E, C]
+
+        # -- expert FFN over the explicit [E, ...] tables ------------------
+        dt = self.dtype or x.dtype
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (e, d, self.d_ff), jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros, (e, self.d_ff),
+                        jnp.float32)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (e, self.d_ff, d), jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros, (e, d), jnp.float32)
+
+        xe = jnp.einsum("nec,nd->ecd", disp.astype(dt), xt.astype(dt))
+        h = jnp.einsum("ecd,edf->ecf", xe, w1.astype(dt)) \
+            + b1.astype(dt)[:, None, :]
+        h = nn.gelu(h)
+        ye = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt)) \
+            + b2.astype(dt)[:, None, :]
+
+        # -- combine (gate-weighted; dropped tokens come back as 0) --------
+        comb = (disp * gate[:, None, None]).astype(dt)
+        yt = jnp.einsum("nec,ecd->nd", comb, ye)
+        return yt.reshape(b, t, d).astype(x.dtype)

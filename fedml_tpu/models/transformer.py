@@ -113,6 +113,11 @@ class TransformerLM(nn.Module):
     block_size: Optional[int] = None  # see CausalSelfAttention
     use_flash: bool = False           # see CausalSelfAttention
     auto_block_len: int = 1024        # see CausalSelfAttention
+    moe_experts: int = 0        # >0: Switch MoE FFN with this many experts
+    #                             (models/moe.py) — the ep-shardable form;
+    #                             NWPWorkload adds the sown balance loss
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01      # Switch paper's alpha
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False, positions=None,
@@ -136,9 +141,15 @@ class TransformerLM(nn.Module):
                 h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
             x = x + h
             h = nn.LayerNorm(dtype=self.dtype)(x)
-            h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
-            h = nn.gelu(h)
-            h = nn.Dense(self.d_model, dtype=self.dtype)(h)
+            if self.moe_experts:
+                from fedml_tpu.models.moe import SwitchFFN
+                h = SwitchFFN(self.moe_experts, self.d_model, self.d_ff,
+                              capacity_factor=self.moe_capacity_factor,
+                              dtype=self.dtype, name=f"moe_{i}")(h)
+            else:
+                h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
+                h = nn.gelu(h)
+                h = nn.Dense(self.d_model, dtype=self.dtype)(h)
             if self.dropout_rate:
                 h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
             x = x + h

@@ -1,0 +1,65 @@
+"""Expert parallelism (ep): shard MoE expert tables over an ``experts``
+mesh axis, GSPMD-style.
+
+The SwitchFFN layer (models/moe.py) keeps its experts as explicit
+``[E, ...]`` einsum operands precisely so that ep is a PLACEMENT, not an
+algorithm: put the tables' leading axis on the mesh's ``experts``
+dimension, jit the unchanged forward/training step, and XLA inserts the
+dispatch/combine collectives (the token->expert einsum becomes an
+all-to-all-shaped reduce across expert shards).  Same recipe as
+tp_shard_params — pick a mesh, annotate shardings, let XLA work
+(SURVEY.md §2.5: parallelism is a config knob).
+
+Composability: the ``experts`` axis can be a second mesh dimension next to
+``clients`` (dp x ep federated training) — each device then holds its
+cohort shard AND its expert shard, exactly like dp x tp.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_expert_mesh(n_experts_axis: int,
+                     devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D [experts] mesh (pure ep; compose via make_mesh-style grids for
+    dp x ep)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n_experts_axis:
+        raise ValueError(f"need {n_experts_axis} devices for the experts "
+                         f"axis, have {len(devices)}")
+    arr = np.asarray(devices[:n_experts_axis])
+    return Mesh(arr, ("experts",))
+
+
+def ep_shard_params(params: Any, mesh: Mesh, n_experts: int,
+                    axis: str = "experts") -> Any:
+    """Place MoE expert tables' leading [E] dim on the ``axis`` mesh axis;
+    everything else replicated.
+
+    Gated on BOTH the param path (inside a ``moe_*`` module — SwitchFFN's
+    naming in TransformerLM) and the leading-dim size, so a coincidental
+    E-sized leading dim elsewhere (a Dense kernel with in=E) never gets an
+    expert sharding.  The router stays replicated: every token needs every
+    router row."""
+    n = mesh.shape[axis]
+    if n_experts % n:
+        raise ValueError(f"n_experts={n_experts} not divisible by the "
+                         f"{axis} mesh axis ({n})")
+
+    def place(path, x):
+        keys = [str(getattr(p, "key", "")) for p in path]
+        in_moe = any(k.startswith("moe_") for k in keys)
+        is_router = any(k == "router" for k in keys)
+        nd = getattr(x, "ndim", 0)
+        if (in_moe and not is_router and nd >= 1
+                and x.shape[0] == n_experts):
+            spec = [axis] + [None] * (nd - 1)
+            return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        return jax.device_put(x, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map_with_path(place, params)

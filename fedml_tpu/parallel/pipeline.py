@@ -1,0 +1,212 @@
+"""Pipeline parallelism (pp): transformer blocks sharded over a
+``stages`` mesh axis, GPipe-style microbatching via shard_map + ppermute.
+
+The reference's only pipeline notion is SplitNN's two-party activation
+exchange (fedml_api/standalone/split_nn); this module is the general
+S-stage form for models too deep for one chip: each device holds L/S
+consecutive blocks, microbatches stream through the stages, and the
+activation hand-off between stages is a `lax.ppermute` hop riding ICI.
+The whole schedule — fill, steady state, drain — is ONE `lax.scan` inside
+ONE shard_map program, so XLA sees static shapes and the backward pass
+falls out of jax autodiff (the transpose of ppermute is the reverse
+permute, so gradients stream backward through the stages automatically —
+no hand-written 1F1B needed for correctness).
+
+Layout contract: block parameters carry an explicit leading layer axis
+``[L, ...]`` (built by vmapped init), reshaped to ``[S, L/S, ...]`` and
+placed with `P("stages")` — placement-as-parallelism, like tp
+(mesh.tp_shard_params) and ep (expert.ep_shard_params).
+
+Bubble accounting: a (M + S - 1)-step schedule does M steps of useful
+work per stage — efficiency M/(M+S-1); pick n_micro >= n_stages for
+>=50% (classic GPipe guidance).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.models.transformer import CausalSelfAttention
+
+
+def make_stage_mesh(n_stages: int,
+                    devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < n_stages:
+        raise ValueError(f"need {n_stages} devices for the stages axis, "
+                         f"have {len(devices)}")
+    return Mesh(np.asarray(devices[:n_stages]), ("stages",))
+
+
+class TransformerBlock(nn.Module):
+    """One pre-LN block (LN→MHA→residual, LN→GELU MLP→residual) — the
+    repeating unit the pipeline distributes.  Matches the DENSE inline
+    blocks of models.transformer.TransformerLM (attention is the shared
+    CausalSelfAttention module; only the LN/residual wiring is repeated
+    here — mirror any change to that wiring in both places).  The MoE FFN
+    variant is deliberately NOT pipelined: its balance loss rides a sown
+    collection that this module's scan-over-layers apply would silently
+    drop — combining ep with pp is future work, not a silent degradation."""
+    n_heads: int
+    d_model: int
+    d_ff: int
+    dtype: object = None
+
+    @nn.compact
+    def __call__(self, x, positions):
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = CausalSelfAttention(self.n_heads, self.d_model,
+                                dtype=self.dtype, name="attn")(h, positions)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype)(x)
+        h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.d_model, dtype=self.dtype)(h)
+        return x + h
+
+
+class PipelineLM:
+    """Decoder-only LM with an EXPLICIT stacked-blocks pytree, built for
+    pipelining: ``params = {"embed", "blocks" ([L, ...] leaves), "final"}``.
+
+    ``apply_seq`` is the single-device reference (scan over layers);
+    ``make_pp_apply`` returns the same function distributed over a
+    [stages] mesh.  Embedding and head stay replicated — tiny next to the
+    block stack that motivates pp — so only block activations travel."""
+
+    def __init__(self, vocab_size: int, d_model: int = 128, n_heads: int = 4,
+                 n_layers: int = 4, d_ff: int = 512, max_len: int = 2048,
+                 dtype=None):
+        self.n_layers = n_layers
+        self.dtype = dtype
+        self.block = TransformerBlock(n_heads, d_model, d_ff, dtype=dtype)
+        self.d_model = d_model
+        self.vocab_size = vocab_size
+        self.max_len = max_len
+
+        class _Embed(nn.Module):
+            dtype = None
+
+            @nn.compact
+            def __call__(s, toks, positions):
+                x = nn.Embed(vocab_size, d_model, dtype=dtype,
+                             name="tok_embed")(toks)
+                return x + nn.Embed(max_len, d_model, dtype=dtype,
+                                    name="pos_embed")(positions)[None]
+
+        class _Final(nn.Module):
+            @nn.compact
+            def __call__(s, x):
+                return nn.Dense(vocab_size, dtype=dtype, name="lm_head")(
+                    nn.LayerNorm(dtype=dtype)(x))
+
+        self._embed = _Embed()
+        self._final = _Final()
+
+    def init(self, rng: jax.Array, toks: jax.Array) -> Any:
+        t = toks.shape[1]
+        positions = jnp.arange(t)
+        r_embed, r_blocks, r_final = jax.random.split(rng, 3)
+        embed = self._embed.init(r_embed, toks, positions)["params"]
+        x = self._embed.apply({"params": embed}, toks, positions)
+        block_keys = jax.random.split(r_blocks, self.n_layers)
+        blocks = jax.vmap(
+            lambda k: self.block.init(k, x, positions)["params"])(block_keys)
+        final = self._final.init(r_final, x)["params"]
+        return {"embed": embed, "blocks": blocks, "final": final}
+
+    def _run_blocks(self, blocks, x, positions):
+        def one(h, layer_params):
+            return self.block.apply({"params": layer_params}, h,
+                                    positions), None
+        out, _ = jax.lax.scan(one, x, blocks)
+        return out
+
+    def apply_seq(self, params: Any, toks: jax.Array) -> jax.Array:
+        """Single-device reference forward: [B, T] -> [B, T, V]."""
+        positions = jnp.arange(toks.shape[1])
+        x = self._embed.apply({"params": params["embed"]}, toks, positions)
+        x = self._run_blocks(params["blocks"], x, positions)
+        return self._final.apply({"params": params["final"]}, x)
+
+    # ---- pipeline execution ---------------------------------------------
+    def pp_shard_params(self, params: Any, mesh: Mesh, n_stages: int) -> Any:
+        """[L, ...] block leaves -> [S, L/S, ...] placed on the stages
+        axis; embed/final replicated."""
+        if self.n_layers % n_stages:
+            raise ValueError(f"n_layers={self.n_layers} not divisible by "
+                             f"n_stages={n_stages}")
+        lps = self.n_layers // n_stages
+        blocks = jax.tree.map(
+            lambda v: jax.device_put(
+                v.reshape((n_stages, lps) + v.shape[1:]),
+                NamedSharding(mesh, P("stages"))), params["blocks"])
+        rep = lambda t: jax.tree.map(
+            lambda v: jax.device_put(v, NamedSharding(mesh, P())), t)
+        return {"embed": rep(params["embed"]), "blocks": blocks,
+                "final": rep(params["final"])}
+
+    def make_pp_apply(self, mesh: Mesh, n_micro: int):
+        """Returns ``fn(pp_params, toks) -> logits`` running the block
+        stack as a GPipe pipeline over ``mesh``'s stages axis.  ``toks``
+        batch must divide into ``n_micro`` microbatches."""
+        n_stages = mesh.shape["stages"]
+
+        def fn(params, toks):
+            b, t = toks.shape
+            if b % n_micro:
+                raise ValueError(f"batch {b} not divisible into "
+                                 f"{n_micro} microbatches")
+            positions = jnp.arange(t)
+            x = self._embed.apply({"params": params["embed"]}, toks,
+                                  positions)
+            x_mb = x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+            @partial(jax.shard_map, mesh=mesh,
+                     in_specs=(P("stages"), P()), out_specs=P())
+            def pipeline(blocks_sharded, xm):
+                sp = jax.tree.map(lambda v: v[0], blocks_sharded)
+                s = jax.lax.axis_index("stages")
+
+                def step(carry, ti):
+                    act, out = carry
+                    inp = jnp.where(s == 0,
+                                    xm[jnp.clip(ti, 0, n_micro - 1)], act)
+                    y = self._run_blocks(sp, inp, positions)
+                    nxt = jax.lax.ppermute(
+                        y, "stages",
+                        [(i, i + 1) for i in range(n_stages - 1)]) \
+                        if n_stages > 1 else y
+                    oidx = ti - (n_stages - 1)
+                    write = (s == n_stages - 1) & (oidx >= 0)
+                    upd = jax.lax.dynamic_update_index_in_dim(
+                        out, y, jnp.clip(oidx, 0, n_micro - 1), 0)
+                    out = jnp.where(write, upd, out)
+                    return (nxt, out), None
+
+                # the carry becomes device-varying inside the loop (each
+                # stage holds different activations); mark the zero init
+                # accordingly or the scan typecheck rejects it (same
+                # pattern as cohort.py's sharded path)
+                init = jax.lax.pcast(
+                    (jnp.zeros_like(xm[0]), jnp.zeros_like(xm)),
+                    ("stages",), to="varying")
+                (_, out), _ = jax.lax.scan(
+                    step, init, jnp.arange(n_micro + n_stages - 1))
+                # only the last stage holds real outputs; psum replicates
+                out = jnp.where(s == n_stages - 1, out,
+                                jnp.zeros_like(out))
+                return jax.lax.psum(out, "stages")
+
+            y = pipeline(params["blocks"], x_mb)
+            y = y.reshape((b, t, self.d_model))
+            return self._final.apply({"params": params["final"]}, y)
+
+        return fn

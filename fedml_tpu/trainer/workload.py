@@ -193,11 +193,23 @@ def NWPWorkload(model, pad_id: int = 0,
     def loss_fn(params, batch, rng, train):
         if compute_dtype is not None:
             params = cast_floats(params, compute_dtype)
-        logits = model.apply({"params": params}, batch["x"], train=train)
+        if getattr(model, "moe_experts", 0):
+            # capture the Switch load-balance terms sown per MoE layer
+            # (models/moe.py); plain applies elsewhere no-op the sow
+            logits, sown = model.apply({"params": params}, batch["x"],
+                                       train=train, mutable=["losses"])
+            # Switch eq. 4: each layer's aux SUMS into the loss at weight
+            # alpha (not a mean — a deeper stack gets more total pressure)
+            moe_aux = sum(jax.tree.leaves(sown.get("losses", {})))
+        else:
+            logits = model.apply({"params": params}, batch["x"], train=train)
+            moe_aux = 0.0
         logits = logits.astype(jnp.float32)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
         m = _position_mask(batch)
         loss = jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+        if getattr(model, "moe_experts", 0):
+            loss = loss + model.moe_aux_weight * moe_aux
         return loss, {"loss": loss}
 
     def metric_fn(params, batch):
