@@ -467,11 +467,14 @@ def bench_shakespeare_rnn(rounds, clients_per_round=10):
 
 
 def bench_longcontext_transformer(steps=10, seq_len=2048, batch=2,
-                                  block=256, use_flash=False):
+                                  block=256, use_flash=False,
+                                  moe_experts=0):
     """Long-context single-chip training step (the capability the
     reference's LSTM zoo caps at 80 tokens): TransformerLM grad step at
     ``seq_len`` with flash-style kv blocking (or the pallas flash kernel
-    when ``use_flash``).  Returns (step_s, tokens_per_s)."""
+    when ``use_flash``).  ``moe_experts`` swaps the FFN for the Switch
+    MoE layer (models/moe.py) — the routed-capacity timing point.
+    Returns (step_s, tokens_per_s)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -481,6 +484,7 @@ def bench_longcontext_transformer(steps=10, seq_len=2048, batch=2,
                           n_layers=2, d_ff=1024, max_len=seq_len,
                           block_size=None if use_flash else block,
                           use_flash=use_flash,
+                          moe_experts=moe_experts,
                           dtype=_compute_dtype())
     toks = jnp.asarray(np.random.RandomState(0).randint(
         0, 256, (batch, seq_len)), jnp.int32)
@@ -760,6 +764,13 @@ def main():
             except Exception as e:  # pallas kernel unavailable here
                 details["configs"]["transformer_T2048_flash"] = {
                     "skipped": str(e)[:120]}
+            # routed-FFN capability point: the SAME T=2048 config with a
+            # Switch MoE FFN (8 experts) — directly comparable tokens/s
+            # against transformer_T2048_blockwise (grouped routing keeps
+            # dispatch linear in T)
+            moe_s, moe_tok = bench_longcontext_transformer(moe_experts=8)
+            details["configs"]["transformer_T2048_moe8"] = {
+                "step_s": moe_s, "tokens_per_s": moe_tok}
 
     # 3) cohort scaling curve (FLOPs scale linearly from the c=10 twins)
     if os.environ.get("BENCH_SCALING", "1") != "0":

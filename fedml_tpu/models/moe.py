@@ -17,6 +17,20 @@ residual connection), and the load-balancing auxiliary loss
 it to the CE loss when the model carries experts; ``sow`` is a silent
 no-op under plain apply, so eval paths need no changes).
 
+Two deliberate departures from the naive formulation:
+
+* **Grouped routing** (the mesh-TF/Switch "group" dim): tokens are routed
+  within fixed-size groups, so the dispatch tensor is [G, g, E, C] with
+  C = ceil(cf·g/E) — linear in total token count, where one global group
+  would be quadratic (at B=2, T=2048, D=256 the one-group dispatch
+  einsum would cost more than the expert FFNs themselves).
+* **Pad masking**: padded positions (and zeroed federated batch rows)
+  share one embedding, so unmasked they would all route to the same
+  expert, eat its capacity, and pull the balance loss toward spreading
+  padding instead of real tokens.  ``mask`` removes them from dispatch
+  and from the f/P statistics; their output is 0, riding the residual,
+  and the workload's loss mask ignores them anyway.
+
 Everything is static-shaped and scan/vmap-friendly: argmax + cumsum +
 one_hot + einsum — no sorting, no dynamic shapes, nothing that blocks the
 MXU (SURVEY.md "XLA semantics").
@@ -24,53 +38,74 @@ MXU (SURVEY.md "XLA semantics").
 
 from __future__ import annotations
 
+from typing import Optional
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 
+def _auto_group(n_tok: int, target: int = 512, min_group: int = 64) -> int:
+    """Largest divisor of ``n_tok`` in [min_group, target], else n_tok
+    (realistic B*T values have power-of-two factors)."""
+    for g in range(min(target, n_tok), min_group - 1, -1):
+        if n_tok % g == 0:
+            return g
+    return n_tok
+
+
 class SwitchFFN(nn.Module):
     """Top-1 MoE FFN: [B, T, D] -> [B, T, D] with E experts.
 
-    ``capacity_factor`` bounds each expert's token buffer at
-    ``ceil(cf * N / E)`` (N = B*T tokens): static shapes for XLA, graceful
-    drop for hot experts.  The router always runs f32 (softmax is
-    range-sensitive; matches the workloads' f32-loss convention)."""
+    ``capacity_factor`` bounds each expert's per-group token buffer at
+    ``ceil(cf * g / E)``: static shapes for XLA, graceful drop for hot
+    experts.  ``group_size=0`` picks the largest divisor of B*T up to
+    512.  ``mask`` is [B, T] (1 = real token); None routes everything.
+    The router always runs f32 (softmax is range-sensitive; matches the
+    workloads' f32-loss convention)."""
     n_experts: int
     d_model: int
     d_ff: int
     capacity_factor: float = 1.25
+    group_size: int = 0
     dtype: object = None
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, mask: Optional[jax.Array] = None):
         b, t, d = x.shape
         n_tok = b * t
         e = self.n_experts
-        cap = max(1, int(-(-self.capacity_factor * n_tok // e)))
-        xt = x.reshape(n_tok, d)
+        g = self.group_size or _auto_group(n_tok)
+        if n_tok % g:
+            raise ValueError(f"group_size {g} must divide B*T = {n_tok}")
+        n_groups = n_tok // g
+        cap = max(1, int(-(-self.capacity_factor * g // e)))
+        xt = x.reshape(n_groups, g, d)
+        m = (jnp.ones((n_groups, g), jnp.float32) if mask is None
+             else mask.reshape(n_groups, g).astype(jnp.float32))
 
-        # -- top-1 routing (f32) ------------------------------------------
+        # -- top-1 routing (f32), pads excluded ---------------------------
         router_logits = nn.Dense(e, dtype=jnp.float32, name="router")(
-            xt.astype(jnp.float32))
-        probs = jax.nn.softmax(router_logits, axis=-1)          # [N, E]
-        expert = jnp.argmax(probs, axis=-1)                     # [N]
-        gate = jnp.max(probs, axis=-1)                          # [N]
-        oh = jax.nn.one_hot(expert, e, dtype=jnp.float32)       # [N, E]
+            xt.astype(jnp.float32))                          # [G, g, E]
+        probs = jax.nn.softmax(router_logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                  # [G, g]
+        gate = jnp.max(probs, axis=-1) * m                   # [G, g]
+        oh = jax.nn.one_hot(expert, e, dtype=jnp.float32) \
+            * m[:, :, None]                                  # [G, g, E]
 
-        # load-balance aux (Switch eq. 4): pushes f (dispatch fraction)
-        # and P (mean router prob) toward uniform
-        f_frac = jnp.mean(oh, axis=0)
-        p_mean = jnp.mean(probs, axis=0)
+        # load-balance aux (Switch eq. 4) over REAL tokens only
+        denom = jnp.maximum(jnp.sum(m), 1.0)
+        f_frac = jnp.sum(oh, axis=(0, 1)) / denom
+        p_mean = jnp.sum(probs * m[:, :, None], axis=(0, 1)) / denom
         self.sow("losses", "load_balance", e * jnp.sum(f_frac * p_mean))
 
-        # -- capacity-bounded dispatch tensor [N, E, C] --------------------
-        # position of each token within its expert's buffer; one_hot of an
-        # out-of-range position is all-zero, which IS the token drop
-        pos = jnp.cumsum(oh, axis=0) - 1.0
-        pos_in_e = jnp.sum(pos * oh, axis=-1).astype(jnp.int32)  # [N]
-        disp = oh[:, :, None] * jax.nn.one_hot(
-            pos_in_e, cap, dtype=jnp.float32)[:, None, :]       # [N, E, C]
+        # -- capacity-bounded dispatch tensor [G, g, E, C] -----------------
+        # per-group position of each token in its expert's buffer; one_hot
+        # of an out-of-range position is all-zero, which IS the token drop
+        pos = jnp.cumsum(oh, axis=1) - 1.0
+        pos_in_e = jnp.sum(pos * oh, axis=-1).astype(jnp.int32)  # [G, g]
+        disp = oh[..., None] * jax.nn.one_hot(
+            pos_in_e, cap, dtype=jnp.float32)[:, :, None, :]  # [G, g, E, C]
 
         # -- expert FFN over the explicit [E, ...] tables ------------------
         dt = self.dtype or x.dtype
@@ -82,14 +117,14 @@ class SwitchFFN(nn.Module):
                         (e, self.d_ff, d), jnp.float32)
         b2 = self.param("b2", nn.initializers.zeros, (e, d), jnp.float32)
 
-        xe = jnp.einsum("nec,nd->ecd", disp.astype(dt), xt.astype(dt))
-        h = jnp.einsum("ecd,edf->ecf", xe, w1.astype(dt)) \
-            + b1.astype(dt)[:, None, :]
+        xe = jnp.einsum("gnec,gnd->gecd", disp.astype(dt), xt.astype(dt))
+        h = jnp.einsum("gecd,edf->gecf", xe, w1.astype(dt)) \
+            + b1.astype(dt)[None, :, None, :]
         h = nn.gelu(h)
-        ye = jnp.einsum("ecf,efd->ecd", h, w2.astype(dt)) \
-            + b2.astype(dt)[:, None, :]
+        ye = jnp.einsum("gecf,efd->gecd", h, w2.astype(dt)) \
+            + b2.astype(dt)[None, :, None, :]
 
-        # -- combine (gate-weighted; dropped tokens come back as 0) --------
-        comb = (disp * gate[:, None, None]).astype(dt)
-        yt = jnp.einsum("nec,ecd->nd", comb, ye)
+        # -- combine (gate-weighted; dropped/pad tokens come back as 0) ----
+        comb = (disp * gate[..., None, None]).astype(dt)
+        yt = jnp.einsum("gnec,gecd->gnd", comb, ye)
         return yt.reshape(b, t, d).astype(x.dtype)

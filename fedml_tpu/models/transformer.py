@@ -118,6 +118,8 @@ class TransformerLM(nn.Module):
     #                             NWPWorkload adds the sown balance loss
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01      # Switch paper's alpha
+    pad_id: int = 0       # pad token id; MoE routing excludes pad positions
+    #                       (they would otherwise eat expert capacity)
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False, positions=None,
@@ -145,7 +147,8 @@ class TransformerLM(nn.Module):
                 from fedml_tpu.models.moe import SwitchFFN
                 h = SwitchFFN(self.moe_experts, self.d_model, self.d_ff,
                               capacity_factor=self.moe_capacity_factor,
-                              dtype=self.dtype, name=f"moe_{i}")(h)
+                              dtype=self.dtype, name=f"moe_{i}")(
+                    h, mask=(input_seq != self.pad_id))
             else:
                 h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
                 h = nn.gelu(h)

@@ -26,14 +26,30 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 def make_expert_mesh(n_experts_axis: int,
                      devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """1-D [experts] mesh (pure ep; compose via make_mesh-style grids for
-    dp x ep)."""
+    """1-D [experts] mesh (pure ep; make_dp_ep_mesh for the combined
+    federated form)."""
     devices = list(devices if devices is not None else jax.devices())
     if len(devices) < n_experts_axis:
         raise ValueError(f"need {n_experts_axis} devices for the experts "
                          f"axis, have {len(devices)}")
     arr = np.asarray(devices[:n_experts_axis])
     return Mesh(arr, ("experts",))
+
+
+def make_dp_ep_mesh(client_axis: int, expert_axis: int,
+                    devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """[clients, experts] mesh for dp x ep federated MoE training: cohort
+    rows sharded on ``clients`` (P("clients") data placement), expert
+    tables on ``experts`` (ep_shard_params works unchanged — it only needs
+    the axis name), everything under the PLAIN vmapped cohort step with
+    GSPMD inserting both the client psums and the expert all-to-alls."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = client_axis * expert_axis
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for a [{client_axis}, "
+                         f"{expert_axis}] mesh, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(client_axis, expert_axis)
+    return Mesh(arr, ("clients", "experts"))
 
 
 def ep_shard_params(params: Any, mesh: Mesh, n_experts: int,
