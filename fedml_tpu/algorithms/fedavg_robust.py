@@ -8,6 +8,10 @@ fedml_core/robustness/robust_aggregation.py).
 Here the defenses are the cohort engine's ``transform_update`` hook, so the
 whole defended round (local training + clip + noise + aggregation) remains
 one jit — on a mesh the defense runs shard-local before the psum.
+
+Beyond the reference, ``defense`` also accepts the Byzantine-tolerant
+aggregation rules of core/byzantine.py (coordinate_median, trimmed_mean,
+krum, multi_krum, geometric_median), which replace the aggregate itself.
 """
 
 from __future__ import annotations
@@ -15,6 +19,8 @@ from __future__ import annotations
 import dataclasses
 
 from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.core.byzantine import METHODS as BYZ_METHODS
+from fedml_tpu.core.byzantine import make_byzantine_aggregate
 from fedml_tpu.core.pallas_agg import make_fused_robust_aggregate
 from fedml_tpu.core.robust import add_gaussian_noise, clip_update
 from fedml_tpu.parallel.cohort import make_cohort_step
@@ -24,15 +30,19 @@ from fedml_tpu.trainer.workload import make_client_optimizer
 
 @dataclasses.dataclass
 class FedAvgRobustConfig(FedAvgConfig):
-    defense: str = "weak_dp"     # "norm_diff_clipping" | "weak_dp" | "none"
+    defense: str = "weak_dp"     # clip/DP (reference parity) or a
+    #                              Byzantine rule (core/byzantine.py)
     norm_bound: float = 5.0
     stddev: float = 0.025        # reference default for weak DP
     defense_backend: str = "xla"  # "xla" | "pallas" (fused kernel,
     #                                core/pallas_agg.py; single-chip only)
+    trim_frac: float = 0.1       # trimmed_mean: fraction cut per side
+    byz_f: int = 0               # krum: assumed Byzantine count
+    krum_m: int = 1              # multi_krum: how many updates to average
 
 
 class FedAvgRobust(FedAvg):
-    DEFENSES = ("norm_diff_clipping", "weak_dp", "none")
+    DEFENSES = ("norm_diff_clipping", "weak_dp", "none") + BYZ_METHODS
 
     def __init__(self, workload, data, config: FedAvgRobustConfig, mesh=None, sink=None):
         super().__init__(workload, data, config, mesh=mesh, sink=sink)
@@ -47,6 +57,26 @@ class FedAvgRobust(FedAvg):
 
         opt = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
         local_train = make_local_trainer(workload, opt, cfg.epochs)
+
+        if cfg.defense in BYZ_METHODS:
+            # Byzantine rules replace the AGGREGATE (they need the whole
+            # cohort: per-coordinate sorts / the pairwise distance matmul),
+            # so they ride the single-chip vmap engine; the mesh path's
+            # aggregation is a fixed psum and would need an all-gather
+            if mesh is not None:
+                raise ValueError(
+                    f"defense {cfg.defense!r} needs the full cohort on one "
+                    "chip (sorts / pairwise distances); drop --mesh_clients")
+            if cfg.defense_backend == "pallas":
+                raise ValueError(
+                    "defense_backend='pallas' fuses clip+noise+mean; "
+                    f"Byzantine rule {cfg.defense!r} has its own aggregate "
+                    "— use the xla backend")
+            agg = make_byzantine_aggregate(
+                cfg.defense, trim_frac=cfg.trim_frac, byz_f=cfg.byz_f,
+                krum_m=cfg.krum_m)
+            self.cohort_step = make_cohort_step(local_train, aggregate=agg)
+            return
 
         if cfg.defense_backend == "pallas" and cfg.defense != "none":
             # fused clip+noise+mean: one VMEM pass, no transformed [N, D]

@@ -54,7 +54,13 @@ class ExperimentConfig:
     gmf: float = 0.0                     # FedNova global momentum factor
     norm_bound: float = 5.0              # robust: clip threshold
     stddev: float = 0.025                # robust: weak-DP noise
-    defense: str = "weak_dp"             # robust: defense type | "none"
+    defense: str = "weak_dp"             # robust: clip/weak_dp/none or a
+    #                                      Byzantine rule (coordinate_median,
+    #                                      trimmed_mean, krum, multi_krum,
+    #                                      geometric_median)
+    trim_frac: float = 0.1               # trimmed_mean: cut per side
+    byz_f: int = 0                       # krum: assumed Byzantine count
+    krum_m: int = 1                      # multi_krum: updates averaged
     defense_backend: str = "xla"         # robust: "xla" | "pallas" (fused
     #                                      clip+noise+mean, core/pallas_agg)
     # robust: backdoor attack evaluation (poison_type pipeline,
