@@ -215,6 +215,10 @@ class FedAvg:
                 checkpointer.maybe_save(
                     round_idx, self._ckpt_state(params, rng, round_idx),
                     last_round=round_idx == cfg.comm_round - 1)
+        if checkpointer is not None:
+            # async_save: the final background write must be durable (and
+            # any write error surfaced) before the run reports success
+            checkpointer.flush()
         return params
 
     def _run_scanned(self, params, rng, start_round):

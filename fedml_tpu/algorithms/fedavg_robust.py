@@ -72,6 +72,16 @@ class FedAvgRobust(FedAvg):
                     "defense_backend='pallas' fuses clip+noise+mean; "
                     f"Byzantine rule {cfg.defense!r} has its own aggregate "
                     "— use the xla backend")
+            if cfg.defense in ("krum", "multi_krum"):
+                m = cfg.krum_m if cfg.defense == "multi_krum" else 1
+                max_m = cfg.client_num_per_round - cfg.byz_f - 2
+                if m > max_m:
+                    raise ValueError(
+                        f"multi-Krum needs m <= n - f - 2 = "
+                        f"{cfg.client_num_per_round} - {cfg.byz_f} - 2 = "
+                        f"{max_m}, got m={m}: selecting that many updates "
+                        "can include Byzantine ones, silently degenerating "
+                        "to a plain mean")
             agg = make_byzantine_aggregate(
                 cfg.defense, trim_frac=cfg.trim_frac, byz_f=cfg.byz_f,
                 krum_m=cfg.krum_m)

@@ -260,4 +260,6 @@ class HierarchicalFedAvg(FedAvg):
                     global_round,
                     self._ckpt_state(params, rng, global_round),
                     last_round=global_round == cfg.comm_round - 1)
+        if checkpointer is not None:
+            checkpointer.flush()  # final async write durable before return
         return params

@@ -137,6 +137,9 @@ class ExperimentConfig:
     # ---- checkpoint / resume (orbax round-level, SURVEY §5.4) ----------
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 10
+    checkpoint_async: bool = False  # background orbax saves (training
+    #                                 never blocks on I/O; durable at the
+    #                                 next save/flush/close/read)
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -97,7 +97,8 @@ def _make_checkpointer(cfg: ExperimentConfig):
         return None
     from fedml_tpu.utils.checkpoint import RoundCheckpointer
     return RoundCheckpointer(cfg.checkpoint_dir,
-                             save_every=cfg.checkpoint_every)
+                             save_every=cfg.checkpoint_every,
+                             async_save=cfg.checkpoint_async)
 
 
 def _eval_global(workload, params, data) -> Dict[str, float]:

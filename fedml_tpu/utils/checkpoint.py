@@ -51,9 +51,17 @@ class RoundCheckpointer:
     rounds, keeping ``max_to_keep`` checkpoints."""
 
     def __init__(self, ckpt_dir: str, save_every: int = 1,
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3, async_save: bool = False):
+        """``async_save=True`` lets orbax serialize in a background thread
+        so training never blocks on checkpoint I/O (the TPU stays fed).
+        Durability semantics: a save is guaranteed on disk only after the
+        NEXT save, ``flush()``, ``close()``, or any read (latest_round /
+        restore) — a process killed mid-write leaves the previous
+        checkpoint intact (orbax writes to a tmp dir and renames).  The
+        sync default trades round latency for save-returns-durable."""
         import orbax.checkpoint as ocp
         self.save_every = max(1, int(save_every))
+        self.async_save = async_save
         self.ckpt_dir = os.path.abspath(ckpt_dir)
         self._mngr = ocp.CheckpointManager(
             self.ckpt_dir,
@@ -71,9 +79,15 @@ class RoundCheckpointer:
         state = _pack_keys(state)
         self._mngr.save(round_idx,
                         args=self._ocp.args.StandardSave(state))
+        if not self.async_save:
+            self._mngr.wait_until_finished()
+
+    def flush(self) -> None:
+        """Block until every pending async save is durable."""
         self._mngr.wait_until_finished()
 
     def latest_round(self) -> Optional[int]:
+        self.flush()  # never report a step whose write is still in flight
         return self._mngr.latest_step()
 
     def restore(self, round_idx: Optional[int] = None,
@@ -82,6 +96,7 @@ class RoundCheckpointer:
         (e.g. a freshly-initialized state) — lets orbax restore to the exact
         dtypes/shardings.  Without it, orbax infers from the saved
         metadata."""
+        self.flush()
         step = round_idx if round_idx is not None else self.latest_round()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.ckpt_dir}")
@@ -93,4 +108,5 @@ class RoundCheckpointer:
         return _unpack_keys(restored)
 
     def close(self) -> None:
+        self.flush()
         self._mngr.close()

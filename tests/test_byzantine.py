@@ -168,3 +168,9 @@ def test_byzantine_rejects_mesh_and_pallas():
     with pytest.raises(ValueError, match="own aggregate"):
         FedAvgRobust(wl, data, FedAvgRobustConfig(
             defense="trimmed_mean", defense_backend="pallas"))
+    # multi-Krum selection bound: m <= n - f - 2, else the "defense"
+    # degenerates to a plain mean over everyone including attackers
+    with pytest.raises(ValueError, match="m <= n - f - 2"):
+        FedAvgRobust(wl, data, FedAvgRobustConfig(
+            defense="multi_krum", client_num_per_round=8, byz_f=2,
+            krum_m=8))

@@ -97,6 +97,23 @@ def test_save_every_gating(tmp_path):
     assert ck.latest_round() == 3
 
 
+def test_async_save_resumes_bit_identical(tmp_path):
+    """async_save=True must not change resume semantics: reads flush the
+    in-flight write first, so a resume right after a background save sees
+    the same state a sync save would have produced."""
+    wl, data = _setup()
+    straight = FedAvg(wl, data, FedAvgConfig(**_kwargs(4))).run()
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1,
+                           async_save=True)
+    FedAvg(wl, data, FedAvgConfig(**_kwargs(2))).run(checkpointer=ck)
+    assert ck.latest_round() == 1  # latest_round flushes pending writes
+    resumed = FedAvg(wl, data, FedAvgConfig(**_kwargs(4))).run(
+        checkpointer=ck)
+    _assert_trees_equal(straight, resumed)
+    ck.close()
+
+
 def test_cli_checkpoint_flag(tmp_path):
     from fedml_tpu.experiments.main import main
     argv = ["--algo", "fedavg", "--model", "lr", "--dataset", "mnist",
