@@ -74,11 +74,15 @@ class FedAvgRobust(FedAvg):
                     "— use the xla backend")
             if cfg.defense in ("krum", "multi_krum"):
                 m = cfg.krum_m if cfg.defense == "multi_krum" else 1
-                max_m = cfg.client_num_per_round - cfg.byz_f - 2
+                # the bound is on the LIVE cohort: sample_clients caps the
+                # cohort at the dataset's client count, so a small dataset
+                # shrinks n below the configured cohort size
+                n = min(cfg.client_num_per_round, data.client_num)
+                max_m = n - cfg.byz_f - 2
                 if m > max_m:
                     raise ValueError(
                         f"multi-Krum needs m <= n - f - 2 = "
-                        f"{cfg.client_num_per_round} - {cfg.byz_f} - 2 = "
+                        f"{n} - {cfg.byz_f} - 2 = "
                         f"{max_m}, got m={m}: selecting that many updates "
                         "can include Byzantine ones, silently degenerating "
                         "to a plain mean")

@@ -24,6 +24,7 @@ work per stage — efficiency M/(M+S-1); pick n_micro >= n_stages for
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 from typing import Any, Optional, Sequence
 
@@ -34,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedml_tpu.models.transformer import CausalSelfAttention
+from fedml_tpu.trainer.workload import Workload, make_nwp_loss_metrics
 
 
 def make_stage_mesh(n_stages: int,
@@ -210,3 +212,51 @@ class PipelineLM:
             return self._final.apply({"params": params["final"]}, y)
 
         return fn
+
+
+@dataclasses.dataclass(frozen=True)
+class _PPWorkload(Workload):
+    """Workload whose params are PipelineLM's explicit pytree (no flax
+    'params' collection to unwrap) and whose forward is an explicit
+    callable (PipelineLM has no flax ``.apply``)."""
+    forward: Any = None  # forward(params, toks) -> logits
+
+    def init(self, rng, sample_batch):
+        return self.model.init(rng, sample_batch["x"])
+
+    def apply(self, params, x, train=False, rng=None):
+        return self.forward(params, x)
+
+
+def _nwp_workload_over(plm: PipelineLM, forward, pad_id: int) -> Workload:
+    """NWP loss/metrics (the shared make_nwp_loss_metrics semantics) over
+    an arbitrary ``forward(params, toks)`` — the pipelined workload and
+    its sequential parity twin."""
+    loss_fn, metric_fn = make_nwp_loss_metrics(
+        lambda params, x, rng, train: (forward(params, x), 0.0), pad_id)
+    return _PPWorkload(model=plm, loss_fn=loss_fn, metric_fn=metric_fn,
+                       grad_clip_norm=None, forward=forward)
+
+
+def make_pp_nwp_workload(plm: PipelineLM, mesh: Mesh, n_micro: int,
+                         pad_id: int = 0) -> Workload:
+    """Next-word-prediction Workload whose forward runs the GPipe
+    pipeline — plugs pipeline parallelism into every Workload consumer
+    (the local trainer, evaluators, the cross-silo silo train_fn), so a
+    silo can train a model too deep for one chip over its local [stages]
+    mesh.
+
+    Scope: SILO-LOCAL training (make_local_trainer directly).  The
+    vmapped cohort engine cannot consume it — a shard_map pipeline under
+    vmap-over-clients is not a meaningful composition (each client would
+    need its own stage mesh); federated use is cross-silo, where
+    aggregation rides the wire and each silo runs this workload on its
+    own chips.  Params come from ``plm.init`` and should be placed with
+    ``plm.pp_shard_params`` before training."""
+    return _nwp_workload_over(plm, plm.make_pp_apply(mesh, n_micro), pad_id)
+
+
+def make_seq_nwp_workload(plm: PipelineLM, pad_id: int = 0) -> Workload:
+    """The single-device reference twin of make_pp_nwp_workload (same
+    params pytree, apply_seq forward) — the parity oracle."""
+    return _nwp_workload_over(plm, plm.apply_seq, pad_id)

@@ -173,47 +173,34 @@ def ClassificationWorkload(model, num_classes: int,
                     grad_clip_norm=grad_clip_norm, stateful=stateful)
 
 
-def NWPWorkload(model, pad_id: int = 0,
-                grad_clip_norm: Optional[float] = None,
-                compute_dtype=None) -> Workload:
-    """Next-word/char prediction: model emits [B, T, V] logits; CE averaged
-    over non-pad positions of valid rows (my_model_trainer_nwp.py semantics,
+def make_nwp_loss_metrics(forward, pad_id: int = 0):
+    """THE single home of the NWP loss/metric semantics: per-position CE
+    averaged over non-pad positions of valid rows, plus summable
+    correct/loss_sum/total metrics (my_model_trainer_nwp.py semantics,
     where torch CE with [B, V, T] logits means per-position CE).
 
-    ``compute_dtype=jnp.bfloat16``: casts params for bf16 weight loads and
-    f32 master/CE as in ClassificationWorkload — but flax RNN cells promote
-    to their own ``dtype``, so the MODEL must also be built with
-    ``dtype=bfloat16`` (RNNOriginalFedAvg/RNNStackOverflow take it;
-    create_workload wires both) or the recurrent matmuls stay f32."""
+    ``forward(params, x, rng, train) -> (logits [B, T, V], extra_loss)``
+    abstracts the model application — NWPWorkload's flax apply (with
+    dtype casting and the MoE balance-loss capture riding ``extra_loss``)
+    and the pipeline workload's GPipe forward (parallel/pipeline.py) both
+    build on this, so the masking/metric math cannot drift between them.
+    """
 
     def _position_mask(batch):
         tok_valid = (batch["y"] != pad_id).astype(jnp.float32)
         return tok_valid * batch["mask"][:, None]
 
     def loss_fn(params, batch, rng, train):
-        if compute_dtype is not None:
-            params = cast_floats(params, compute_dtype)
-        if getattr(model, "moe_experts", 0):
-            # capture the Switch load-balance terms sown per MoE layer
-            # (models/moe.py); plain applies elsewhere no-op the sow
-            logits, sown = model.apply({"params": params}, batch["x"],
-                                       train=train, mutable=["losses"])
-            # Switch eq. 4: each layer's aux SUMS into the loss at weight
-            # alpha (not a mean — a deeper stack gets more total pressure)
-            moe_aux = sum(jax.tree.leaves(sown.get("losses", {})))
-        else:
-            logits = model.apply({"params": params}, batch["x"], train=train)
-            moe_aux = 0.0
+        logits, extra = forward(params, batch["x"], rng, train)
         logits = logits.astype(jnp.float32)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
         m = _position_mask(batch)
-        loss = jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
-        if getattr(model, "moe_experts", 0):
-            loss = loss + model.moe_aux_weight * moe_aux
+        loss = jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0) + extra
         return loss, {"loss": loss}
 
     def metric_fn(params, batch):
-        logits = model.apply({"params": params}, batch["x"], train=False)
+        logits, _ = forward(params, batch["x"], None, False)
+        logits = logits.astype(jnp.float32)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
         pred = jnp.argmax(logits, axis=-1)
         m = _position_mask(batch)
@@ -223,6 +210,37 @@ def NWPWorkload(model, pad_id: int = 0,
             "total": jnp.sum(m),
         }
 
+    return loss_fn, metric_fn
+
+
+def NWPWorkload(model, pad_id: int = 0,
+                grad_clip_norm: Optional[float] = None,
+                compute_dtype=None) -> Workload:
+    """Next-word/char prediction over [B, T, V] logits
+    (make_nwp_loss_metrics has the loss semantics).
+
+    ``compute_dtype=jnp.bfloat16``: casts params for bf16 weight loads and
+    f32 master/CE as in ClassificationWorkload — but flax RNN cells promote
+    to their own ``dtype``, so the MODEL must also be built with
+    ``dtype=bfloat16`` (RNNOriginalFedAvg/RNNStackOverflow take it;
+    create_workload wires both) or the recurrent matmuls stay f32."""
+
+    def forward(params, x, rng, train):
+        if compute_dtype is not None:
+            params = cast_floats(params, compute_dtype)
+        if getattr(model, "moe_experts", 0) and train:
+            # capture the Switch load-balance terms sown per MoE layer
+            # (models/moe.py); plain applies elsewhere no-op the sow.
+            # Switch eq. 4: each layer's aux SUMS into the loss at weight
+            # alpha (not a mean — a deeper stack gets more total pressure)
+            logits, sown = model.apply({"params": params}, x,
+                                       train=train, mutable=["losses"])
+            extra = model.moe_aux_weight * sum(
+                jax.tree.leaves(sown.get("losses", {})))
+            return logits, extra
+        return model.apply({"params": params}, x, train=train), 0.0
+
+    loss_fn, metric_fn = make_nwp_loss_metrics(forward, pad_id)
     return Workload(model=model, loss_fn=loss_fn, metric_fn=metric_fn,
                     grad_clip_norm=grad_clip_norm)
 
