@@ -41,8 +41,20 @@ with open("BENCH_R56_SPREAD.json", "w") as f:
 print("wrote BENCH_R56_SPREAD.json")
 EOF
 
-echo "== 3/3 full clean f32 bench (canonical BENCH_DETAILS.json) =="
+echo "== 3/4 full clean f32 bench (canonical BENCH_DETAILS.json) =="
 BENCH_MODE=full python bench.py
 
+echo "== 4/4 profiler traces (resnet56 + shakespeare rounds) =="
+for cfg in "resnet56 cifar10" "rnn shakespeare"; do
+  set -- $cfg
+  if ! python -m fedml_tpu --algo fedavg --model "$1" --dataset "$2" \
+      --client_num_in_total 10 --client_num_per_round 10 --comm_round 3 \
+      --batch_size 64 --frequency_of_the_test 3 --log_stdout false \
+      --profile_dir "profiles/$1"; then
+    echo "WARNING: profiled $1 run FAILED — profiles/$1 is empty/partial"
+  fi
+done
+
 echo "done — inspect BENCH_DETAILS.json / BENCH_DETAILS_bf16.json /"
-echo "BENCH_R56_SPREAD.json, then commit the clean artifacts."
+echo "BENCH_R56_SPREAD.json + profiles/, then commit the clean artifacts"
+echo "(profiles/ stays local — gitignored)."
