@@ -1,0 +1,142 @@
+"""Asynchronous buffered federated aggregation (FedBuff-style) — beyond
+the reference.
+
+The reference's server is a strict barrier: every sampled client must
+report before aggregation (check_whether_all_receive,
+FedAvgServerManager.py:51), so one straggler stalls the world and its
+only escape is MPI.Abort.  Our cross-silo layer already softens that
+with wait/drop/abort policies; this module removes the barrier entirely,
+the Nguyen et al. 2022 (FedBuff) way:
+
+* silos train CONTINUOUSLY: upload a delta, immediately receive the
+  current global + a fresh client assignment, keep going;
+* the server buffers deltas and aggregates every ``aggregation_goal``
+  uploads — a "version" — applying each delta against the CURRENT global
+  with a staleness discount ``(1 + s)^-alpha`` where ``s`` is how many
+  versions elapsed since the silo's base model;
+* with ``aggregation_goal = n_silos``, ``alpha`` irrelevant (zero
+  staleness) and ``server_lr = 1`` the first version reduces EXACTLY to
+  a synchronous FedAvg round (the parity oracle in
+  tests/test_async_fl.py).
+
+Deltas ride the existing client actor's ``encode_upload`` hook (the same
+seam wire compression uses), so the client side is unchanged
+FedAvgClientActor choreography — INIT/SYNC in, MODEL out.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from fedml_tpu.comm.actors import ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.transport import Transport
+from fedml_tpu.algorithms.cross_silo import MsgType
+from fedml_tpu.core.sampling import sample_clients
+
+log = logging.getLogger(__name__)
+
+
+def delta_encoder(new_params, global_params):
+    """Client-side upload transform: send the UPDATE, not the weights —
+    the async server applies it to whatever global is current."""
+    return jax.tree.map(lambda a, b: np.asarray(a) - np.asarray(b),
+                        new_params, global_params)
+
+
+class AsyncFedServerActor(ServerManager):
+    """Barrier-free aggregator: buffer ``aggregation_goal`` deltas, apply
+    with staleness discounts, re-task exactly the silos whose uploads
+    were consumed.
+
+    ``num_versions`` plays comm_round's role: total aggregations before
+    FINISH.  ``on_version(version, params)`` is the eval hook."""
+
+    def __init__(self, transport: Transport, init_params,
+                 client_num_in_total: int, n_silos: int,
+                 num_versions: int, aggregation_goal: int,
+                 staleness_exponent: float = 0.5, server_lr: float = 1.0,
+                 on_version: Optional[Callable[[int, object], None]] = None,
+                 seed: int = 0):
+        super().__init__(0, transport)
+        if not 1 <= aggregation_goal <= n_silos:
+            raise ValueError(
+                f"aggregation_goal must be in [1, n_silos={n_silos}], "
+                f"got {aggregation_goal}")
+        self.params = init_params
+        self.client_num_in_total = client_num_in_total
+        self.n_silos = n_silos
+        self.num_versions = num_versions
+        self.goal = aggregation_goal
+        self.alpha = staleness_exponent
+        self.server_lr = server_lr
+        self.on_version = on_version
+        self.version = 0
+        self.staleness_seen: List[int] = []  # per consumed upload
+        self._buffer: List[Tuple[object, float, int]] = []
+        self._task_rng = np.random.RandomState(seed)
+
+    def register_handlers(self) -> None:
+        self.register_handler(MsgType.C2S_MODEL, self._on_model)
+
+    # -- tasking -----------------------------------------------------------
+    def start(self) -> None:
+        """Initial tasking: version-0 assignments use the same seeded
+        sampler as the synchronous paths, so goal == n_silos reduces to
+        the FedAvg round-0 cohort."""
+        ids = sample_clients(0, self.client_num_in_total, self.n_silos)
+        for silo, client_idx in enumerate(ids, start=1):
+            self._task(silo, int(client_idx), MsgType.S2C_INIT)
+
+    def _task(self, silo: int, client_idx: int, msg_type=MsgType.S2C_SYNC):
+        host_params = jax.tree.map(np.asarray, self.params)
+        self.send(msg_type, silo,
+                  **{Message.ARG_MODEL_PARAMS: host_params,
+                     Message.ARG_CLIENT_INDEX: client_idx,
+                     Message.ARG_ROUND: self.version})
+
+    def _next_client(self) -> int:
+        return int(self._task_rng.randint(self.client_num_in_total))
+
+    # -- aggregation -------------------------------------------------------
+    def _on_model(self, msg: Message) -> None:
+        if self.version >= self.num_versions:
+            return  # late upload after FINISH
+        delta = msg.get(Message.ARG_MODEL_PARAMS)
+        num_samples = float(msg.get(Message.ARG_NUM_SAMPLES))
+        base_version = int(msg.get(Message.ARG_ROUND))
+        staleness = self.version - base_version
+        weight = num_samples * float(1.0 + staleness) ** (-self.alpha)
+        self.staleness_seen.append(staleness)
+        self._buffer.append((delta, weight, msg.sender_id))
+        if len(self._buffer) >= self.goal:
+            self._apply_buffer()
+
+    def _apply_buffer(self) -> None:
+        deltas = [d for d, _, _ in self._buffer]
+        weights = np.asarray([w for _, w, _ in self._buffer], np.float64)
+        ratios = weights / max(weights.sum(), 1e-12)
+        mean = jax.tree.map(
+            lambda *leaves: sum(r * np.asarray(l, np.float64)
+                                for r, l in zip(ratios, leaves)),
+            *deltas)
+        self.params = jax.tree.map(
+            lambda p, d: (np.asarray(p, np.float64)
+                          + self.server_lr * d).astype(np.asarray(p).dtype),
+            self.params, mean)
+        silos = [s for _, _, s in self._buffer]
+        self._buffer.clear()
+        self.version += 1
+        if self.on_version is not None:
+            self.on_version(self.version, self.params)
+        if self.version >= self.num_versions:
+            for silo in range(1, self.n_silos + 1):
+                self.send(MsgType.S2C_FINISH, silo)
+            self.finish()
+            return
+        for silo in silos:  # only the consumed silos need new work
+            self._task(silo, self._next_client())

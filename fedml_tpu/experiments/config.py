@@ -120,6 +120,10 @@ class ExperimentConfig:
     #                                   the next round's delta (EF-SGD style;
     #                                   silo-local state, so gRPC silos must
     #                                   be persistent processes — they are)
+    async_goal: int = 0               # async_fl: aggregate every K uploads
+    #                                   (0 = n_silos // 2, FedBuff style)
+    staleness_exponent: float = 0.5   # async_fl: (1+s)^-alpha discount
+    async_server_lr: float = 1.0      # async_fl: server step on the mean
     completion_signal: str = ""       # write the final summary line here on
     #                                   completion (FIFO or file; parity with
     #                                   the reference's ./tmp/fedml pipe)

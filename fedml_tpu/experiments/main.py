@@ -373,43 +373,25 @@ def run_scaffold(cfg, data, mesh, sink):
     return algo.history[-1] if algo.history else {}
 
 
-@runner("cross_silo")
-def run_cross_silo(cfg, data, mesh, sink):
-    """Distributed FedAvg over the host-edge actor/transport layer — the
-    reference's ``mpirun -np N+1 main_fedavg.py`` deployment
-    (run_fedavg_distributed_pytorch.sh:17-21).
+def _silo_training_setup(cfg, data, wl):
+    """Shared silo-side machinery for the sync (cross_silo) and async
+    (async_fl) actor modes: the initial global params and the per-silo
+    ``train_fn(params, client_idx, round_idx)`` factory.
 
-    ``--silo_backend local`` runs server + N silo actors in-process over the
-    deterministic hub (the reference's localhost-MPI CI analog);
-    ``--silo_backend grpc`` runs THIS process as ``--node_id`` k (0=server,
-    1..N=silos) with peers from ``--ip_config`` (the reference's
-    grpc_ipconfig.csv format, ip_config_utils.py:4-14) at
-    ``--base_port``+rank.  Each silo trains its sampled client's shard with
-    a jit'd local-SGD program; only aggregation rides messages.
-    """
+    The rng chain reproduces FedAvg.run exactly (key(seed) -> init split
+    -> one split per round -> per-cohort-slot fold_in) so the message
+    choreography lands bit-comparably with the in-jit cohort engine —
+    every node derives the chain deterministically from (seed, round).
+    The chain advances incrementally (O(R) total, not O(R^2)); a
+    backwards query (never happens in a normal run) restarts it."""
     import jax
     import jax.numpy as jnp
-    from fedml_tpu.algorithms.cross_silo import (FedAvgClientActor,
-                                                 FedAvgServerActor)
     from fedml_tpu.trainer.local_sgd import make_local_trainer
     from fedml_tpu.trainer.workload import make_client_optimizer
 
-    if mesh is not None:
-        raise ValueError("--mesh_clients does not apply to the cross-silo "
-                         "actor mode (each silo trains single-chip); drop "
-                         "the flag or use --algo fedavg for on-pod sharding")
-
-    wl = _make_workload(cfg, data)
     local = jax.jit(make_local_trainer(
         wl, make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd),
         cfg.epochs))
-
-    # reproduce FedAvg.run's exact rng chain (key(seed) -> init split ->
-    # one split per round -> per-cohort-slot fold_in) so the message
-    # choreography lands bit-comparably with the in-jit cohort engine —
-    # every node derives the chain deterministically from (seed, round).
-    # The chain advances incrementally (O(R) total, not O(R^2)); a
-    # backwards query (never happens in a normal run) restarts it.
     _chain = {"next_round": 0,
               "rng": jax.random.split(jax.random.key(cfg.seed))[0]}
 
@@ -436,7 +418,97 @@ def run_cross_silo(cfg, data, mesh, sink):
     sample = jax.tree.map(lambda v: jnp.asarray(v[0, 0]),
                           {k: data.train[k] for k in ("x", "y", "mask")})
     _, init_rng = jax.random.split(jax.random.key(cfg.seed))
-    init = wl.init(init_rng, sample)
+    return wl.init(init_rng, sample), make_train_fn
+
+
+@runner("async_fl")
+def run_async_fl(cfg, data, mesh, sink):
+    """FedBuff-style asynchronous federation (algorithms/async_fl.py):
+    no barrier — the server aggregates every --async_goal uploads with
+    (1+staleness)^-alpha discounts and immediately re-tasks the consumed
+    silos.  --comm_round counts server VERSIONS (aggregations).  Local
+    hub deployment (the async protocol is transport-agnostic; the gRPC
+    path would reuse the same actors)."""
+    from fedml_tpu.algorithms.async_fl import (AsyncFedServerActor,
+                                               delta_encoder)
+    from fedml_tpu.algorithms.cross_silo import FedAvgClientActor
+    from fedml_tpu.comm.local import LocalHub
+
+    if mesh is not None:
+        raise ValueError("--mesh_clients does not apply to the async "
+                         "actor mode (each silo trains single-chip)")
+    if cfg.wire_compression != "none" or cfg.error_feedback:
+        raise ValueError(
+            "--wire_compression/--error_feedback are not wired into "
+            "--algo async_fl yet (the async server consumes raw deltas); "
+            "running on would silently send uncompressed uploads")
+    if cfg.silo_backend != "local":
+        raise ValueError(
+            "--algo async_fl currently deploys over the local hub only; "
+            f"--silo_backend {cfg.silo_backend!r} would silently be "
+            "ignored (the actors are transport-agnostic — the gRPC "
+            "wiring mirrors cross_silo's when needed)")
+    wl = _make_workload(cfg, data)
+    init, make_train_fn = _silo_training_setup(cfg, data, wl)
+    n_silos = min(cfg.client_num_per_round, data.client_num)
+    goal = cfg.async_goal or max(1, n_silos // 2)
+
+    history = []
+
+    def on_version(version, params):
+        if (version % cfg.frequency_of_the_test == 0
+                or version == cfg.comm_round):
+            stats = _eval_global(wl, params, data)
+            stats["version"] = version
+            history.append(stats)
+            sink.log(stats, step=version)
+
+    hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
+    server = AsyncFedServerActor(
+        hub.transport(0), init, data.client_num, n_silos,
+        num_versions=cfg.comm_round, aggregation_goal=goal,
+        staleness_exponent=cfg.staleness_exponent,
+        server_lr=cfg.async_server_lr, on_version=on_version,
+        seed=cfg.seed)
+    server.register_handlers()
+    silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
+                               encode_upload=delta_encoder)
+             for i in range(1, n_silos + 1)]
+    for s in silos:
+        s.register_handlers()
+    server.start()
+    hub.pump()
+    out = dict(history[-1]) if history else {}
+    if server.staleness_seen:
+        out["mean_staleness"] = float(np.mean(server.staleness_seen))
+    return out
+
+
+@runner("cross_silo")
+def run_cross_silo(cfg, data, mesh, sink):
+    """Distributed FedAvg over the host-edge actor/transport layer — the
+    reference's ``mpirun -np N+1 main_fedavg.py`` deployment
+    (run_fedavg_distributed_pytorch.sh:17-21).
+
+    ``--silo_backend local`` runs server + N silo actors in-process over the
+    deterministic hub (the reference's localhost-MPI CI analog);
+    ``--silo_backend grpc`` runs THIS process as ``--node_id`` k (0=server,
+    1..N=silos) with peers from ``--ip_config`` (the reference's
+    grpc_ipconfig.csv format, ip_config_utils.py:4-14) at
+    ``--base_port``+rank.  Each silo trains its sampled client's shard with
+    a jit'd local-SGD program; only aggregation rides messages.
+    """
+    import jax
+    from fedml_tpu.algorithms.cross_silo import (FedAvgClientActor,
+                                                 FedAvgServerActor)
+
+    if mesh is not None:
+        raise ValueError("--mesh_clients does not apply to the cross-silo "
+                         "actor mode (each silo trains single-chip); drop "
+                         "the flag or use --algo fedavg for on-pod sharding")
+
+    wl = _make_workload(cfg, data)
+    init, make_train_fn = _silo_training_setup(cfg, data, wl)
     n_silos = min(cfg.client_num_per_round, data.client_num)
     timeout = cfg.round_timeout_s or None
 
@@ -465,9 +537,8 @@ def run_cross_silo(cfg, data, mesh, sink):
         _ef = ErrorFeedback()
 
         def encode(new_params, global_params, _silo=None):
-            delta = jax.tree.map(
-                lambda a, b: np.asarray(a) - np.asarray(b),
-                new_params, global_params)
+            from fedml_tpu.algorithms.async_fl import delta_encoder
+            delta = delta_encoder(new_params, global_params)
             if cfg.error_feedback:
                 delta = _ef.apply(_silo, delta)
             payload = compress_update(delta, cfg.wire_compression,

@@ -21,12 +21,12 @@ d = jax.devices()[0]
 print("alive:", d.platform, getattr(d, "device_kind", "?"))
 EOF
 
-echo "== 1/3 bf16 comparison =="
+echo "== 1/4 bf16 comparison =="
 BENCH_DTYPE=bfloat16 BENCH_SCALING=0 python bench.py
 cp BENCH_DETAILS.json BENCH_DETAILS_bf16.json
 echo "bf16 details -> BENCH_DETAILS_bf16.json"
 
-echo "== 2/3 resnet56 repeat spreads (tunnel-jitter methodology) =="
+echo "== 2/4 resnet56 repeat spreads (tunnel-jitter methodology) =="
 python - <<'EOF'
 import json
 import bench
