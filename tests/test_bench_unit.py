@@ -1,0 +1,88 @@
+"""Unit tests for the driver-critical bench.py plumbing — the pieces
+whose failure modes cost rounds 1-2 their artifacts: peak resolution,
+the skip-on-wedge JSON contract, and spread statistics.  (The honest
+twin-FLOPs machinery is exercised end-to-end by the explicit-CPU bench
+path and validated against hand math in BENCH notes; these tests pin
+the host-side logic that never touches an accelerator.)"""
+
+import json
+
+import numpy as np
+import pytest
+
+import bench
+
+
+class _FakeDev:
+    def __init__(self, kind):
+        self.device_kind = kind
+        self.platform = "tpu"
+
+
+@pytest.mark.parametrize("kind,peak", [
+    ("TPU v5e", 197.0), ("TPU v5 lite", 197.0), ("TPU v5p chip", 459.0),
+    ("TPU v6e", 918.0), ("trillium", 918.0), ("TPU v4", 275.0),
+    ("TPU v3", 123.0), ("mystery accelerator", 197.0),
+])
+def test_peak_resolution_by_device_kind(kind, peak, monkeypatch):
+    monkeypatch.delenv("BENCH_PEAK_TFLOPS", raising=False)
+    assert bench._peak_for_device(_FakeDev(kind)) == peak
+
+
+def test_peak_env_override_wins(monkeypatch):
+    monkeypatch.setenv("BENCH_PEAK_TFLOPS", "123.5")
+    assert bench._peak_for_device(_FakeDev("TPU v6e")) == 123.5
+
+
+def test_emit_skipped_contract(capsys):
+    """The wedged-tunnel line must carry skipped + stale + the committed
+    TPU figures, and MUST NOT carry vs_baseline (the round-2 failure was
+    a CPU fallback dressed as a cross-platform comparison)."""
+    bench._emit_skipped()
+    line = json.loads(capsys.readouterr().out.strip())
+    assert line["stale"] is True
+    assert "unreachable" in line["skipped"]
+    assert "vs_baseline" not in line
+    assert line["metric"] == "fedavg_round_time_femnist_cnn"
+    # sourced from the committed clean-TPU BENCH_DETAILS.json
+    assert line["last_good_tpu"]["platform"] == "tpu"
+    assert line["value"] == pytest.approx(
+        max(line["last_good_tpu"]["rounds_per_s_dispatch"],
+            line["last_good_tpu"]["rounds_per_s_scan20"]))
+    assert "STALE" in line["last_good_tpu"]["source"]
+
+
+def test_round_spread_statistics(monkeypatch):
+    times = iter([0.1, 0.3, 0.2, 0.5, 0.2])
+    clock = {"t": 0.0}
+    monkeypatch.setattr(bench, "_now", lambda: clock["t"])
+
+    def run_round(params, i):
+        clock["t"] += next(times)
+        return params, None
+
+    stats = bench._round_spread(run_round, np.zeros(1), 5)
+    assert stats["n"] == 5
+    assert stats["median"] == pytest.approx(0.2)
+    assert stats["mean"] == pytest.approx(0.26)
+    assert stats["max"] == pytest.approx(0.5)
+    assert stats["p10"] <= stats["median"] <= stats["p90"] <= stats["max"]
+
+
+def test_mfu_uses_module_peak(monkeypatch):
+    monkeypatch.setattr(bench, "PEAK_TFLOPS", 100.0)
+    # 1e14 FLOPs in 2 s = 5e13 FLOP/s = 50% of a 100-TFLOPs peak
+    assert bench._mfu(1e14, 2.0) == pytest.approx(0.5)
+    assert bench._mfu(0.0, 2.0) == 0.0
+    assert bench._mfu(1e14, 0.0) == 0.0
+
+
+def test_auto_group_and_block_helpers():
+    from fedml_tpu.models.moe import _auto_group
+    assert _auto_group(1024) == 512     # largest divisor <= 512
+    assert _auto_group(96) == 96        # <= target: itself (loop hit)
+    assert _auto_group(1031) == 1031    # prime > target: n_tok fallback
+    from fedml_tpu.models.transformer import _auto_block
+    assert _auto_block(2048, threshold=1024) == 512
+    assert _auto_block(512, threshold=1024) is None   # dense is fine
+    assert _auto_block(1031, threshold=1024) is None  # prime, no divisor
