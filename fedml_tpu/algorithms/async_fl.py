@@ -13,7 +13,12 @@ the Nguyen et al. 2022 (FedBuff) way:
 * the server buffers deltas and aggregates every ``aggregation_goal``
   uploads — a "version" — applying each delta against the CURRENT global
   with a staleness discount ``(1 + s)^-alpha`` where ``s`` is how many
-  versions elapsed since the silo's base model;
+  versions elapsed since the silo's base model.  The discount is applied
+  OUTSIDE the sample-weight normalization: mixing ratios come from raw
+  ``num_samples`` (summing to 1), and each delta is then scaled by its
+  own discount — so a buffer of uniformly stale deltas is damped
+  absolutely (the FedBuff behavior), not just relatively.  At zero
+  staleness every discount is 1 and the update is plain weighted FedAvg;
 * with ``aggregation_goal = n_silos``, ``alpha`` irrelevant (zero
   staleness) and ``server_lr = 1`` the first version reduces EXACTLY to
   a synchronous FedAvg round (the parity oracle in
@@ -77,7 +82,7 @@ class AsyncFedServerActor(ServerManager):
         self.on_version = on_version
         self.version = 0
         self.staleness_seen: List[int] = []  # per consumed upload
-        self._buffer: List[Tuple[object, float, int]] = []
+        self._buffer: List[Tuple[object, float, float, int]] = []
         self._task_rng = np.random.RandomState(seed)
 
     def register_handlers(self) -> None:
@@ -110,25 +115,28 @@ class AsyncFedServerActor(ServerManager):
         num_samples = float(msg.get(Message.ARG_NUM_SAMPLES))
         base_version = int(msg.get(Message.ARG_ROUND))
         staleness = self.version - base_version
-        weight = num_samples * float(1.0 + staleness) ** (-self.alpha)
+        discount = float(1.0 + staleness) ** (-self.alpha)
         self.staleness_seen.append(staleness)
-        self._buffer.append((delta, weight, msg.sender_id))
+        self._buffer.append((delta, num_samples, discount, msg.sender_id))
         if len(self._buffer) >= self.goal:
             self._apply_buffer()
 
     def _apply_buffer(self) -> None:
-        deltas = [d for d, _, _ in self._buffer]
-        weights = np.asarray([w for _, w, _ in self._buffer], np.float64)
-        ratios = weights / max(weights.sum(), 1e-12)
+        deltas = [d for d, _, _, _ in self._buffer]
+        samples = np.asarray([n for _, n, _, _ in self._buffer], np.float64)
+        discounts = np.asarray([c for _, _, c, _ in self._buffer], np.float64)
+        # Sample ratios sum to 1; the staleness discount multiplies each
+        # term afterwards so stale buffers shrink the applied step itself.
+        coeffs = discounts * samples / max(samples.sum(), 1e-12)
         mean = jax.tree.map(
-            lambda *leaves: sum(r * np.asarray(l, np.float64)
-                                for r, l in zip(ratios, leaves)),
+            lambda *leaves: sum(c * np.asarray(l, np.float64)
+                                for c, l in zip(coeffs, leaves)),
             *deltas)
         self.params = jax.tree.map(
             lambda p, d: (np.asarray(p, np.float64)
                           + self.server_lr * d).astype(np.asarray(p).dtype),
             self.params, mean)
-        silos = [s for _, _, s in self._buffer]
+        silos = [s for _, _, _, s in self._buffer]
         self._buffer.clear()
         self.version += 1
         if self.on_version is not None:

@@ -17,6 +17,7 @@ krum, multi_krum, geometric_median), which replace the aggregate itself.
 from __future__ import annotations
 
 import dataclasses
+import logging
 
 from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
 from fedml_tpu.core.byzantine import METHODS as BYZ_METHODS
@@ -26,6 +27,8 @@ from fedml_tpu.core.robust import add_gaussian_noise, clip_update
 from fedml_tpu.parallel.cohort import make_cohort_step
 from fedml_tpu.trainer.local_sgd import make_local_trainer
 from fedml_tpu.trainer.workload import make_client_optimizer
+
+log = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -39,6 +42,8 @@ class FedAvgRobustConfig(FedAvgConfig):
     trim_frac: float = 0.1       # trimmed_mean: fraction cut per side
     byz_f: int = 0               # krum: assumed Byzantine count
     krum_m: int = 1              # multi_krum: how many updates to average
+    gm_iters: int = 8            # geometric_median: Weiszfeld iterations
+    gm_eps: float = 1e-6         # geometric_median: smoothing floor
 
 
 class FedAvgRobust(FedAvg):
@@ -86,9 +91,20 @@ class FedAvgRobust(FedAvg):
                         f"{max_m}, got m={m}: selecting that many updates "
                         "can include Byzantine ones, silently degenerating "
                         "to a plain mean")
+                if n < 2 * cfg.byz_f + 3:
+                    # Blanchard et al. 2017 Prop. 1: the (alpha, f)-Byzantine
+                    # resilience of Krum additionally needs n >= 2f + 3; below
+                    # it the selection can be steered by a near-majority of
+                    # attackers.  Warn rather than abort — the rule still runs
+                    # and small cohorts are common in tests/simulation.
+                    log.warning(
+                        "krum robustness guarantee needs n >= 2f + 3 "
+                        "(n=%d, f=%d): selection may be defeatable by a "
+                        "coordinated near-majority of Byzantine silos",
+                        n, cfg.byz_f)
             agg = make_byzantine_aggregate(
                 cfg.defense, trim_frac=cfg.trim_frac, byz_f=cfg.byz_f,
-                krum_m=cfg.krum_m)
+                krum_m=cfg.krum_m, gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps)
             self.cohort_step = make_cohort_step(local_train, aggregate=agg)
             return
 

@@ -153,7 +153,8 @@ def geometric_median(stacked: Pytree, weights: jax.Array,
 
 
 def make_byzantine_aggregate(method: str, trim_frac: float = 0.1,
-                             byz_f: int = 0, krum_m: int = 1):
+                             byz_f: int = 0, krum_m: int = 1,
+                             gm_iters: int = 8, gm_eps: float = 1e-6):
     """Build the cohort engine ``aggregate(stacked, weights)`` hook."""
     if method not in METHODS:
         raise ValueError(f"unknown byzantine method {method!r}; "
@@ -168,6 +169,10 @@ def make_byzantine_aggregate(method: str, trim_frac: float = 0.1,
     if krum_m < 1:
         # m=0 would select nothing and NaN the weighted mean
         raise ValueError(f"krum_m must be >= 1, got {krum_m}")
+    if gm_iters < 1:
+        raise ValueError(f"gm_iters must be >= 1, got {gm_iters}")
+    if gm_eps <= 0.0:
+        raise ValueError(f"gm_eps must be > 0, got {gm_eps}")
     if method == "coordinate_median":
         return coordinate_median
     if method == "trimmed_mean":
@@ -176,4 +181,4 @@ def make_byzantine_aggregate(method: str, trim_frac: float = 0.1,
         return lambda s, w: krum(s, w, byz_f, 1)
     if method == "multi_krum":
         return lambda s, w: krum(s, w, byz_f, krum_m)
-    return lambda s, w: geometric_median(s, w)
+    return lambda s, w: geometric_median(s, w, gm_iters, gm_eps)

@@ -61,6 +61,8 @@ class ExperimentConfig:
     trim_frac: float = 0.1               # trimmed_mean: cut per side
     byz_f: int = 0                       # krum: assumed Byzantine count
     krum_m: int = 1                      # multi_krum: updates averaged
+    gm_iters: int = 8                    # geometric_median: Weiszfeld steps
+    gm_eps: float = 1e-6                 # geometric_median: smoothing floor
     defense_backend: str = "xla"         # robust: "xla" | "pallas" (fused
     #                                      clip+noise+mean, core/pallas_agg)
     # robust: backdoor attack evaluation (poison_type pipeline,

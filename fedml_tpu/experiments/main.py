@@ -257,6 +257,7 @@ def run_fedavg_robust(cfg, data, mesh, sink):
         defense=cfg.defense, norm_bound=cfg.norm_bound, stddev=cfg.stddev,
         defense_backend=cfg.defense_backend, trim_frac=cfg.trim_frac,
         byz_f=cfg.byz_f, krum_m=cfg.krum_m,
+        gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps,
         **_fedavg_cfg_kwargs(cfg)), mesh=mesh, sink=sink)
     params = algo.run(checkpointer=_make_checkpointer(cfg))
     out = dict(algo.history[-1]) if algo.history else {}

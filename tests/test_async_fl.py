@@ -74,9 +74,7 @@ def test_server_validates_goal_and_ignores_late_uploads():
     np.testing.assert_allclose(server.params["w"], 1.0)
 
 
-def test_staleness_discount_weighting():
-    """Two buffered deltas, one fresh and one s=1 stale with alpha=1:
-    weights num_samples * (1+s)^-1 -> the stale delta counts half."""
+def _make_two_silo_server(alpha):
     from fedml_tpu.algorithms.async_fl import AsyncFedServerActor
     from fedml_tpu.comm.local import LocalHub
     from fedml_tpu.comm.message import Message
@@ -87,20 +85,45 @@ def test_staleness_discount_weighting():
         hub.transport(i)
     server = AsyncFedServerActor(hub.transport(0), {"w": np.zeros(1)},
                                  8, 2, num_versions=2, aggregation_goal=2,
-                                 server_lr=1.0, staleness_exponent=1.0)
+                                 server_lr=1.0, staleness_exponent=alpha)
     server.register_handlers()
     server.version = 1  # pretend one aggregation happened
 
-    def upload(sender, value, base_version):
+    def upload(sender, value, base_version, num_samples=10):
         m = Message(MsgType.C2S_MODEL, sender, 0)
         m.add(Message.ARG_MODEL_PARAMS, {"w": np.asarray([value],
                                                          np.float32)})
-        m.add(Message.ARG_NUM_SAMPLES, 10)
+        m.add(Message.ARG_NUM_SAMPLES, num_samples)
         m.add(Message.ARG_ROUND, base_version)
         server._on_model(m)
 
-    upload(1, 3.0, 1)   # fresh: weight 10
-    upload(2, 9.0, 0)   # stale s=1, alpha=1: weight 5
-    # weighted mean = (10*3 + 5*9) / 15 = 5.0
-    np.testing.assert_allclose(server.params["w"], 5.0)
+    return server, upload
+
+
+def test_staleness_discount_weighting():
+    """The discount acts OUTSIDE the sample-weight normalization: mixing
+    ratios come from raw num_samples, then each delta is scaled by its own
+    (1+s)^-alpha — so staleness shrinks the applied step absolutely."""
+    server, upload = _make_two_silo_server(alpha=1.0)
+    upload(1, 3.0, 1)   # fresh: ratio 0.5, discount 1
+    upload(2, 9.0, 0)   # stale s=1, alpha=1: ratio 0.5, discount 0.5
+    # applied = 0.5*1*3 + 0.5*0.5*9 = 3.75  (old relative-only scheme: 5.0)
+    np.testing.assert_allclose(server.params["w"], 3.75)
     assert server.staleness_seen == [0, 1]
+
+
+def test_uniformly_stale_buffer_is_damped_absolutely():
+    """A buffer of uniformly stale deltas must be applied at reduced
+    strength, not full strength (the FedBuff discount must not cancel in
+    the normalization)."""
+    server, upload = _make_two_silo_server(alpha=1.0)
+    upload(1, 4.0, 0)   # both s=1 -> discount 0.5 each
+    upload(2, 8.0, 0)
+    # applied = 0.5 * mean(4, 8) = 3.0; undamped would be 6.0
+    np.testing.assert_allclose(server.params["w"], 3.0)
+
+    # zero staleness at alpha>0 stays exact weighted FedAvg (parity case)
+    server2, upload2 = _make_two_silo_server(alpha=1.0)
+    upload2(1, 4.0, 1, num_samples=30)
+    upload2(2, 8.0, 1, num_samples=10)
+    np.testing.assert_allclose(server2.params["w"], 5.0)  # (30*4+10*8)/40
