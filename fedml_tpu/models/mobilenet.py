@@ -36,10 +36,13 @@ def _depthwise(x, kernel, stride, norm, train, act):
 
 
 class MobileNetV1(nn.Module):
-    """13 depthwise-separable blocks (mobilenet.py:60-106)."""
-    num_classes: int = 1000
+    """13 depthwise-separable blocks (mobilenet.py:60-106).  The
+    reference is the CIFAR variant — stride-1 stem and class_num=100
+    (mobilenet.py:70-83); ``stem_stride=2`` gives the ImageNet layout."""
+    num_classes: int = 100
     width_mult: float = 1.0
     norm: str = "group"
+    stem_stride: int = 1
 
     # (out_channels, stride) after the stem conv
     _blocks: Sequence[Tuple[int, int]] = (
@@ -50,7 +53,8 @@ class MobileNetV1(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         w = lambda c: max(8, int(c * self.width_mult))
-        x = _conv_norm(x, w(32), 3, 2, self.norm, train, nn.relu)
+        x = _conv_norm(x, w(32), 3, self.stem_stride, self.norm, train,
+                       nn.relu)
         for out_ch, stride in self._blocks:
             x = _depthwise(x, 3, stride, self.norm, train, nn.relu)
             x = _conv_norm(x, w(out_ch), 1, 1, self.norm, train, nn.relu)
@@ -153,10 +157,13 @@ class MobileNetV3(nn.Module):
         return nn.Dense(self.num_classes)(x)
 
 
-def mobilenet(num_classes: int = 1000, norm: str = "group",
-              width_mult: float = 1.0) -> MobileNetV1:
+def mobilenet(num_classes: int = 100, norm: str = "group",
+              width_mult: float = 1.0,
+              stem_stride: int = 1) -> MobileNetV1:
+    """Reference-default CIFAR MobileNet (mobilenet.py:70 class_num=100,
+    stride-1 stem); pass stem_stride=2 for the ImageNet stem."""
     return MobileNetV1(num_classes=num_classes, norm=norm,
-                       width_mult=width_mult)
+                       width_mult=width_mult, stem_stride=stem_stride)
 
 
 def mobilenet_v3(num_classes: int = 1000, mode: str = "large",

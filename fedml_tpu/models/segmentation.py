@@ -74,11 +74,17 @@ class XceptionBlock(nn.Module):
 
 
 class AlignedXception(nn.Module):
-    """Compact aligned Xception: entry (32/2, 64, blocks 128/2, 256/2,
-    728/2), middle (``middle_reps``x 728 blocks, dilation 1), exit (1024).
-    Returns (high-level feats at OS16, low-level feats at OS4)."""
-    middle_reps: int = 4
-    width_mult: float = 0.25   # compact default; 1.0 = paper widths
+    """Aligned Xception at output stride 16: entry (32/2, 64, blocks
+    128/2, 256/2, 728/2), middle (``middle_reps``× 728 blocks of 3
+    separable convs, dilation 1), exit (1024 block + separable convs
+    1536/1536/2048 at dilation 2).  Defaults match the reference
+    backbone (xception.py:98-158: 16 middle blocks of reps=3,
+    middle_block_dilation=1 and exit_block_dilations=(1, 2) at OS16);
+    ``width_mult < 1`` and smaller ``middle_reps`` give the compact twin
+    used in tests.  Returns (high-level feats at OS16, low-level feats
+    at OS4)."""
+    middle_reps: int = 16
+    width_mult: float = 1.0
 
     @nn.compact
     def __call__(self, x, train=False) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -94,8 +100,10 @@ class AlignedXception(nn.Module):
         x = XceptionBlock(w(256), stride=2)(x, train)
         x = XceptionBlock(w(728), stride=2)(x, train)   # OS16
         for _ in range(self.middle_reps):
-            x = XceptionBlock(w(728), dilation=2)(x, train)
-        x = XceptionBlock(w(1024), dilation=2)(x, train)
+            x = XceptionBlock(w(728), reps=3)(x, train)
+        x = XceptionBlock(w(1024))(x, train)        # exit block20
+        for c in (1536, 1536, 2048):                # exit separable convs
+            x = nn.relu(SepConvNorm(w(c), dilation=2)(x, train))
         return x, low_level
 
 
@@ -150,16 +158,23 @@ class ASPP(nn.Module):
 
 class DeepLabV3Plus(nn.Module):
     """backbone -> ASPP -> decoder (low-level fuse) -> per-pixel logits
-    (deeplabV3_plus.py DeepLab)."""
+    (deeplabV3_plus.py DeepLab).  ``aspp_features=256`` matches the
+    reference's ASPP/decoder width (deeplabV3_plus.py:70-133);
+    ``middle_reps``/``width_mult`` forward to the Xception backbone
+    (reference defaults 16/1.0) — shrink all three for test-sized
+    compact twins."""
     num_classes: int
     backbone: str = "xception"      # "xception" | "resnet"
-    aspp_features: int = 64
+    aspp_features: int = 256
+    middle_reps: int = 16           # xception backbone middle-flow blocks
+    width_mult: float = 1.0         # xception backbone width multiplier
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         H, W = x.shape[1], x.shape[2]
-        bb = (AlignedXception() if self.backbone == "xception"
-              else ResNetBackbone())
+        bb = (AlignedXception(middle_reps=self.middle_reps,
+                              width_mult=self.width_mult)
+              if self.backbone == "xception" else ResNetBackbone())
         high, low = bb(x, train)
         a = ASPP(self.aspp_features)(high, train)
         a = _resize(a, low.shape[1:3])
@@ -176,9 +191,11 @@ class DeepLabV3Plus(nn.Module):
 
 
 class UNet(nn.Module):
-    """Encoder-decoder with skip concats (unet.py:61)."""
+    """Encoder-decoder with skip concats (unet.py:61).  Default widths
+    match the reference's 4-level encoder 64/128/256/512 with a 1024
+    bottleneck (unet.py:66-77); tests pass compact widths."""
     num_classes: int
-    widths: Sequence[int] = (16, 32, 64)
+    widths: Sequence[int] = (64, 128, 256, 512)
 
     @nn.compact
     def __call__(self, x, train: bool = False):

@@ -113,10 +113,35 @@ def test_fedseg_end_to_end_unet():
 def test_deeplab_shapes_both_backbones():
     x = jnp.asarray(np.random.RandomState(0).rand(1, 32, 32, 3), jnp.float32)
     for bb in ("xception", "resnet"):
-        net = DeepLabV3Plus(num_classes=5, backbone=bb, aspp_features=16)
+        # compact twin: defaults are reference-sized (16 middle blocks,
+        # width 1.0, ASPP 256) — too heavy for a CPU unit test
+        net = DeepLabV3Plus(num_classes=5, backbone=bb, aspp_features=16,
+                            middle_reps=2, width_mult=0.25)
         params = net.init(jax.random.key(0), x)["params"]
         out = jax.jit(lambda p, v: net.apply({"params": p}, v))(params, x)
         assert out.shape == (1, 32, 32, 5)
+
+
+@pytest.mark.slow
+def test_deeplab_reference_default_structure():
+    """Default hyperparameters match the reference DeepLab: 16 Xception
+    middle-flow blocks of 3 separable convs (xception.py:132-162), exit
+    separable convs 1536/1536/2048, ASPP/decoder width 256
+    (deeplabV3_plus.py:70-133)."""
+    from fedml_tpu.models import AlignedXception
+    net = DeepLabV3Plus(num_classes=3)
+    assert net.aspp_features == 256
+    assert net.middle_reps == 16 and net.width_mult == 1.0
+    bb = AlignedXception()
+    assert bb.middle_reps == 16 and bb.width_mult == 1.0
+    x = jnp.asarray(np.random.RandomState(1).rand(1, 32, 32, 3), jnp.float32)
+    params = bb.init(jax.random.key(0), x)["params"]
+    # 3 entry blocks + 16 middle + exit block20 = 20 XceptionBlocks
+    n_blocks = sum(1 for k in params if k.startswith("XceptionBlock"))
+    assert n_blocks == 20
+    middle = params["XceptionBlock_3"]
+    n_seps = sum(1 for k in middle if k.startswith("SepConvNorm"))
+    assert n_seps == 3  # reference middle blocks are reps=3
 
 
 def test_perceptual_loss_taps_and_gradient():
