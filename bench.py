@@ -191,7 +191,7 @@ def _synth_clients(n_clients, samples, shape, classes, seed=0):
 
 
 def _build_step(model, classes, lr, epochs, batch_size, xs, ys, mesh=None,
-                workload=None, scan_unroll=1):
+                workload=None, scan_unroll=1, client_axis="vmap"):
     import jax
     import jax.numpy as jnp
     from fedml_tpu.data.stacking import stack_client_data, gather_cohort
@@ -207,7 +207,7 @@ def _build_step(model, classes, lr, epochs, batch_size, xs, ys, mesh=None,
     local = make_local_trainer(workload,
                                make_client_optimizer("sgd", lr), epochs,
                                scan_unroll=scan_unroll)
-    step = make_cohort_step(local, mesh=mesh)
+    step = make_cohort_step(local, mesh=mesh, client_axis=client_axis)
     params = workload.init(jax.random.key(0), jax.tree.map(
         lambda v: jnp.asarray(v[0, 0]),
         {k: stacked[k] for k in ("x", "y", "mask")}))
@@ -421,16 +421,28 @@ def bench_femnist_cnn_scanned(rounds, clients_per_round=10, k=20):
     return (_now() - t0) / (n_chunks * k)
 
 
-def bench_resnet56_cifar10(rounds, mesh=None, samples=512):
+def bench_resnet56_cifar10(rounds, mesh=None, samples=512, epochs=1,
+                           client_axis=None):
     """Flagship cross-silo config (benchmark/README.md:105): 10 clients,
-    B=64; one local epoch measured (published runs use E=20 of 5000
-    samples — scale linearly).  Returns (round_s, flops, steps)."""
+    B=64; ``epochs`` local epochs measured (published runs use E=20 of
+    5000 samples — pass epochs=20 for the exact config).  Returns
+    (round_s, flops, steps).
+
+    ``client_axis`` ("vmap" | "scan", env BENCH_R56_CLIENT_AXIS):
+    concurrent clients lower per-client conv kernels to GROUPED convs —
+    at 16/32/64 channels each group fills a sliver of the 128-wide MXU
+    tile, the leading suspect for the ~1% committed MFU; "scan" trains
+    clients sequentially with dense convs.  tpu_capture.sh measures both.
+    """
     from fedml_tpu.models import resnet56
+    client_axis = client_axis or os.environ.get(
+        "BENCH_R56_CLIENT_AXIS", "vmap")
     xs, ys = _synth_clients(10, samples, (32, 32, 3), 10)
-    flops, steps = _honest_flops(resnet56(10), 10, 0.001, 1, 64, xs, ys, 10)
+    flops, steps = _honest_flops(resnet56(10), 10, 0.001, epochs, 64,
+                                 xs, ys, 10)
     step, params, stacked = _build_step(
-        resnet56(10), 10, lr=0.001, epochs=1, batch_size=64, xs=xs, ys=ys,
-        mesh=mesh)
+        resnet56(10), 10, lr=0.001, epochs=epochs, batch_size=64, xs=xs,
+        ys=ys, mesh=mesh, client_axis=client_axis)
     round_s, spread = _measure(step, params, stacked, 10, 10, rounds,
                                spread=True)
     return round_s, flops, steps, spread

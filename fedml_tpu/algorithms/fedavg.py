@@ -58,6 +58,13 @@ class FedAvgConfig:
     # checkpointer (per-round save cadence needs the host loop) or a
     # _server_update hook (per-round host-side server state, e.g. FedOpt).
     rounds_per_dispatch: int = 1
+    # evaluate_global processes at most this many clients per compiled
+    # call (single-chip and mesh-sharded alike).  The all-clients vmap
+    # materializes [C, S, B, ...] activations (an NWP model's logits over
+    # a 342k-client corpus would be TBs); chunking bounds eval memory at
+    # [chunk, ...] and keeps the memmap staging path O(chunk) in host
+    # RAM.  0 = never chunk.
+    eval_chunk_clients: int = 1024
 
 
 class FedAvg:
@@ -301,11 +308,21 @@ class FedAvg:
 
     def evaluate_global(self, params) -> Dict[str, float]:
         """Weighted train/test metrics over ALL clients' shards (parity with
-        _local_test_on_all_clients, fedavg_api.py:118-171)."""
+        _local_test_on_all_clients, fedavg_api.py:118-171).  Corpora larger
+        than ``eval_chunk_clients`` are swept in fixed-size client chunks
+        (summed metric dicts are exact under chunking; zero-mask padding of
+        the last chunk contributes nothing)."""
         from jax.sharding import PartitionSpec as P
         out: Dict[str, float] = {}
         for split, stacked in (("train", self.data.train), ("test", self.data.test)):
             if stacked is None:
+                continue
+            chunk = self.cfg.eval_chunk_clients
+            n_clients = stacked["num_samples"].shape[0]
+            if chunk and n_clients > chunk:
+                from fedml_tpu.utils.metrics import stats_from_metrics
+                m = self._eval_cohort_chunked(params, stacked, chunk)
+                out.update(stats_from_metrics(m, prefix=f"{split}_"))
                 continue
             # once the train set is device-resident, reuse it; cache the
             # test split too when train+test together stay inside the
@@ -330,3 +347,26 @@ class FedAvg:
             m = self._eval_cohort(params, batch)
             out.update(stats_from_metrics(m, prefix=f"{split}_"))
         return out
+
+    def _eval_cohort_chunked(self, params, stacked, chunk: int):
+        """Sum the cohort-eval metric dict over [chunk]-client slices; the
+        last slice is zero-padded to the chunk size via pad_clients (the
+        one shared zero-contribution convention) so every call hits the
+        same compiled program.  Works sharded too: each chunk rides the
+        same `_eval_cohort` as the one-shot path, with multi-process
+        chunks staged globally pre-jit."""
+        from jax.sharding import PartitionSpec as P
+        from fedml_tpu.parallel.cohort import pad_clients
+        total = None
+        n_clients = stacked["num_samples"].shape[0]
+        for lo in range(0, n_clients, chunk):
+            part = {k: jax.numpy.asarray(np.asarray(v[lo:lo + chunk]))
+                    for k, v in stacked.items()}
+            part = pad_clients(part, chunk)  # static shape across chunks
+            if self.mesh is not None and jax.process_count() > 1:
+                part = pad_clients(part, self.mesh.shape["clients"])
+                part = stage_global(part, self.mesh, P("clients"))
+            m = jax.tree.map(np.asarray, self._eval_cohort(params, part))
+            total = m if total is None else jax.tree.map(
+                lambda a, b: a + b, total, m)
+        return total

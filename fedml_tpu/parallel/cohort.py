@@ -36,19 +36,44 @@ CohortStep = Callable[..., Tuple[Pytree, Dict[str, jax.Array]]]
 
 
 def train_cohort(local_train, params: Pytree, data: CohortData,
-                 rng: jax.Array, index_offset=0, transform_update=None):
-    """vmap ``local_train`` over the stacked client axis.
+                 rng: jax.Array, index_offset=0, transform_update=None,
+                 client_axis: str = "vmap"):
+    """Run ``local_train`` over the stacked client axis.
 
     Per-client rng = fold_in(rng, global cohort slot), so single-chip and
     mesh-sharded runs are bit-identical even with dropout.  This is the one
     shared preamble for every cohort-training algorithm (FedAvg cohort step,
-    FedNova, gossip) — keep rng/num_samples conventions here only."""
+    FedNova, gossip) — keep rng/num_samples conventions here only.
+
+    ``client_axis`` picks the execution of that axis; both produce
+    identical stacked outputs:
+
+    * ``"vmap"`` (default) — all clients train concurrently.  For conv
+      models this batches per-client KERNELS too, which XLA lowers to
+      grouped convolutions: at CIFAR-ResNet channel widths (16/32/64)
+      each group occupies a sliver of the 128-wide MXU tile, so the
+      grouping can dominate the step time.
+    * ``"scan"`` — clients train sequentially via ``lax.scan``; every
+      conv stays a dense, full-batch conv (better MXU tiling per call,
+      no cross-client parallelism).  The right choice is empirical —
+      bench.py measures both for the resnet56 flagship (BENCH_R56 table).
+    """
+    if client_axis not in ("vmap", "scan"):
+        raise ValueError(f"client_axis must be 'vmap' or 'scan', "
+                         f"got {client_axis!r}")
     n_clients = data["num_samples"].shape[0]
     idx = jnp.arange(n_clients) + index_offset
     rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(idx)
     client_batches = {k: v for k, v in data.items() if k != "num_samples"}
-    new_params, metrics = jax.vmap(
-        local_train, in_axes=(None, 0, 0))(params, client_batches, rngs)
+    if client_axis == "scan":
+        def _one(_, xs):
+            batches, r = xs
+            return _, local_train(params, batches, r)
+        _, (new_params, metrics) = jax.lax.scan(
+            _one, 0, (client_batches, rngs))
+    else:
+        new_params, metrics = jax.vmap(
+            local_train, in_axes=(None, 0, 0))(params, client_batches, rngs)
     if transform_update is not None:
         t_rng = jax.random.fold_in(rng, 0x7FFFFFFF)  # distinct stream
         t_rngs = jax.vmap(lambda i: jax.random.fold_in(t_rng, i))(idx)
@@ -68,7 +93,8 @@ def _call_aggregate(aggregate, stacked, weights, global_params, rng):
 
 def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
                      aggregate=tree_weighted_mean,
-                     transform_update=None) -> CohortStep:
+                     transform_update=None,
+                     client_axis: str = "vmap") -> CohortStep:
     """Build ``step(global_params, cohort_data, rng) -> (new_global, aux)``.
 
     ``local_train(params, client_data, rng) -> (params', metrics)`` is the
@@ -81,12 +107,16 @@ def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
 
     ``aggregate(stacked_params, weights) -> params`` defaults to the
     sample-weighted FedAvg mean; FedOpt/FedNova swap in their own.
+
+    ``client_axis`` ("vmap" | "scan") — see train_cohort: concurrent
+    clients (grouped convs) vs sequential clients (dense convs).
     """
 
     def _train_cohort(params, data, rng, index_offset=0):
         return train_cohort(local_train, params, data, rng,
                             index_offset=index_offset,
-                            transform_update=transform_update)
+                            transform_update=transform_update,
+                            client_axis=client_axis)
 
     if mesh is None:
         def step(global_params, cohort_data, rng):

@@ -102,6 +102,65 @@ def test_vmap_cohort_equals_sequential_clients(workload):
                  agg, want)
 
 
+def test_scan_client_axis_equals_vmap(workload):
+    """client_axis='scan' (sequential clients, dense convs — the MXU
+    alternative to vmap's grouped-conv lowering, bench R56 grid) must
+    produce the exact same round as the vmapped engine: same stacked
+    outputs, same aggregate, same per-client rng streams."""
+    xs, ys = _synthetic_clients(n_clients=4)
+    train = stack_client_data(xs, ys, batch_size=5)
+    opt = make_client_optimizer("sgd", 0.1)
+    local = make_local_trainer(workload, opt, epochs=2)
+    params = workload.init(jax.random.key(0),
+                           jax.tree.map(lambda v: v[0, 0],
+                                        {k: train[k] for k in ("x", "y", "mask")}))
+    cohort = {k: jnp.asarray(v) for k, v in train.items()}
+    rng = jax.random.key(3)
+    agg_v, m_v = make_cohort_step(local)(params, cohort, rng)
+    agg_s, m_s = make_cohort_step(local, client_axis="scan")(
+        params, cohort, rng)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, rtol=1e-5, atol=1e-6), agg_v, agg_s)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        a, b, rtol=1e-5, atol=1e-6), m_v, m_s)
+    with pytest.raises(ValueError, match="client_axis"):
+        make_cohort_step(local, client_axis="pmap")(params, cohort, rng)
+
+
+def test_chunked_global_eval_equals_full_sweep(workload):
+    """evaluate_global with eval_chunk_clients set must equal the
+    all-clients vmap exactly (summed metric dicts; zero-padded tail
+    chunk contributes nothing) — the 342k-client corpora path, where the
+    one-shot vmap would materialize [C, S, B, ...] activations."""
+    import dataclasses
+    from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+
+    xs, ys = _synthetic_clients(n_clients=7)
+    from fedml_tpu.data.stacking import FederatedData
+    data = FederatedData(client_num=7, class_num=3,
+                         train=stack_client_data(xs, ys, batch_size=5))
+    base = FedAvgConfig(comm_round=1, client_num_per_round=3, batch_size=5,
+                        frequency_of_the_test=10**9)
+    full = FedAvg(workload, data, dataclasses.replace(
+        base, eval_chunk_clients=0))
+    params = full.run()
+    chunked = FedAvg(workload, data, dataclasses.replace(
+        base, eval_chunk_clients=2))
+    a, b = full.evaluate_global(params), chunked.evaluate_global(params)
+    assert a.keys() == b.keys() and a
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-6)
+
+    # sharded eval chunks too (each chunk rides the shard_map eval jit)
+    from fedml_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(client_axis=4, devices=jax.devices("cpu")[:4])
+    sharded = FedAvg(workload, data, dataclasses.replace(
+        base, client_num_per_round=4, eval_chunk_clients=2), mesh=mesh)
+    c = sharded.evaluate_global(params)
+    for k in a:
+        np.testing.assert_allclose(a[k], c[k], rtol=1e-6)
+
+
 def test_sharded_cohort_equals_single_chip(workload, devices):
     """8-device shard_map cohort == single-chip vmap cohort."""
     xs, ys = _synthetic_clients(n_clients=8)
