@@ -105,6 +105,13 @@ class ExperimentConfig:
     mesh_clients: int = 0     # >0: shard the cohort over this many devices
     mesh_groups: int = 0      # >0 (hierarchical): [groups, clients] mesh
     mesh_sequence: int = 0    # >0 (fedavg + transformer): dp x sp
+    mesh_stages: int = 0      # >0 (cross_silo + transformer): silo-local
+    #                           pipeline parallelism — transformer blocks
+    #                           over this many stage devices (GPipe,
+    #                           parallel/pipeline.py); composes with
+    #                           --moe_experts (balance loss rides the
+    #                           schedule's scan carry)
+    pp_microbatches: int = 0  # GPipe microbatches (0 = mesh_stages)
     #                           [clients, sequence] mesh with ring attention
     attn_block_size: int = 0  # >0 (transformer): flash-style kv blocking —
     #                           O(T*block) attention memory for single-chip

@@ -374,6 +374,40 @@ def run_scaffold(cfg, data, mesh, sink):
     return algo.history[-1] if algo.history else {}
 
 
+def _pp_workload(cfg, data):
+    """--mesh_stages: silo-local GPipe pipeline over the transformer block
+    stack (parallel/pipeline.py) — the deployment for silos whose model is
+    too deep for one chip.  Same TransformerLM hyperparameters as
+    create_workload's dense path; composes with --moe_experts (the Switch
+    balance loss rides the schedule's scan carry, pipeline.py)."""
+    import jax
+    from fedml_tpu.parallel.pipeline import (PipelineLM, make_pp_nwp_workload,
+                                             make_stage_mesh)
+    if cfg.model != "transformer":
+        raise ValueError("--mesh_stages requires --model transformer "
+                         "(the stacked-block PipelineLM)")
+    shape = sample_shape_of(data)
+    if len(shape) != 1:
+        raise ValueError(f"--mesh_stages needs a sequence dataset "
+                         f"(next-word prediction); got sample shape {shape}")
+    n_dev = len(jax.devices())
+    if n_dev < cfg.mesh_stages:
+        raise ValueError(f"--mesh_stages {cfg.mesh_stages} exceeds the "
+                         f"{n_dev} available devices")
+    # TransformerLM's dense defaults (experiments/models.py) in stacked
+    # form; the block count grows to one-per-stage past the default 2
+    plm = PipelineLM(vocab_size=data.class_num, d_model=128, n_heads=4,
+                     n_layers=max(2, cfg.mesh_stages), d_ff=512,
+                     max_len=2048, moe_experts=cfg.moe_experts)
+    mesh = make_stage_mesh(cfg.mesh_stages,
+                           devices=jax.devices()[:cfg.mesh_stages])
+    n_micro = cfg.pp_microbatches or cfg.mesh_stages
+    if cfg.batch_size % n_micro:
+        raise ValueError(f"--batch_size {cfg.batch_size} must divide into "
+                         f"{n_micro} GPipe microbatches (--pp_microbatches)")
+    return make_pp_nwp_workload(plm, mesh, n_micro=n_micro)
+
+
 def _silo_training_setup(cfg, data, wl):
     """Shared silo-side machinery for the sync (cross_silo) and async
     (async_fl) actor modes: the initial global params and the per-silo
@@ -508,7 +542,8 @@ def run_cross_silo(cfg, data, mesh, sink):
                          "actor mode (each silo trains single-chip); drop "
                          "the flag or use --algo fedavg for on-pod sharding")
 
-    wl = _make_workload(cfg, data)
+    wl = (_pp_workload(cfg, data) if cfg.mesh_stages > 0
+          else _make_workload(cfg, data))
     init, make_train_fn = _silo_training_setup(cfg, data, wl)
     n_silos = min(cfg.client_num_per_round, data.client_num)
     timeout = cfg.round_timeout_s or None
@@ -915,6 +950,21 @@ def main(argv=None) -> Dict[str, Any]:
         raise ValueError(
             f"--compute_dtype is not wired into --algo {cfg.algo}; "
             f"supported: {sorted(_DTYPE_RUNNERS)}")
+    if cfg.mesh_stages > 0 and cfg.algo != "cross_silo":
+        raise ValueError(
+            "--mesh_stages is silo-local pipeline parallelism: each silo "
+            "runs its own [stages] mesh, so it only applies to --algo "
+            "cross_silo (the vmapped cohort engine cannot nest a shard_map "
+            f"pipeline per client); got --algo {cfg.algo}")
+    if cfg.pp_microbatches and not cfg.mesh_stages:
+        raise ValueError("--pp_microbatches tunes the GPipe schedule and "
+                         "needs --mesh_stages; alone it would be silently "
+                         "ignored")
+    if cfg.mesh_stages > 0 and (cfg.attn_block_size or cfg.attn_flash):
+        raise ValueError(
+            "--attn_block_size/--attn_flash are TransformerLM attention "
+            "backends; the pipelined PipelineLM (--mesh_stages) runs dense "
+            "block attention and would silently drop them")
     # same fail-loudly convention: a silently-ignored EF flag would label
     # uncompressed numbers as EF results
     if cfg.wire_compression != "none" and cfg.algo != "cross_silo":

@@ -196,16 +196,19 @@ class PipelineLM:
 
     # ---- pipeline execution ---------------------------------------------
     def pp_shard_params(self, params: Any, mesh: Mesh, n_stages: int) -> Any:
-        """[L, ...] block leaves -> [S, L/S, ...] placed on the stages
-        axis; embed/final replicated."""
+        """PLACEMENT-only: the canonical [L, ...] block leaves are
+        device_put with the leading layer axis split over the stages axis
+        (layer l lives on stage l // (L/S)); embed/final replicated.  The
+        pytree SHAPE is unchanged — pipelined and sequential params are
+        the same tree, so optimizers, aggregation, and the wire protocol
+        never see a pp-specific layout, and make_pp_apply accepts host
+        params directly (GSPMD moves them on first call)."""
         if self.n_layers % n_stages:
             raise ValueError(f"n_layers={self.n_layers} not divisible by "
                              f"n_stages={n_stages}")
-        lps = self.n_layers // n_stages
         blocks = jax.tree.map(
-            lambda v: jax.device_put(
-                v.reshape((n_stages, lps) + v.shape[1:]),
-                NamedSharding(mesh, P("stages"))), params["blocks"])
+            lambda v: jax.device_put(v, NamedSharding(mesh, P("stages"))),
+            params["blocks"])
         rep = lambda t: jax.tree.map(
             lambda v: jax.device_put(v, NamedSharding(mesh, P())), t)
         return {"embed": rep(params["embed"]), "blocks": blocks,
@@ -227,6 +230,9 @@ class PipelineLM:
         hand-off as the activations so each stage routes with its
         in-flight microbatch's mask."""
         n_stages = mesh.shape["stages"]
+        if self.n_layers % n_stages:
+            raise ValueError(f"n_layers={self.n_layers} not divisible by "
+                             f"n_stages={n_stages}")
 
         def fn(params, toks):
             b, t = toks.shape
@@ -247,7 +253,9 @@ class PipelineLM:
                      in_specs=(P("stages"), P(), P()),
                      out_specs=(P(), P()))
             def pipeline(blocks_sharded, xm, mm):
-                sp = jax.tree.map(lambda v: v[0], blocks_sharded)
+                # in_specs P("stages") splits the canonical [L, ...] layer
+                # axis: this device already holds ITS [L/S, ...] stack
+                sp = blocks_sharded
                 s = jax.lax.axis_index("stages")
 
                 def step(carry, ti):

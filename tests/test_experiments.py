@@ -141,6 +141,29 @@ def test_cli_cross_silo_matches_fedavg(tmp_path):
 
 
 @pytest.mark.slow
+def test_cli_cross_silo_pipeline_stages(tmp_path):
+    """--mesh_stages: cross-silo federation where every silo trains its
+    transformer through the 2-stage GPipe pipeline (CPU devices stand in
+    for the stage chips).  Must run end-to-end AND compose with
+    --moe_experts (the ep x pp balance-loss path)."""
+    argv = ["--algo", "cross_silo", "--model", "transformer",
+            "--dataset", "shakespeare", "--mesh_stages", "2",
+            "--client_num_in_total", "4", "--client_num_per_round", "2",
+            "--comm_round", "1", "--frequency_of_the_test", "1",
+            "--batch_size", "4", "--epochs", "1", "--log_stdout", "false"]
+    out = main(argv)
+    assert np.isfinite(out["train_loss"])
+    out_moe = main(argv + ["--moe_experts", "2"])
+    assert np.isfinite(out_moe["train_loss"])
+
+
+def test_cli_mesh_stages_rejected_outside_cross_silo():
+    with pytest.raises(ValueError, match="mesh_stages"):
+        main(["--algo", "fedavg", "--model", "transformer", "--dataset",
+              "shakespeare", "--mesh_stages", "2"] + _BASE)
+
+
+@pytest.mark.slow
 def test_cli_cross_silo_grpc_loopback(tmp_path):
     """True multi-process federation: server + 2 silo processes over gRPC
     on 127.0.0.1 (the reference's localhost-MPI strategy, SURVEY.md §4.3,

@@ -52,9 +52,7 @@ def test_pp_gradients_match_sequential(setup, devices):
 
     g_seq = jax.grad(lambda p: _ce(lm.apply_seq(p, toks), y))(params)
     g_pp = jax.jit(jax.grad(lambda p: _ce(pp_fn(p, toks), y)))(pp)
-    g_pp_blocks = jax.tree.map(
-        lambda v: np.asarray(v).reshape((-1,) + v.shape[2:]),
-        g_pp["blocks"])
+    g_pp_blocks = jax.tree.map(np.asarray, g_pp["blocks"])
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
         g_seq["blocks"], g_pp_blocks)
@@ -114,9 +112,7 @@ def test_pp_workload_local_training_matches_sequential(setup, devices):
     pp_params = lm.pp_shard_params(params, mesh, 4)
     out_pp, _ = make_local_trainer(wl_pp, opt, epochs=2)(
         pp_params, data, jax.random.key(2))
-    out_pp_blocks = jax.tree.map(
-        lambda v: np.asarray(v).reshape((-1,) + v.shape[2:]),
-        out_pp["blocks"])
+    out_pp_blocks = jax.tree.map(np.asarray, out_pp["blocks"])
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4),
         out_seq["blocks"], out_pp_blocks)
@@ -184,9 +180,7 @@ def test_pp_moe_gradients_carry_balance_loss(moe_setup, devices):
 
     g_seq = jax.grad(loss_seq)(params)
     g_pp = jax.jit(jax.grad(loss_pp))(pp)
-    g_pp_blocks = jax.tree.map(
-        lambda v: np.asarray(v).reshape((-1,) + v.shape[2:]),
-        g_pp["blocks"])
+    g_pp_blocks = jax.tree.map(np.asarray, g_pp["blocks"])
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-5),
         g_seq["blocks"], g_pp_blocks)
@@ -221,9 +215,7 @@ def test_pp_moe_workload_local_training_matches_sequential(moe_setup,
     pp_params = lm.pp_shard_params(params, mesh, 2)
     out_pp, _ = make_local_trainer(wl_pp, opt, epochs=2)(
         pp_params, data, jax.random.key(2))
-    out_pp_blocks = jax.tree.map(
-        lambda v: np.asarray(v).reshape((-1,) + v.shape[2:]),
-        out_pp["blocks"])
+    out_pp_blocks = jax.tree.map(np.asarray, out_pp["blocks"])
     jax.tree.map(lambda a, b: np.testing.assert_allclose(
         np.asarray(a), np.asarray(b), rtol=2e-3, atol=1e-4),
         out_seq["blocks"], out_pp_blocks)
