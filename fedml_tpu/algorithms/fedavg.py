@@ -58,6 +58,13 @@ class FedAvgConfig:
     # checkpointer (per-round save cadence needs the host loop) or a
     # _server_update hook (per-round host-side server state, e.g. FedOpt).
     rounds_per_dispatch: int = 1
+    # execution of the cohort's client axis: "vmap" trains all clients
+    # concurrently (per-client conv kernels lower to grouped convs),
+    # "scan" trains them sequentially with dense convs — identical
+    # results (parity-tested); the right engine is hardware-empirical
+    # (bench.py BENCH_R56 grid).  Scan also compiles one client's
+    # program instead of the whole cohort's.
+    client_axis: str = "vmap"
     # evaluate_global processes at most this many clients per compiled
     # call (single-chip and mesh-sharded alike).  The all-clients vmap
     # materializes [C, S, B, ...] activations (an NWP model's logits over
@@ -92,7 +99,8 @@ class FedAvg:
                                         config.wd)
             local_train = make_local_trainer(workload, opt, config.epochs)
         self._local_train = local_train
-        self.cohort_step = make_cohort_step(local_train, mesh=mesh)
+        self.cohort_step = make_cohort_step(local_train, mesh=mesh,
+                                            client_axis=config.client_axis)
         self._base_cohort_step = self.cohort_step  # fast-path eligibility
         # optional server-side hook applied AFTER each round's aggregation:
         # server_update(prev_params, w_avg) -> new_params (FedOpt's
@@ -235,7 +243,8 @@ class FedAvg:
         cfg = self.cfg
         m = cfg.client_num_per_round
         # one jit'd rounds_fn serves every chunk size (cache keys on shapes)
-        rounds_fn = make_scanned_rounds(self._local_train, m)
+        rounds_fn = make_scanned_rounds(self._local_train, m,
+                                        client_axis=cfg.client_axis)
 
         round_idx = start_round
         while round_idx < cfg.comm_round:
@@ -289,7 +298,8 @@ class FedAvg:
             self._device_round = (self._device_round_override
                                   or make_device_round(
                                       self._local_train,
-                                      self.cfg.client_num_per_round))
+                                      self.cfg.client_num_per_round,
+                                      client_axis=self.cfg.client_axis))
         self._train_dev = {k: jax.numpy.asarray(v)
                            for k, v in self.data.train.items()}
         return True

@@ -116,6 +116,73 @@ def mnist_learnable_twin(num_clients: int = 1000, class_num: int = 10,
                                  np.concatenate(ys_te), batch_size))
 
 
+def cifar_learnable_twin(num_clients: int = 10, class_num: int = 10,
+                         samples_per_client: int = 500,
+                         partition_alpha: float = 0.5,
+                         batch_size: int = 64, noise: float = 0.35,
+                         seed: int = 0) -> FederatedData:
+    """A LEARNABLE CIFAR-shaped twin for flagship-config accuracy proofs
+    (benchmark/README.md:105 — real CIFAR is not downloadable here):
+    each class is a smooth random 32x32x3 prototype (low-res pattern,
+    bilinearly upsampled) plus pixel noise, partitioned across clients
+    with the REAL LDA(alpha) partitioner (core/partition.py) so the
+    non-IID label skew matches the published config's.  A conv net
+    separates the classes well (centralized accuracy lands in the 90s at
+    the default noise), leaving federated runs the same "non-IID gap" to
+    close that the reference's 93.19 -> 87.12 row documents."""
+    from fedml_tpu.core.partition import partition_dirichlet_hetero
+
+    rng = np.random.RandomState(seed)
+    n_total = num_clients * samples_per_client
+    low = rng.randn(class_num, 8, 8, 3).astype(np.float32)
+    protos = np.stack([_upsample_bilinear(p, 32) for p in low])
+
+    def make_split(n, rng):
+        y = rng.randint(0, class_num, n).astype(np.int32)
+        x = protos[y] + noise * rng.randn(n, 32, 32, 3).astype(np.float32)
+        return x.astype(np.float32), y
+
+    x_tr, y_tr = make_split(n_total, rng)
+    x_te, y_te = make_split(max(class_num * 20, n_total // 5), rng)
+    idx_map = partition_dirichlet_hetero(y_tr, num_clients, class_num,
+                                         partition_alpha, seed=seed)
+    # per-client 80/20 train/test split of the client's OWN shard, so the
+    # federated test metric sees each client's non-IID label mix (the
+    # reference's local_test_on_all_clients semantics)
+    xs, ys, xs_te, ys_te = [], [], [], []
+    for c in range(num_clients):
+        idx = idx_map[c]
+        n_te = max(1, len(idx) // 5)
+        xs.append(x_tr[idx[:-n_te]])
+        ys.append(y_tr[idx[:-n_te]])
+        xs_te.append(x_tr[idx[-n_te:]])
+        ys_te.append(y_tr[idx[-n_te:]])
+    return FederatedData(
+        client_num=num_clients, class_num=class_num,
+        train=stack_client_data(xs, ys, batch_size),
+        test=stack_client_data(xs_te, ys_te, batch_size),
+        train_global=batch_global(np.concatenate(xs), np.concatenate(ys),
+                                  batch_size),
+        test_global=batch_global(x_te, y_te, batch_size))
+
+
+def _upsample_bilinear(img: np.ndarray, size: int) -> np.ndarray:
+    """[h, w, c] -> [size, size, c] bilinear (numpy-only, no jax import at
+    data-gen time)."""
+    h, w, c = img.shape
+    ys = np.linspace(0, h - 1, size)
+    xs = np.linspace(0, w - 1, size)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    fy = (ys - y0)[:, None, None]
+    fx = (xs - x0)[None, :, None]
+    top = img[y0][:, x0] * (1 - fx) + img[y0][:, x1] * fx
+    bot = img[y1][:, x0] * (1 - fx) + img[y1][:, x1] * fx
+    return (top * (1 - fy) + bot * fy).astype(np.float32)
+
+
 def synthetic_federated_dataset(
         num_clients: int = 8, samples_per_client: int = 32,
         sample_shape: Sequence[int] = (28, 28, 1), class_num: int = 10,

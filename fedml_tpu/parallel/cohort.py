@@ -170,7 +170,8 @@ def make_cohort_step(local_train, mesh: Optional[Mesh] = None,
 
 
 def make_device_round(local_train, clients_per_round: int,
-                      aggregate=tree_weighted_mean, transform_update=None):
+                      aggregate=tree_weighted_mean, transform_update=None,
+                      client_axis: str = "vmap"):
     """Fully-on-device round: the ENTIRE stacked dataset lives in HBM and
     the sampled cohort is gathered by ids INSIDE the jit — zero per-round
     host<->device traffic (only the [m] ids array crosses).
@@ -187,7 +188,8 @@ def make_device_round(local_train, clients_per_round: int,
     ``live`` a float32[m] 1/0 mask of real (non-padding) cohort slots.
     """
 
-    body = _device_round_body(local_train, aggregate, transform_update)
+    body = _device_round_body(local_train, aggregate, transform_update,
+                              client_axis)
     return jax.jit(body)
 
 
@@ -203,7 +205,8 @@ def gather_live_cohort(stacked: CohortData, ids, live) -> CohortData:
     return cohort
 
 
-def _device_round_body(local_train, aggregate, transform_update):
+def _device_round_body(local_train, aggregate, transform_update,
+                       client_axis: str = "vmap"):
     """One HBM-resident round: in-jit id gather + live masking + cohort
     train + aggregate.  Shared by make_device_round (K=1, jitted directly)
     and make_scanned_rounds (the lax.scan body), so the two fast paths can
@@ -213,7 +216,7 @@ def _device_round_body(local_train, aggregate, transform_update):
         cohort = gather_live_cohort(stacked, ids, live)
         stacked_out, metrics = train_cohort(
             local_train, params, cohort, rng,
-            transform_update=transform_update)
+            transform_update=transform_update, client_axis=client_axis)
         return _call_aggregate(aggregate, stacked_out,
                                cohort["num_samples"], params, rng), metrics
 
@@ -222,7 +225,7 @@ def _device_round_body(local_train, aggregate, transform_update):
 
 def make_scanned_rounds(local_train, clients_per_round: int,
                         aggregate=tree_weighted_mean,
-                        transform_update=None):
+                        transform_update=None, client_axis: str = "vmap"):
     """K federated rounds per dispatch: `lax.scan` over per-round cohort ids
     with the dataset HBM-resident (make_device_round's gather, iterated on
     device).
@@ -238,7 +241,8 @@ def make_scanned_rounds(local_train, clients_per_round: int,
     live [K, m] float32, rng) -> (params, per_round_metrics)``.
     """
 
-    body = _device_round_body(local_train, aggregate, transform_update)
+    body = _device_round_body(local_train, aggregate, transform_update,
+                              client_axis)
 
     @jax.jit
     def rounds_fn(params, stacked, ids, live, rng):
