@@ -828,6 +828,12 @@ def main():
     # vs jax CPU on the same host is a same-platform comparison.
     torch_s = bench_torch_baseline()
     details["torch_cpu_sequential_round_s"] = torch_s
+    details["vs_baseline_meaning"] = (
+        "ratio vs the reference's SEQUENTIAL standalone simulator loop "
+        "(fedavg_api.py:52-66) in torch on THIS HOST'S CPU — an "
+        "architectural comparison (one-program cohort vs per-client "
+        "Python loop), NOT a GPU-hardware claim; the 8xV100 wall-clock "
+        "north star (BASELINE.md) remains unmeasured from both sides")
     out_name = "BENCH_DETAILS_cpu.json" if on_cpu else "BENCH_DETAILS.json"
     with open(_repo_path(out_name), "w") as f:
         json.dump(details, f, indent=2)

@@ -105,7 +105,9 @@ class FedAvgRobust(FedAvg):
             agg = make_byzantine_aggregate(
                 cfg.defense, trim_frac=cfg.trim_frac, byz_f=cfg.byz_f,
                 krum_m=cfg.krum_m, gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps)
-            self.cohort_step = make_cohort_step(local_train, aggregate=agg)
+            self.cohort_step = make_cohort_step(
+                local_train, aggregate=agg,
+                client_axis=cfg.client_axis)
             return
 
         if cfg.defense_backend == "pallas" and cfg.defense != "none":
@@ -123,7 +125,9 @@ class FedAvgRobust(FedAvg):
                             ("norm_diff_clipping", "weak_dp") else None),
                 noise_std=(cfg.stddev if cfg.defense == "weak_dp" else 0.0),
                 interpret=jax.default_backend() != "tpu")
-            self.cohort_step = make_cohort_step(local_train, aggregate=fused)
+            self.cohort_step = make_cohort_step(
+                local_train, aggregate=fused,
+                client_axis=cfg.client_axis)
             return
 
         def transform(client_params, global_params, rng):
@@ -136,4 +140,5 @@ class FedAvgRobust(FedAvg):
 
         self.cohort_step = make_cohort_step(
             local_train, mesh=mesh,
-            transform_update=None if cfg.defense == "none" else transform)
+            transform_update=None if cfg.defense == "none" else transform,
+            client_axis=cfg.client_axis)

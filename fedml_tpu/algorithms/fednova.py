@@ -113,6 +113,11 @@ class FedNova(FedAvg):
     def __init__(self, workload, data, config: FedNovaConfig, mesh=None, sink=None):
         super().__init__(workload, data, config, mesh=mesh, sink=sink)
         cfg = config
+        if cfg.client_axis != "vmap":
+            # the Nova round has its own train_cohort call sites; a
+            # silently-vmapped "scan" request would mislabel the engine
+            raise ValueError("client_axis is not wired into FedNova's "
+                             "custom round; drop --client_axis")
         local_train = make_fednova_local_trainer(workload, cfg)
         self._gmf_buf = None
 

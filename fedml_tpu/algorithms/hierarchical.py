@@ -163,6 +163,11 @@ class HierarchicalFedAvg(FedAvg):
         cfg = config
         if cfg.group_method != "random":
             raise ValueError(f"unknown group_method {cfg.group_method!r}")
+        if cfg.client_axis != "vmap":
+            # grouped/two-level rounds vmap inside their own bodies; a
+            # silently-ignored "scan" request would mislabel the engine
+            raise ValueError("client_axis is not wired into hierarchical "
+                             "FL's grouped rounds; drop --client_axis")
         rng = np.random.RandomState(cfg.seed)
         self.group_indexes = rng.randint(0, cfg.group_num, data.client_num)
         if two_level:

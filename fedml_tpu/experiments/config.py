@@ -105,6 +105,7 @@ class ExperimentConfig:
     mesh_clients: int = 0     # >0: shard the cohort over this many devices
     mesh_groups: int = 0      # >0 (hierarchical): [groups, clients] mesh
     mesh_sequence: int = 0    # >0 (fedavg + transformer): dp x sp
+    #                           [clients, sequence] mesh with ring attention
     mesh_stages: int = 0      # >0 (cross_silo + transformer): silo-local
     #                           pipeline parallelism — transformer blocks
     #                           over this many stage devices (GPipe,
@@ -112,7 +113,14 @@ class ExperimentConfig:
     #                           --moe_experts (balance loss rides the
     #                           schedule's scan carry)
     pp_microbatches: int = 0  # GPipe microbatches (0 = mesh_stages)
-    #                           [clients, sequence] mesh with ring attention
+    client_axis: str = "vmap"  # cohort engine: "vmap" (concurrent
+    #                            clients, grouped convs) | "scan"
+    #                            (sequential clients, dense convs) —
+    #                            identical results, hardware-empirical
+    #                            choice (bench BENCH_R56 grid)
+    eval_chunk_clients: int = 1024  # evaluate_global clients per compiled
+    #                                 call; bounds eval memory on large
+    #                                 corpora (0 = one-shot vmap)
     attn_block_size: int = 0  # >0 (transformer): flash-style kv blocking —
     #                           O(T*block) attention memory for single-chip
     #                           train/eval at long context

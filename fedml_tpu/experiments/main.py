@@ -78,7 +78,9 @@ def _fedavg_cfg_kwargs(cfg: ExperimentConfig) -> Dict[str, Any]:
                 epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr,
                 client_optimizer=cfg.client_optimizer, wd=cfg.wd,
                 frequency_of_the_test=freq, seed=cfg.seed,
-                rounds_per_dispatch=cfg.rounds_per_dispatch)
+                rounds_per_dispatch=cfg.rounds_per_dispatch,
+                client_axis=cfg.client_axis,
+                eval_chunk_clients=cfg.eval_chunk_clients)
 
 
 def _make_workload(cfg: ExperimentConfig, data):
