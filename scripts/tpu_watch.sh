@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# Recurring tunnel probe (VERDICT r3 item 1: "check for the tunnel early
+# and repeatedly — a cron-style retry during the session").  The moment
+# the backend answers, fire the full capture; on a mid-capture wedge go
+# back to probing and retry (stage 1 reruns are cache-warm and cheap).
+# A sentinel file marks capture-in-progress so interactive work can
+# avoid contaminating the timings on this small host.
+cd "$(dirname "$0")/.."
+LOG=${TPU_WATCH_LOG:-/tmp/tpu_watch.log}
+SENTINEL=/tmp/tpu_capture_running
+trap 'rm -f "$SENTINEL"' EXIT
+while true; do
+  if timeout 75 python -c "import jax, jax.numpy as jnp; \
+jax.block_until_ready(jax.jit(lambda a: a + 1)(jnp.ones(8)))" \
+      >/dev/null 2>&1; then
+    echo "$(date -u +%FT%TZ) alive — launching capture" >> "$LOG"
+    touch "$SENTINEL"
+    if bash scripts/tpu_capture.sh >> "$LOG" 2>&1; then
+      rm -f "$SENTINEL"
+      echo "$(date -u +%FT%TZ) capture COMPLETE" >> "$LOG"
+      exit 0
+    fi
+    rm -f "$SENTINEL"
+    echo "$(date -u +%FT%TZ) capture incomplete — back to probing" >> "$LOG"
+  else
+    echo "$(date -u +%FT%TZ) wedged" >> "$LOG"
+  fi
+  sleep 140
+done

@@ -86,3 +86,55 @@ def test_auto_group_and_block_helpers():
     assert _auto_block(2048, threshold=1024) == 512
     assert _auto_block(512, threshold=1024) is None   # dense is fine
     assert _auto_block(1031, threshold=1024) is None  # prime, no divisor
+
+
+def _run_stalled(tmp_path, watch_fields):
+    """Exercise _emit_stalled in a subprocess (it hard-exits by design)."""
+    import os
+    import subprocess
+    import sys
+    code = (
+        "import json, sys\n"
+        "import bench\n"
+        f"bench._WATCH.update(**json.loads({json.dumps(json.dumps(watch_fields))}))\n"
+        "bench._repo_path = lambda name: "
+        f"__import__('os').path.join({str(tmp_path)!r}, name)\n"
+        "bench._emit_stalled()\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 0, proc.stderr
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_watchdog_partial_line_carries_measured_values(tmp_path):
+    """A mid-run wedge after the femnist configs must emit the values that
+    WERE measured, labeled partial, with vs_baseline from the torch
+    baseline that ran before any TPU RPC — and checkpoint the partial
+    details file."""
+    details = {"platform": "tpu", "device_kind": "TPU v5 lite",
+               "configs": {"femnist_cnn_c10":
+                           {"rounds_per_s": 100.0, "mfu": 0.25}}}
+    line = _run_stalled(tmp_path, {
+        "details": details, "out": "BENCH_TESTOUT.json",
+        "torch_s": 2.0, "stage": "resnet56", "beat": 0.0})
+    assert line["value"] == pytest.approx(100.0)
+    assert "resnet56" in line["partial"]
+    assert line["vs_baseline"] == pytest.approx(200.0)
+    assert line["mfu_femnist"] == pytest.approx(0.25)
+    assert "stale" not in line          # measured THIS run, not carried
+    part = json.loads((tmp_path / "BENCH_TESTOUT.json.partial").read_text())
+    assert part["partial_next_stage"] == "resnet56"
+    assert part["configs"]["femnist_cnn_c10"]["rounds_per_s"] == 100.0
+
+
+def test_watchdog_stall_before_any_config_is_skipped_line(tmp_path):
+    """Wedge before anything completed: the line must look like the
+    skip-on-wedge contract (no fabricated values, no vs_baseline)."""
+    line = _run_stalled(tmp_path, {
+        "details": {"platform": "tpu", "configs": {}},
+        "out": "BENCH_TESTOUT.json", "torch_s": 5.0,
+        "stage": "femnist twins", "beat": 0.0})
+    assert line["value"] is None
+    assert "femnist twins" in line["skipped"]
+    assert "vs_baseline" not in line
