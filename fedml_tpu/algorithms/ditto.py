@@ -1,0 +1,236 @@
+"""Ditto (Li et al. 2021, arXiv:2012.04221) — personalized FL via a
+bi-level objective: a normal FedAvg global stream plus, per client, a
+persistent personalized model trained against its own data with a
+proximal pull toward the current global weights.
+
+Beyond the reference's algorithm list — nothing in ``fedml_api`` covers
+personalization (its closest knob is FedProx's μ, which regularizes the
+*global* stream; SURVEY.md §2.2).  Included because the cohort engine
+makes it nearly free: like SCAFFOLD's control variates
+(algorithms/scaffold.py), the personalized models live as ONE stacked
+pytree ``[client_num_in_total, ...]`` host-side between rounds, with a
+cohort gather/scatter per round and a vmap'd local scan inside one jit.
+
+Round structure (Algorithm 1 of the paper, full-batch SGD solver):
+
+    global:    w-stream is EXACTLY FedAvg — the base cohort step consumes
+               the same rng it would under plain FedAvg, so the global
+               trajectory is bit-identical (parity-tested);
+    personal:  v_i ← v_i − η_p · (∇F_i(v_i) + λ (v_i − w^t))
+               for ``personal_epochs`` local epochs, starting from the
+               round-start global weights the first time client i is
+               sampled.  λ=0 decouples v_i into pure local training;
+               λ→∞ pins v_i to the global stream.
+
+Eval: ``evaluate_personalized`` scores each client's OWN model on its
+own shard (the metric the paper reports); ``evaluate_global`` appends
+those columns to the standard global metrics so ``run()``'s history
+carries both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.trainer.workload import Workload
+
+Pytree = Any
+
+# distinct fold_in stream for the personal updates, so adding Ditto's
+# second training pass cannot perturb the global FedAvg rng chain
+_PERSONAL_STREAM = 0x44495454  # ASCII "DITT"
+
+
+@dataclasses.dataclass
+class DittoConfig(FedAvgConfig):
+    ditto_lambda: float = 0.1
+    # 0 -> inherit the corresponding global hyperparameter
+    personal_lr: float = 0.0
+    personal_epochs: int = 0
+
+
+def make_ditto_local(workload: Workload, lr: float, epochs: int,
+                     lam: float):
+    """``train(v, w_ref, data, rng) -> v'`` — the personalized solver.
+
+    Plain SGD on ∇F_i(v) + λ(v − w_ref), the paper's Algorithm 1 inner
+    loop.  The workload's ``grad_clip_norm`` is honored AFTER the
+    proximal coupling — the same corrected-then-clipped ordering the
+    FedProx/SCAFFOLD trainers use (local_sgd.py).  Fully-padded batches
+    freeze the carry, so ragged clients take exactly their own steps.
+    """
+    import optax
+    clip = (optax.clip_by_global_norm(workload.grad_clip_norm)
+            if workload.grad_clip_norm is not None else None)
+    grad_fn = jax.grad(lambda p, b, r: workload.loss_fn(p, b, r, True)[0])
+
+    def train(v: Pytree, w_ref: Pytree, data: Dict[str, jax.Array],
+              rng: jax.Array):
+        num_steps = jax.tree.leaves(data)[0].shape[0]
+        clip_state = clip.init(v) if clip is not None else None
+
+        def step(carry, step_idx):
+            v, rng = carry
+            rng, drng = jax.random.split(rng)
+            batch = jax.tree.map(lambda x: x[step_idx % num_steps], data)
+            grads = grad_fn(v, batch, drng)
+            grads = jax.tree.map(lambda g, vi, wi: g + lam * (vi - wi),
+                                 grads, v, w_ref)
+            if clip is not None:
+                grads, _ = clip.update(grads, clip_state)
+            gd = (jnp.sum(batch["mask"]) > 0).astype(jnp.float32)
+            v = jax.tree.map(lambda p, g: p - lr * gd * g, v, grads)
+            return (v, rng), None
+
+        (v, _), _ = jax.lax.scan(step, (v, rng),
+                                 jnp.arange(epochs * num_steps))
+        return v
+
+    return train
+
+
+class Ditto(FedAvg):
+    """FedAvg.run drives this via the replaced ``cohort_step`` (host-gather
+    path — the stacked v_i state is scattered back per round, which the
+    HBM fast paths don't model).  The step re-derives the round's client
+    ids from the same seeded sampling chain run() used to gather the
+    cohort (the SCAFFOLD pattern)."""
+
+    def __init__(self, workload, data, config: DittoConfig, mesh=None,
+                 sink=None):
+        if mesh is not None:
+            raise ValueError("ditto tracks per-client personalized models "
+                             "host-side; mesh sharding is not wired — run "
+                             "single-chip")
+        if getattr(workload, "stateful", False):
+            raise ValueError(
+                "ditto does not support stateful (BatchNorm) workloads: "
+                "the proximal pull over running statistics is undefined — "
+                "use a GroupNorm model (e.g. resnet18_gn)")
+        super().__init__(workload, data, config, mesh=mesh, sink=sink)
+        cfg = config
+        self._round_counter = 0
+        self.v_locals = None  # stacked [client_num_in_total, ...]
+        p_lr = cfg.personal_lr or cfg.lr
+        p_epochs = cfg.personal_epochs or cfg.epochs
+        personal = make_ditto_local(workload, p_lr, p_epochs,
+                                    cfg.ditto_lambda)
+
+        @jax.jit
+        def personal_round(v_cohort, w_ref, cohort, rng):
+            n = cohort["num_samples"].shape[0]
+            rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+                jnp.arange(n))
+            batches = {k: v for k, v in cohort.items()
+                       if k != "num_samples"}
+            new_v = jax.vmap(personal, in_axes=(0, None, 0, 0))(
+                v_cohort, w_ref, batches, rngs)
+            # padded slots (weight 0) keep their previous state
+            live = (cohort["num_samples"] > 0).astype(jnp.float32)
+            return jax.tree.map(
+                lambda nv, v: jnp.where(
+                    live.reshape((-1,) + (1,) * (v.ndim - 1)) > 0, nv, v),
+                new_v, v_cohort)
+
+        self._personal_round = personal_round
+        # vmapped per-client evaluator: client i's OWN params on its OWN
+        # shard; metric dicts are sums, so cross-client aggregation is a
+        # tree-sum (same convention as cohort_eval)
+        self._personal_eval = jax.jit(
+            lambda vs, data: jax.tree.map(
+                lambda m: jnp.sum(m, axis=0),
+                jax.vmap(self.evaluate, in_axes=(0, 0))(vs, data)))
+        self.cohort_step = self._ditto_step
+
+    def run(self, params=None, rng=None, checkpointer=None):
+        # fresh runs restart the sampling-chain mirror AND the personalized
+        # state (v_i = w^0 on first sight); a checkpoint resume restores
+        # both via _load_extra_state afterwards
+        self._round_counter = 0
+        self.v_locals = None
+        return super().run(params=params, rng=rng, checkpointer=checkpointer)
+
+    def _ditto_step(self, params, cohort, rng):
+        if self.v_locals is None:
+            # paper init: v_i = w^0 (round-start globals on first sight)
+            self.v_locals = jax.tree.map(
+                lambda x: jnp.broadcast_to(
+                    x[None], (self.data.client_num,) + x.shape).copy(),
+                params)
+        # global stream: EXACTLY FedAvg, consuming the round rng unchanged
+        new_params, aux = self._base_cohort_step(params, cohort, rng)
+        ids = sample_clients(self._round_counter, self.data.client_num,
+                             self.cfg.client_num_per_round)
+        self._round_counter += 1
+        m = cohort["num_samples"].shape[0]
+        padded = jnp.zeros(m, jnp.int32).at[:len(ids)].set(
+            jnp.asarray(ids, jnp.int32))
+        v_cohort = jax.tree.map(lambda v: jnp.take(v, padded, axis=0),
+                                self.v_locals)
+        p_rng = jax.random.fold_in(rng, _PERSONAL_STREAM)
+        new_v = self._personal_round(v_cohort, params, cohort, p_rng)
+        live_n = len(ids)
+        self.v_locals = jax.tree.map(
+            lambda v, nv: v.at[jnp.asarray(ids, jnp.int32)].set(
+                nv[:live_n]),
+            self.v_locals, new_v)
+        return new_params, aux
+
+    # -- personalized evaluation ------------------------------------------
+    def evaluate_personalized(self) -> Dict[str, float]:
+        """Sample-weighted metrics of each client's PERSONAL model on its
+        own train/test shard (the paper's reported metric), swept in
+        ``eval_chunk_clients`` chunks like evaluate_global."""
+        from fedml_tpu.utils.metrics import stats_from_metrics
+        if self.v_locals is None:
+            return {}
+        out: Dict[str, float] = {}
+        chunk = self.cfg.eval_chunk_clients or self.data.client_num
+        for split, stacked in (("train", self.data.train),
+                               ("test", self.data.test)):
+            if stacked is None:
+                continue
+            from fedml_tpu.algorithms.fedavg import sweep_eval_chunks
+            from fedml_tpu.parallel.cohort import pad_clients
+
+            def run_chunk(part, lo):
+                # per-client params ride the same zero-pad convention as
+                # the data rows: padded rows carry mask 0, so the
+                # zero-padded params rows contribute nothing
+                v_chunk = jax.tree.map(
+                    lambda v: pad_clients(
+                        {"v": v[lo:lo + chunk]}, chunk)["v"],
+                    self.v_locals)
+                return self._personal_eval(
+                    v_chunk, {k: part[k] for k in ("x", "y", "mask")})
+
+            total = sweep_eval_chunks(stacked, chunk, run_chunk)
+            out.update(stats_from_metrics(total,
+                                          prefix=f"personal_{split}_"))
+        return out
+
+    def evaluate_global(self, params) -> Dict[str, float]:
+        out = super().evaluate_global(params)
+        out.update(self.evaluate_personalized())
+        return out
+
+    # personalized state rides the round checkpoint
+    def _extra_state(self):
+        return {"v_locals": self.v_locals,
+                "round_counter": self._round_counter}
+
+    def _extra_state_template(self, params):
+        return {"v_locals": jax.tree.map(
+            lambda x: jnp.zeros((self.data.client_num,) + x.shape,
+                                x.dtype), params),
+                "round_counter": 0}
+
+    def _load_extra_state(self, extra) -> None:
+        self.v_locals = extra["v_locals"]
+        self._round_counter = int(extra["round_counter"])

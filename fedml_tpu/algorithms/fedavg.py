@@ -74,6 +74,27 @@ class FedAvgConfig:
     eval_chunk_clients: int = 1024
 
 
+def sweep_eval_chunks(stacked, chunk: int, run_chunk):
+    """THE chunked-eval convention: slice the stacked client axis into
+    [chunk]-row pieces, zero-pad the last one to the static chunk shape
+    via pad_clients (padded rows carry mask 0 / weight 0 and contribute
+    nothing), call ``run_chunk(part, lo) -> summed-metric dict`` on each,
+    and sum.  Summed metric dicts are exact under chunking.  Shared by
+    FedAvg.evaluate_global and Ditto.evaluate_personalized — any change
+    to the padding contract happens here once."""
+    from fedml_tpu.parallel.cohort import pad_clients
+    total = None
+    n_clients = stacked["num_samples"].shape[0]
+    for lo in range(0, n_clients, chunk):
+        part = {k: jax.numpy.asarray(np.asarray(v[lo:lo + chunk]))
+                for k, v in stacked.items()}
+        part = pad_clients(part, chunk)  # static shape across chunks
+        m = jax.tree.map(np.asarray, run_chunk(part, lo))
+        total = m if total is None else jax.tree.map(
+            lambda a, b: a + b, total, m)
+    return total
+
+
 class FedAvg:
     def __init__(self, workload: Workload, data: FederatedData,
                  config: FedAvgConfig, mesh=None, sink=None,
@@ -359,24 +380,16 @@ class FedAvg:
         return out
 
     def _eval_cohort_chunked(self, params, stacked, chunk: int):
-        """Sum the cohort-eval metric dict over [chunk]-client slices; the
-        last slice is zero-padded to the chunk size via pad_clients (the
-        one shared zero-contribution convention) so every call hits the
-        same compiled program.  Works sharded too: each chunk rides the
-        same `_eval_cohort` as the one-shot path, with multi-process
-        chunks staged globally pre-jit."""
+        """Sum the cohort-eval metric dict over [chunk]-client slices;
+        each chunk rides the same `_eval_cohort` as the one-shot path,
+        with multi-process chunks staged globally pre-jit."""
         from jax.sharding import PartitionSpec as P
         from fedml_tpu.parallel.cohort import pad_clients
-        total = None
-        n_clients = stacked["num_samples"].shape[0]
-        for lo in range(0, n_clients, chunk):
-            part = {k: jax.numpy.asarray(np.asarray(v[lo:lo + chunk]))
-                    for k, v in stacked.items()}
-            part = pad_clients(part, chunk)  # static shape across chunks
+
+        def run_chunk(part, lo):
             if self.mesh is not None and jax.process_count() > 1:
                 part = pad_clients(part, self.mesh.shape["clients"])
                 part = stage_global(part, self.mesh, P("clients"))
-            m = jax.tree.map(np.asarray, self._eval_cohort(params, part))
-            total = m if total is None else jax.tree.map(
-                lambda a, b: a + b, total, m)
-        return total
+            return self._eval_cohort(params, part)
+
+        return sweep_eval_chunks(stacked, chunk, run_chunk)

@@ -157,9 +157,13 @@ class Scaffold(FedAvg):
         self.cohort_step = self._stateful_step
 
     def run(self, params=None, rng=None, checkpointer=None):
-        # fresh runs restart the sampling-chain mirror; a checkpoint resume
-        # restores the true counter via _load_extra_state afterwards
+        # fresh runs restart the sampling-chain mirror AND the control
+        # variates (a second run() on the same instance must not reuse the
+        # previous run's c state); a checkpoint resume restores both via
+        # _load_extra_state afterwards
         self._round_counter = 0
+        self.c_global = None
+        self.c_locals = None
         return super().run(params=params, rng=rng, checkpointer=checkpointer)
 
     def _stateful_step(self, params, cohort, rng):

@@ -51,6 +51,9 @@ class ExperimentConfig:
 
     # ---- algorithm extras ----------------------------------------------
     mu: float = 0.1                      # FedProx proximal term
+    ditto_lambda: float = 0.1            # Ditto: personalization pull λ
+    personal_lr: float = 0.0             # Ditto: 0 → inherit --lr
+    personal_epochs: int = 0             # Ditto: 0 → inherit --epochs
     gmf: float = 0.0                     # FedNova global momentum factor
     norm_bound: float = 5.0              # robust: clip threshold
     stddev: float = 0.025                # robust: weak-DP noise

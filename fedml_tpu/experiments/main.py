@@ -376,6 +376,22 @@ def run_scaffold(cfg, data, mesh, sink):
     return algo.history[-1] if algo.history else {}
 
 
+@runner("ditto")
+def run_ditto(cfg, data, mesh, sink):
+    """Ditto personalized FL (beyond the reference's list —
+    algorithms/ditto.py): the FedAvg global stream unchanged, plus
+    per-client personalized models trained with a λ proximal pull toward
+    the globals; history carries personal_{train,test}_acc columns."""
+    from fedml_tpu.algorithms.ditto import Ditto, DittoConfig
+    wl = _make_workload(cfg, data)
+    algo = Ditto(wl, data, DittoConfig(
+        ditto_lambda=cfg.ditto_lambda, personal_lr=cfg.personal_lr,
+        personal_epochs=cfg.personal_epochs, **_fedavg_cfg_kwargs(cfg)),
+        mesh=mesh, sink=sink)
+    algo.run(checkpointer=_make_checkpointer(cfg))
+    return algo.history[-1] if algo.history else {}
+
+
 def _pp_workload(cfg, data):
     """--mesh_stages: silo-local GPipe pipeline over the transformer block
     stack (parallel/pipeline.py) — the deployment for silos whose model is
@@ -947,7 +963,7 @@ def main(argv=None) -> Dict[str, Any]:
     # train f32 — fail loudly instead of faking a bf16 benchmark
     _DTYPE_RUNNERS = {"fedavg", "fedprox", "fedopt", "fednova",
                       "fedavg_robust", "hierarchical", "centralized",
-                      "decentralized", "turboaggregate"}
+                      "decentralized", "turboaggregate", "ditto"}
     if cfg.compute_dtype and cfg.algo not in _DTYPE_RUNNERS:
         raise ValueError(
             f"--compute_dtype is not wired into --algo {cfg.algo}; "

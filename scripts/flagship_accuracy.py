@@ -34,6 +34,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 REF_CURVES = "/root/reference/fedml_api/model/cv/pretrained/CIFAR10/resnet56"
 
 
+class PartialSink:
+    """MetricsSink that appends every eval to <json_out>.partial as it
+    lands: a tunnel wedge (or timeout kill) mid-run must still leave the
+    curve measured so far on disk (round-4 hardening — the tunnel was
+    seen wedging mid-session after a clean probe)."""
+
+    def __init__(self, path, meta):
+        self.path, self.meta, self.curve = path, meta, []
+
+    def log(self, metrics, step=None):
+        self.curve.append({"round": step,
+                           "train_acc": metrics.get("train_acc"),
+                           "test_acc": metrics.get("test_acc")})
+        with open(self.path, "w") as f:
+            json.dump({"partial": True, "config": self.meta,
+                       "federated_curve_so_far": self.curve}, f,
+                      indent=1)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--platform", default="tpu", choices=["cpu", "tpu"])
@@ -79,24 +98,6 @@ def main():
                                     partition_alpha=0.5, batch_size=64,
                                     seed=args.seed)
         source = f"learnable_twin(spc={samples}, lda=0.5)"
-
-    class PartialSink:
-        """Append every eval to <json_out>.partial as it lands: a tunnel
-        wedge (or timeout kill) mid-run must still leave the curve
-        measured so far on disk (round-4 hardening — the tunnel was seen
-        wedging mid-session after a clean probe)."""
-
-        def __init__(self, path, meta):
-            self.path, self.meta, self.curve = path, meta, []
-
-        def log(self, metrics, step=None):
-            self.curve.append({"round": step,
-                               "train_acc": metrics.get("train_acc"),
-                               "test_acc": metrics.get("test_acc")})
-            with open(self.path, "w") as f:
-                json.dump({"partial": True, "config": self.meta,
-                           "federated_curve_so_far": self.curve}, f,
-                          indent=1)
 
     wl = ClassificationWorkload(resnet56(10), num_classes=10)
     # scan engine on CPU: compiling the 10-client vmapped resnet56 cohort

@@ -39,19 +39,24 @@ EOF
 echo "== backend probe (90s watchdog) =="
 probe || { echo "backend unreachable — aborting capture"; exit 1; }
 
+# any measurement stage that fails or goes partial (bench exit 3, timeout
+# 124) marks the whole capture incomplete — the final exit code is what
+# tpu_watch.sh keys on to keep retrying instead of declaring COMPLETE
+FAILED=0
+
 echo "== 1/5 canonical full f32 bench (cache-warm; BENCH_DETAILS.json) =="
 timeout 5400 env BENCH_MODE=full python bench.py \
-  || echo "stage 1 FAILED or partial (rc=$?) — see BENCH_DETAILS.json.partial"
+  || { echo "stage 1 FAILED or partial (rc=$?) — see BENCH_DETAILS.json.partial"; FAILED=1; }
 
 probe || { echo "tunnel wedged after stage 1 — stopping"; exit 2; }
 echo "== 2/5 bf16 comparison (BENCH_DETAILS_bf16.json) =="
 timeout 3600 env BENCH_DTYPE=bfloat16 BENCH_SCALING=0 \
   BENCH_OUT=BENCH_DETAILS_bf16.json python bench.py \
-  || echo "stage 2 FAILED or partial (rc=$?)"
+  || { echo "stage 2 FAILED or partial (rc=$?)"; FAILED=1; }
 
 probe || { echo "tunnel wedged after stage 2 — stopping"; exit 2; }
 echo "== 3/5 resnet56 investigation: spreads + client-axis x dtype grid =="
-timeout 3600 python - <<'EOF' || echo "stage 3 FAILED or partial (rc=$?)"
+timeout 3600 python - <<'EOF' || { echo "stage 3 FAILED or partial (rc=$?)"; FAILED=1; }
 import json
 import os
 import jax
@@ -124,8 +129,13 @@ done
 probe || { echo "tunnel wedged after stage 4 — stopping"; exit 2; }
 echo "== 5/5 flagship accuracy (published resnet56 config, longest) =="
 timeout 14400 python scripts/flagship_accuracy.py \
-  || echo "stage 5 FAILED or partial (rc=$?) — see FLAGSHIP_CURVE.json.partial"
+  || { echo "stage 5 FAILED or partial (rc=$?) — see FLAGSHIP_CURVE.json.partial"; FAILED=1; }
 
+if [ "$FAILED" -ne 0 ]; then
+  echo "capture INCOMPLETE — at least one measurement stage failed or went"
+  echo "partial; tpu_watch.sh will retry (completed stages rerun cache-warm)"
+  exit 3
+fi
 echo "done — inspect BENCH_DETAILS.json / BENCH_DETAILS_bf16.json /"
 echo "BENCH_R56_SPREAD.json / FLAGSHIP_CURVE.json + profiles/, then commit"
 echo "the clean artifacts (profiles/ stays local — gitignored)."

@@ -103,7 +103,9 @@ def _run_stalled(tmp_path, watch_fields):
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    assert proc.returncode == 0, proc.stderr
+    # exit 3 = partial-from-wedge: nonzero so tpu_capture.sh/tpu_watch.sh
+    # keep retrying instead of declaring the capture complete
+    assert proc.returncode == 3, (proc.returncode, proc.stderr)
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 

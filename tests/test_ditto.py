@@ -1,0 +1,196 @@
+"""Ditto personalized FL (algorithms/ditto.py).
+
+Pins the paper's structure (Li et al. 2021, arXiv:2012.04221): the global
+stream is EXACTLY FedAvg; personalized models decouple at λ=0, pin to the
+globals as λ grows, and win under concept shift — the regime
+personalization exists for (same input ↦ different labels across clients,
+which no single global model can fit)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms import (Ditto, DittoConfig, FedAvg, FedAvgConfig)
+from fedml_tpu.algorithms.ditto import make_ditto_local
+from fedml_tpu.data.stacking import FederatedData, stack_client_data
+from fedml_tpu.models import LogisticRegression
+from fedml_tpu.trainer.workload import ClassificationWorkload
+
+
+def _concept_shift_clients(n_clients=4, dim=8, per=32, seed=0):
+    """Same marginal x, per-client label flips: client c labels by
+    sign(w·x) XOR (c odd) — global accuracy is capped near 50%, while each
+    personalized model can fit its own concept."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    xs, ys = [], []
+    for c in range(n_clients):
+        x = rng.randn(per, dim).astype(np.float32)
+        y = (x @ w > 0).astype(np.int32)
+        if c % 2:
+            y = 1 - y
+        xs.append(x)
+        ys.append(y)
+    return xs, ys
+
+
+def _fed(xs, ys, batch=8, classes=2):
+    train = stack_client_data(xs, ys, batch)
+    return FederatedData(client_num=len(xs), class_num=classes,
+                         train=train, test=train)
+
+
+def _wl(dim=8, classes=2):
+    return ClassificationWorkload(LogisticRegression(dim, classes),
+                                  num_classes=classes, grad_clip_norm=None)
+
+
+def _cfg_kwargs(rounds=3, clients=4):
+    return dict(comm_round=rounds, client_num_per_round=clients, epochs=1,
+                batch_size=8, lr=0.1, frequency_of_the_test=100, seed=0)
+
+
+def test_global_stream_is_bit_identical_to_fedavg():
+    xs, ys = _concept_shift_clients()
+    w_fed = FedAvg(_wl(), _fed(xs, ys),
+                   FedAvgConfig(**_cfg_kwargs())).run()
+    w_ditto = Ditto(_wl(), _fed(xs, ys),
+                    DittoConfig(ditto_lambda=0.3, **_cfg_kwargs())).run()
+    for a, b in zip(jax.tree.leaves(w_fed), jax.tree.leaves(w_ditto)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_lambda_zero_decouples_into_pure_local_training():
+    """λ=0: v_i is plain local SGD on client i's shard, starting from the
+    round-0 globals, untouched by aggregation — replay it directly through
+    the module's own local solver."""
+    xs, ys = _concept_shift_clients(n_clients=3)
+    data = _fed(xs, ys)
+    wl = _wl()
+    cfg = DittoConfig(ditto_lambda=0.0, **_cfg_kwargs(rounds=2, clients=3))
+    algo = Ditto(wl, data, cfg)
+    rng = jax.random.key(cfg.seed)
+    rng, init_rng = jax.random.split(rng)
+    w0 = wl.init(init_rng, jax.tree.map(
+        lambda v: v[0, 0], {k: data.train[k] for k in ("x", "y", "mask")}))
+    algo.run(params=w0, rng=rng)
+
+    local = make_ditto_local(wl, cfg.lr, cfg.epochs, 0.0)
+    batches = {k: data.train[k] for k in ("x", "y", "mask")}
+    for c in range(3):
+        v = w0
+        run_rng = rng
+        for r in range(2):
+            run_rng, round_rng = jax.random.split(run_rng)
+            p_rng = jax.random.fold_in(round_rng, 0x44495454)
+            v = local(v, v,  # w_ref unused at λ=0
+                      jax.tree.map(lambda x: jnp.asarray(x[c]), batches),
+                      jax.random.fold_in(p_rng, c))
+        got = jax.tree.map(lambda t: np.asarray(t[c]), algo.v_locals)
+        for a, b in zip(jax.tree.leaves(got), jax.tree.leaves(v)):
+            np.testing.assert_allclose(a, np.asarray(b), rtol=1e-6)
+
+
+def test_large_lambda_pins_personal_to_global():
+    xs, ys = _concept_shift_clients()
+    dists = {}
+    for lam in (0.0, 10.0):
+        algo = Ditto(_wl(), _fed(xs, ys),
+                     DittoConfig(ditto_lambda=lam, **_cfg_kwargs(rounds=4)))
+        w = algo.run()
+        d = 0.0
+        for vw, gw in zip(jax.tree.leaves(algo.v_locals),
+                          jax.tree.leaves(w)):
+            d += float(jnp.sum((vw - gw[None]) ** 2))
+        dists[lam] = d
+    assert dists[10.0] < 0.05 * dists[0.0]
+
+
+def test_personalization_beats_global_under_concept_shift():
+    xs, ys = _concept_shift_clients(n_clients=4, per=48)
+    algo = Ditto(_wl(), _fed(xs, ys),
+                 DittoConfig(ditto_lambda=0.01, personal_epochs=4,
+                             **_cfg_kwargs(rounds=12)))
+    params = algo.run()
+    out = algo.evaluate_global(params)
+    assert out["personal_test_acc"] > 0.9
+    assert out["test_acc"] < 0.75  # the global model cannot fit both concepts
+    assert out["personal_test_acc"] > out["test_acc"] + 0.2
+
+
+def test_unsampled_clients_keep_their_personal_state():
+    xs, ys = _concept_shift_clients(n_clients=6)
+    algo = Ditto(_wl(), _fed(xs, ys),
+                 DittoConfig(ditto_lambda=0.1,
+                             **_cfg_kwargs(rounds=1, clients=2)))
+    algo.run()
+    from fedml_tpu.core.sampling import sample_clients
+    sampled = set(sample_clients(0, 6, 2).tolist())
+    # v was lazily initialized to the round-start globals; unsampled
+    # clients must still hold exactly that broadcast value
+    init_like = {c for c in range(6) if c not in sampled}
+    leaves = jax.tree.leaves(algo.v_locals)
+    for c in init_like:
+        for c2 in init_like:
+            for leaf in leaves:
+                np.testing.assert_array_equal(np.asarray(leaf[c]),
+                                              np.asarray(leaf[c2]))
+    # sampled clients moved away from the shared init
+    ref = init_like.pop()
+    moved = any(
+        not np.array_equal(np.asarray(leaf[c]), np.asarray(leaf[ref]))
+        for c in sampled for leaf in leaves)
+    assert moved
+
+
+def test_kill_and_resume_bit_identical(tmp_path):
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+    xs, ys = _concept_shift_clients()
+    kw = _cfg_kwargs(rounds=4)
+
+    straight = Ditto(_wl(), _fed(xs, ys), DittoConfig(ditto_lambda=0.2, **kw))
+    w_straight = straight.run()
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1)
+    first = Ditto(_wl(), _fed(xs, ys), DittoConfig(
+        ditto_lambda=0.2, **{**kw, "comm_round": 2}))
+    first.run(checkpointer=ck)
+    resumed = Ditto(_wl(), _fed(xs, ys), DittoConfig(ditto_lambda=0.2, **kw))
+    w_resumed = resumed.run(
+        checkpointer=RoundCheckpointer(str(tmp_path / "ck"), save_every=1))
+
+    for a, b in zip(jax.tree.leaves(w_straight), jax.tree.leaves(w_resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(straight.v_locals),
+                    jax.tree.leaves(resumed.v_locals)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_rejects_mesh_and_stateful():
+    xs, ys = _concept_shift_clients()
+    with pytest.raises(ValueError, match="single-chip"):
+        from fedml_tpu.parallel.mesh import make_mesh
+        Ditto(_wl(), _fed(xs, ys), DittoConfig(**_cfg_kwargs()),
+              mesh=make_mesh())
+
+    class _Stateful:
+        stateful = True
+    with pytest.raises(ValueError, match="stateful"):
+        Ditto(_Stateful(), _fed(xs, ys), DittoConfig(**_cfg_kwargs()))
+
+
+def test_personalized_eval_chunking_is_exact():
+    """eval_chunk_clients chunking must not change personalized metrics
+    (zero-padded rows carry zero masks — the shared convention)."""
+    xs, ys = _concept_shift_clients(n_clients=5)
+    runs = {}
+    for chunk in (0, 2):
+        algo = Ditto(_wl(), _fed(xs, ys),
+                     DittoConfig(ditto_lambda=0.1, eval_chunk_clients=chunk,
+                                 **_cfg_kwargs(rounds=2, clients=5)))
+        algo.run()
+        runs[chunk] = algo.evaluate_personalized()
+    assert runs[0].keys() == runs[2].keys()
+    for k in runs[0]:
+        np.testing.assert_allclose(runs[0][k], runs[2][k], rtol=1e-6)

@@ -304,3 +304,40 @@ def test_cli_profiler_trace(tmp_path):
           "--profile_dir", str(prof)] + _BASE)
     captured = list(prof.rglob("*.pb")) + list(prof.rglob("*.json.gz"))
     assert captured, f"no trace artifacts under {prof}"
+
+
+def test_flagship_partial_sink_checkpoints_curve(tmp_path):
+    """scripts/flagship_accuracy.py's PartialSink must leave the measured
+    curve on disk after EVERY eval — a wedged tunnel mid-flagship-run
+    still yields an artifact (round-4 hardening)."""
+    import importlib.util
+    import json as _json
+    import os as _os
+    spec = importlib.util.spec_from_file_location(
+        "flagship_accuracy",
+        _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "scripts", "flagship_accuracy.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+    from fedml_tpu.data.synthetic import synthetic_federated_dataset
+    from fedml_tpu.models import LogisticRegression
+    from fedml_tpu.trainer.workload import ClassificationWorkload
+
+    path = str(tmp_path / "CURVE.json.partial")
+    sink = mod.PartialSink(path, {"rounds": 4})
+    data = synthetic_federated_dataset(num_clients=6, samples_per_client=12,
+                                       sample_shape=(5,), class_num=3,
+                                       batch_size=4)
+    wl = ClassificationWorkload(LogisticRegression(5, 3), num_classes=3,
+                                grad_clip_norm=None)
+    cfg = FedAvgConfig(comm_round=4, client_num_per_round=3, epochs=1,
+                       batch_size=4, lr=0.1, frequency_of_the_test=2, seed=0)
+    FedAvg(wl, data, cfg, sink=sink).run()
+    part = _json.loads(open(path).read())
+    assert part["partial"] is True
+    curve = part["federated_curve_so_far"]
+    # evals at rounds 0, 2, 3 (every 2 + final)
+    assert [c["round"] for c in curve] == [0, 2, 3]
+    assert all(c["train_acc"] is not None for c in curve)
