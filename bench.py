@@ -19,7 +19,13 @@ way), so per-round FLOPs are extrapolated from two rounds differing only
 in local-step count, with recurrent cells unrolled in the cost twin.
 MFU = achieved FLOP/s ÷ peak; peak comes from the detected device kind
 (bf16 peak — the computation runs f32 unless BENCH_DTYPE=bfloat16, so
-reported MFU is conservative), overridable via BENCH_PEAK_TFLOPS.
+reported MFU is conservative), overridable via BENCH_PEAK_TFLOPS, and
+raised to the measured bf16 matmul throughput when that exceeds the
+table value (``bench_matmul_peak`` — the tunnel's device_kind string is
+not trustworthy evidence of the attached silicon).  Cost twins compile
+on the host CPU backend (``_twin_device_ctx``): they are never executed,
+and keeping their fresh multi-minute compiles off the tunnel removes the
+RPC most likely to wedge it.
 
 stdout carries ONE JSON line (driver contract): the femnist_cnn rounds/s
 with vs_baseline = measured sequential-torch-CPU round time ratio (the
@@ -93,6 +99,28 @@ def _compiled_flops(jitted, *args) -> float:
         return 0.0
 
 
+def _twin_device_ctx():
+    """Context that places the FLOPs cost twins on the host CPU backend.
+
+    Twins are only COMPILED (cost analysis), never executed, so they do
+    not need the accelerator at all — and compiling them on CPU keeps the
+    single most wedge-prone RPC off the tunnel: round 4 observed the
+    backend answer the liveness probe and then wedge inside the fresh
+    multi-minute resnet56 twin compile, killing the whole capture.  FLOP
+    counts are a property of the HLO, not the backend, and the twin
+    subtraction (F2-F1) cancels residual backend-specific overhead.
+    BENCH_TWIN_DEVICE=default restores on-device twins; falls back to the
+    default backend when no CPU backend is registered."""
+    import contextlib
+    import jax
+    if os.environ.get("BENCH_TWIN_DEVICE", "cpu") != "cpu":
+        return contextlib.nullcontext()
+    try:
+        return jax.default_device(jax.local_devices(backend="cpu")[0])
+    except Exception:
+        return contextlib.nullcontext()
+
+
 def _honest_flops(model, classes, lr, epochs, batch_size, xs, ys,
                   clients_per_round, workload=None):
     """Per-round FLOPs that count every local step: (flops, total_steps).
@@ -124,13 +152,14 @@ def _honest_flops(model, classes, lr, epochs, batch_size, xs, ys,
             reps = max(1, -(-need // len(x)))
             xs_t.append(np.concatenate([x] * reps)[:need])
             ys_t.append(np.concatenate([y] * reps)[:need])
-        step, params, stacked = _build_step(
-            model, classes, lr, 1, batch_size, xs_t, ys_t,
-            workload=workload, scan_unroll=nb)
-        cohort = gather_cohort(stacked, np.arange(clients_per_round),
-                               pad_to=clients_per_round)
-        _beat()  # each unrolled twin is its own (long) compile RPC
-        return _compiled_flops(step, params, cohort, jax.random.key(0))
+        with _twin_device_ctx():
+            step, params, stacked = _build_step(
+                model, classes, lr, 1, batch_size, xs_t, ys_t,
+                workload=workload, scan_unroll=nb)
+            cohort = gather_cohort(stacked, np.arange(clients_per_round),
+                                   pad_to=clients_per_round)
+            _beat()  # each unrolled twin is its own (long) compile
+            return _compiled_flops(step, params, cohort, jax.random.key(0))
 
     f1, f2 = f_for(1), f_for(2)
     total_steps = epochs * max(1, -(-max(len(x) for x in xs) // batch_size))
@@ -170,12 +199,14 @@ def _rnn_round_flops(dtype, clients_per_round, n_steps, seq_len=80,
         wl = NWPWorkload(
             RNNOriginalFedAvg(vocab_size=vocab, dtype=dtype, unroll=t),
             compute_dtype=dtype)
-        step, params, stacked = _build_step(
-            None, vocab, 0.8, 1, batch, xs, ys, workload=wl, scan_unroll=nb)
-        cohort = gather_cohort(stacked, np.arange(clients_per_round),
-                               pad_to=clients_per_round)
-        _beat()  # each unrolled twin is its own (long) compile RPC
-        return _compiled_flops(step, params, cohort, jax.random.key(0))
+        with _twin_device_ctx():
+            step, params, stacked = _build_step(
+                None, vocab, 0.8, 1, batch, xs, ys, workload=wl,
+                scan_unroll=nb)
+            cohort = gather_cohort(stacked, np.arange(clients_per_round),
+                                   pad_to=clients_per_round)
+            _beat()  # each unrolled twin is its own (long) compile
+            return _compiled_flops(step, params, cohort, jax.random.key(0))
 
     a, b, c = f_at(1, t_lo), f_at(2, t_lo), f_at(1, t_hi)
     per_token = max(c - a, 0.0) / (t_hi - t_lo)
@@ -567,6 +598,42 @@ def bench_robust_backends(rounds, clients_per_round=10):
     return out
 
 
+def bench_matmul_peak(n=4096, iters=24):
+    """Empirical MXU throughput floor: achieved TF/s on a chained dense
+    [n,n]x[n,n] matmul, bf16 and f32.
+
+    Round-4 motivation: with the honest per-trip FLOPs accounting in
+    place, the femnist configs still read MFU > 1.0 against the
+    device_kind table peak ("TPU v5 lite" -> 197 TF/s bf16), and a hand
+    count of the CNN's conv/fc MACs CONFIRMS the per-round FLOPs number
+    — so the peak assumption, not the accounting, is what's broken (the
+    tunnel's device_kind string is not trustworthy evidence of the
+    attached silicon).  A plain matmul can't exceed the chip's real peak,
+    so its achieved rate is a hard lower bound; when it beats the table
+    value, MFU is quoted against it instead."""
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    rng = np.random.RandomState(0)
+    # ~N(0,1) columns keep the chained product's scale stable (no
+    # overflow-to-inf values in the timing loop)
+    b0 = (rng.randn(n, n) / np.sqrt(n)).astype(np.float32)
+    for name, dt in (("bf16", jnp.bfloat16), ("f32", jnp.float32)):
+        a = jnp.asarray(rng.randn(n, n).astype(np.float32), dtype=dt)
+        b = jnp.asarray(b0, dtype=dt)
+        f = jax.jit(lambda x, y: x @ y)
+        r = f(a, b)
+        jax.block_until_ready(r)
+        _beat()
+        t0 = _now()
+        for _ in range(iters):
+            r = f(r, b)
+        jax.block_until_ready(r)
+        out[name] = 2.0 * n ** 3 * iters / (_now() - t0) / 1e12
+    return out
+
+
 def bench_torch_baseline(clients_per_round=10, batch_size=20):
     """The reference's standalone simulator loop (sequential clients,
     fedavg_api.py:52-66) in torch on this host's CPU — an architectural
@@ -806,6 +873,26 @@ def main():
     torch_s = bench_torch_baseline()
     _WATCH["torch_s"] = torch_s
     details["torch_cpu_sequential_round_s"] = torch_s
+
+    # 0b) empirical peak: a plain matmul's achieved TF/s bounds the real
+    # chip peak from below; when it exceeds the device_kind table value
+    # (untrustworthy through the tunnel), MFU is quoted against it
+    peak_src = ("BENCH_PEAK_TFLOPS env override"
+                if os.environ.get("BENCH_PEAK_TFLOPS")
+                else "device_kind table")
+    if not on_cpu:
+        _beat("matmul peak probe")
+        mm = bench_matmul_peak()
+        details["measured_matmul_tflops"] = mm
+        # an explicit BENCH_PEAK_TFLOPS pins the MFU denominator; only the
+        # untrusted device_kind table value gets raised by measurement
+        if (mm["bf16"] > PEAK_TFLOPS
+                and not os.environ.get("BENCH_PEAK_TFLOPS")):
+            PEAK_TFLOPS = mm["bf16"]
+            peak_src = ("measured bf16 matmul throughput (exceeds the "
+                        "device_kind table peak — kind string untrusted)")
+    details["peak_tflops_used"] = PEAK_TFLOPS
+    details["peak_tflops_source"] = peak_src
 
     # 1) cross-device headline
     _beat("femnist_cnn_c10 (honest-FLOPs twins + device rounds)")

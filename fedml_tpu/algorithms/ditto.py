@@ -17,9 +17,10 @@ Round structure (Algorithm 1 of the paper, full-batch SGD solver):
                the same rng it would under plain FedAvg, so the global
                trajectory is bit-identical (parity-tested);
     personal:  v_i ← v_i − η_p · (∇F_i(v_i) + λ (v_i − w^t))
-               for ``personal_epochs`` local epochs, starting from the
-               round-start global weights the first time client i is
-               sampled.  λ=0 decouples v_i into pure local training;
+               for ``personal_epochs`` local epochs.  Every v_i is
+               initialized to w^0 (the paper's Algorithm 1 init; the
+               stacked state is broadcast once, lazily, at the first
+               round).  λ=0 decouples v_i into pure local training;
                λ→∞ pins v_i to the global stream.
 
 Eval: ``evaluate_personalized`` scores each client's OWN model on its
@@ -36,7 +37,10 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.algorithms.fedavg import (FedAvg, FedAvgConfig,
+                                         gather_client_rows,
+                                         scatter_client_rows,
+                                         zeros_client_state)
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.trainer.workload import Workload
 
@@ -168,18 +172,11 @@ class Ditto(FedAvg):
         ids = sample_clients(self._round_counter, self.data.client_num,
                              self.cfg.client_num_per_round)
         self._round_counter += 1
-        m = cohort["num_samples"].shape[0]
-        padded = jnp.zeros(m, jnp.int32).at[:len(ids)].set(
-            jnp.asarray(ids, jnp.int32))
-        v_cohort = jax.tree.map(lambda v: jnp.take(v, padded, axis=0),
-                                self.v_locals)
+        v_cohort = gather_client_rows(self.v_locals, ids,
+                                      cohort["num_samples"].shape[0])
         p_rng = jax.random.fold_in(rng, _PERSONAL_STREAM)
         new_v = self._personal_round(v_cohort, params, cohort, p_rng)
-        live_n = len(ids)
-        self.v_locals = jax.tree.map(
-            lambda v, nv: v.at[jnp.asarray(ids, jnp.int32)].set(
-                nv[:live_n]),
-            self.v_locals, new_v)
+        self.v_locals = scatter_client_rows(self.v_locals, ids, new_v)
         return new_params, aux
 
     # -- personalized evaluation ------------------------------------------
@@ -191,11 +188,16 @@ class Ditto(FedAvg):
         if self.v_locals is None:
             return {}
         out: Dict[str, float] = {}
-        chunk = self.cfg.eval_chunk_clients or self.data.client_num
         for split, stacked in (("train", self.data.train),
                                ("test", self.data.test)):
             if stacked is None:
                 continue
+            # never pad ABOVE the corpus size: a 3-client run with the
+            # default chunk=1024 would otherwise stack 1024 zero-padded
+            # copies of the model params per eval (evaluate_global's gate
+            # is `n_clients > chunk`; this is the same rule)
+            n_clients = stacked["num_samples"].shape[0]
+            chunk = min(self.cfg.eval_chunk_clients or n_clients, n_clients)
             from fedml_tpu.algorithms.fedavg import sweep_eval_chunks
             from fedml_tpu.parallel.cohort import pad_clients
 
@@ -226,9 +228,8 @@ class Ditto(FedAvg):
                 "round_counter": self._round_counter}
 
     def _extra_state_template(self, params):
-        return {"v_locals": jax.tree.map(
-            lambda x: jnp.zeros((self.data.client_num,) + x.shape,
-                                x.dtype), params),
+        return {"v_locals": zeros_client_state(params,
+                                               self.data.client_num),
                 "round_counter": 0}
 
     def _load_extra_state(self, extra) -> None:

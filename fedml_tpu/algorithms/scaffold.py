@@ -28,7 +28,10 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.algorithms.fedavg import (FedAvg, FedAvgConfig,
+                                         gather_client_rows,
+                                         scatter_client_rows,
+                                         zeros_client_state)
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.trainer.workload import Workload
 
@@ -169,26 +172,18 @@ class Scaffold(FedAvg):
     def _stateful_step(self, params, cohort, rng):
         if self.c_global is None:
             self.c_global = jax.tree.map(jnp.zeros_like, params)
-            self.c_locals = jax.tree.map(
-                lambda x: jnp.zeros((self.data.client_num,) + x.shape,
-                                    x.dtype), params)
+            self.c_locals = zeros_client_state(params, self.data.client_num)
         ids = sample_clients(self._round_counter, self.data.client_num,
                              self.cfg.client_num_per_round)
         self._round_counter += 1
-        m = cohort["num_samples"].shape[0]
-        padded = jnp.zeros(m, jnp.int32).at[:len(ids)].set(
-            jnp.asarray(ids, jnp.int32))
-        c_cohort = jax.tree.map(lambda c: jnp.take(c, padded, axis=0),
-                                self.c_locals)
+        c_cohort = gather_client_rows(self.c_locals, ids,
+                                      cohort["num_samples"].shape[0])
         params, new_c_cohort, self.c_global = self._round_step(
             params, cohort, rng, self.c_global, c_cohort)
-        # scatter updated control variates back (live slots only — the
-        # round_step froze padded ones, but a padded slot aliases client 0)
-        live_n = len(ids)
-        self.c_locals = jax.tree.map(
-            lambda c, nc: c.at[jnp.asarray(ids, jnp.int32)].set(
-                nc[:live_n]),
-            self.c_locals, new_c_cohort)
+        # the round_step froze padded slots; the scatter writes live rows
+        # only, so the aliased client-0 slot cannot clobber real state
+        self.c_locals = scatter_client_rows(self.c_locals, ids,
+                                            new_c_cohort)
         return params, {}
 
     # control-variate state rides the round checkpoint
@@ -198,9 +193,8 @@ class Scaffold(FedAvg):
 
     def _extra_state_template(self, params):
         return {"c_global": jax.tree.map(jnp.zeros_like, params),
-                "c_locals": jax.tree.map(
-                    lambda x: jnp.zeros((self.data.client_num,) + x.shape,
-                                        x.dtype), params),
+                "c_locals": zeros_client_state(params,
+                                               self.data.client_num),
                 "round_counter": 0}
 
     def _load_extra_state(self, extra) -> None:

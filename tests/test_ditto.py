@@ -194,3 +194,24 @@ def test_personalized_eval_chunking_is_exact():
     assert runs[0].keys() == runs[2].keys()
     for k in runs[0]:
         np.testing.assert_allclose(runs[0][k], runs[2][k], rtol=1e-6)
+
+
+def test_personalized_eval_never_pads_above_corpus():
+    """The DEFAULT chunk (1024) on a small run must not stack 1024
+    zero-padded copies of the params per eval — chunk is capped at the
+    split's client count (evaluate_global's `n_clients > chunk` rule)."""
+    xs, ys = _concept_shift_clients(n_clients=3)
+    algo = Ditto(_wl(), _fed(xs, ys),
+                 DittoConfig(ditto_lambda=0.1,
+                             **_cfg_kwargs(rounds=1, clients=3)))
+    algo.run()
+    seen = []
+    orig = algo._personal_eval
+
+    def spy(vs, data):
+        seen.append(jax.tree.leaves(vs)[0].shape[0])
+        return orig(vs, data)
+
+    algo._personal_eval = spy
+    metrics = algo.evaluate_personalized()
+    assert metrics and seen and max(seen) == 3
