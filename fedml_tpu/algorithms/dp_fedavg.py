@@ -1,0 +1,174 @@
+"""DP-FedAvg (McMahan et al. 2018, arXiv:1710.06963) — user-level
+differential privacy with a REAL accountant.
+
+The reference's privacy story is "weak DP": per-update clip + Gaussian
+noise with a bare stddev knob and no accounting whatsoever
+(``fedml_core/robustness/robust_aggregation.py:38-55``; our parity port
+is ``--algo fedavg_robust --defense weak_dp``).  This algorithm is the
+honest version:
+
+* per-client update Δ_k = θ_k − θ^t clipped to L2 norm ``dp_clip`` (S);
+* UNIFORM average over the m live cohort slots — sample-weighted
+  averaging (FedAvg's default) has unbounded per-user sensitivity and
+  would void the guarantee, so it is deliberately NOT used here;
+* one Gaussian draw with std ``S·z/m`` added to the averaged update
+  (central model: the server is trusted, the released model sequence is
+  what's protected), drawn from a dedicated fold_in stream so the
+  training rng chain is untouched;
+* SECRET cohort sampling: amplification-by-subsampling assumes the
+  adversary cannot tell which users joined a round, so the framework's
+  default deterministic, PUBLIC sampling chain
+  (core/sampling.sample_clients — the reference's seeded
+  client_sampling, identical across all runs) would void the theorem.
+  ``_sample_round`` is overridden to draw each cohort from the run rng
+  (without replacement; full participation falls back to the exact
+  arange, keeping the FedAvg parity case bit-identical);
+* an RDP moments accountant (core/privacy.py) composes the subsampled
+  Gaussian over rounds with q = cohort/N and reports ε at ``dp_delta``
+  in every eval row — the number the reference never computes.
+
+The whole defended round stays ONE jit: the per-client clip, the noisy
+uniform mean, and the single central noise draw are fused into the
+custom ``needs_global`` aggregate (``make_dp_aggregate``) that replaces
+the cohort engine's default weighted mean (parallel/cohort.py) — NOT the
+``transform_update`` hook, which transforms each client's params but
+cannot change the weighting or add one shared draw.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.core.privacy import RdpAccountant
+from fedml_tpu.parallel.cohort import make_cohort_step
+from fedml_tpu.trainer.local_sgd import make_local_trainer
+from fedml_tpu.trainer.workload import make_client_optimizer
+
+# distinct fold_in streams: the DP noise draw ("DPNZ") and the secret
+# cohort-sampling chain ("DPSG")
+_NOISE_STREAM = 0x44504E5A
+_SAMPLE_STREAM = 0x44505347
+
+
+@dataclasses.dataclass
+class DPFedAvgConfig(FedAvgConfig):
+    dp_clip: float = 1.0             # S: per-user update L2 bound
+    dp_noise_multiplier: float = 1.0  # z: noise std = S·z/m on the mean
+    dp_delta: float = 1e-5           # δ for the reported ε
+
+
+def make_dp_aggregate(clip: float, noise_multiplier: float):
+    """``aggregate(stacked, weights, global_params, rng)`` — clip each
+    client's update, uniform-mean the live slots, add one central
+    Gaussian draw calibrated to sensitivity S/m."""
+
+    def aggregate(stacked, weights, global_params, rng):
+        live = (weights > 0).astype(jnp.float32)
+        m = jnp.maximum(jnp.sum(live), 1.0)
+        deltas = jax.tree.map(lambda y, x: y - x[None], stacked,
+                              global_params)
+        # per-client global L2 norm across the whole pytree -> [C]
+        sq = sum(jnp.sum(jnp.square(d.astype(jnp.float32)),
+                         axis=tuple(range(1, d.ndim)))
+                 for d in jax.tree.leaves(deltas))
+        scale = jnp.minimum(1.0, clip / jnp.maximum(jnp.sqrt(sq), 1e-12))
+        scale = scale * live  # padded slots contribute nothing
+
+        def _mean(d):
+            s = scale.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
+            return jnp.sum(d * s, axis=0) / m.astype(d.dtype)
+
+        mean_delta = jax.tree.map(_mean, deltas)
+        nrng = jax.random.fold_in(rng, _NOISE_STREAM)
+        leaves, treedef = jax.tree.flatten(mean_delta)
+        keys = jax.random.split(nrng, len(leaves))
+        std = clip * noise_multiplier / m
+        noisy = [d + (std * jax.random.normal(k, d.shape)).astype(d.dtype)
+                 for d, k in zip(leaves, keys)]
+        mean_delta = jax.tree.unflatten(treedef, noisy)
+        return jax.tree.map(lambda x, d: x + d, global_params, mean_delta)
+
+    aggregate.needs_global = True
+    return aggregate
+
+
+class DPFedAvg(FedAvg):
+    def __init__(self, workload, data, config: DPFedAvgConfig, mesh=None,
+                 sink=None):
+        if mesh is not None:
+            raise ValueError(
+                "dp_fedavg adds ONE central noise draw after a cohort-wide "
+                "clip; the mesh path's per-shard psum aggregate would draw "
+                "per-device noise — run single-chip")
+        if config.dp_clip <= 0.0:
+            raise ValueError("dp_clip must be > 0")
+        if config.dp_noise_multiplier < 0.0:
+            raise ValueError("dp_noise_multiplier must be >= 0 "
+                             "(0 = clipped, non-private FedAvg)")
+        super().__init__(workload, data, config, mesh=mesh, sink=sink)
+        cfg = config
+        opt = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
+        local_train = make_local_trainer(workload, opt, cfg.epochs)
+        self.cohort_step = make_cohort_step(
+            local_train,
+            aggregate=make_dp_aggregate(cfg.dp_clip,
+                                        cfg.dp_noise_multiplier),
+            client_axis=cfg.client_axis)
+        # Poisson-approximated q for fixed-size cohorts (core/privacy.py
+        # caveat); z=0 yields eps=inf — reported honestly, not hidden
+        q = min(cfg.client_num_per_round, data.client_num) \
+            / data.client_num
+        self.accountant = RdpAccountant(q, cfg.dp_noise_multiplier,
+                                        cfg.dp_delta)
+        base_step = self.cohort_step
+
+        def counted_step(params, cohort, rng):
+            out = base_step(params, cohort, rng)
+            self.accountant.step()
+            return out
+
+        self.cohort_step = counted_step
+
+    def run(self, params=None, rng=None, checkpointer=None):
+        self.accountant.steps = 0
+        # secret sampling chain, derived from the run rng BEFORE the base
+        # loop consumes it (resume replays the same rng -> same cohorts)
+        rng = rng if rng is not None else jax.random.key(self.cfg.seed)
+        self._sample_base = jax.random.fold_in(rng, _SAMPLE_STREAM)
+        return super().run(params=params, rng=rng,
+                           checkpointer=checkpointer)
+
+    def _sample_round(self, round_idx: int):
+        """SECRET cohorts (see module docstring): drawn without
+        replacement from the run rng, not the public round-index chain.
+        Full participation needs no subsampling — the exact arange keeps
+        the z=0 FedAvg parity case bit-identical."""
+        n = self.data.client_num
+        m = min(self.cfg.client_num_per_round, n)
+        if m >= n:
+            return np.arange(n)
+        key = jax.random.fold_in(self._sample_base, round_idx)
+        return np.asarray(jax.random.choice(key, n, (m,), replace=False))
+
+    def evaluate_global(self, params) -> Dict[str, float]:
+        out = super().evaluate_global(params)
+        out["dp_epsilon"] = self.accountant.epsilon()
+        out["dp_delta"] = self.accountant.delta
+        return out
+
+    # the accountant's round count rides the checkpoint so a resumed run
+    # keeps reporting the TOTAL privacy spent, not just the tail's
+    def _extra_state(self):
+        return {"dp_rounds": self.accountant.steps}
+
+    def _extra_state_template(self, params):
+        return {"dp_rounds": 0}
+
+    def _load_extra_state(self, extra) -> None:
+        self.accountant.steps = int(extra["dp_rounds"])

@@ -182,6 +182,16 @@ class FedAvg:
         self._eval_cohort = cohort_eval(self.evaluate, mesh=mesh)
         self.history: List[Dict[str, Any]] = []
 
+    def _sample_round(self, round_idx: int):
+        """Cohort ids for one round — the reference's deterministic seeded
+        chain (FedAVGAggregator.client_sampling:89-97), which stateful
+        algorithms (SCAFFOLD/Ditto/FedDyn) mirror to re-derive their
+        cohort.  dp_fedavg overrides this with SECRET rng-derived sampling:
+        a public, run-independent cohort schedule voids the
+        amplification-by-subsampling assumption its accountant relies on."""
+        return sample_clients(round_idx, self.data.client_num,
+                              self.cfg.client_num_per_round)
+
     def init_params(self, rng: Optional[jax.Array] = None):
         rng = rng if rng is not None else jax.random.key(self.cfg.seed)
         sample = jax.tree.map(lambda v: v[0, 0], {
@@ -251,8 +261,7 @@ class FedAvg:
             return self._run_scanned(params, rng, start_round)
         for round_idx in range(start_round, cfg.comm_round):
             t0 = time.time()
-            ids = sample_clients(round_idx, self.data.client_num,
-                                 cfg.client_num_per_round)
+            ids = self._sample_round(round_idx)
             rng, round_rng = jax.random.split(rng)
             if use_device_data:
                 m = cfg.client_num_per_round
@@ -314,7 +323,7 @@ class FedAvg:
             ids = np.zeros((K, m), np.int32)
             live = np.zeros((K, m), np.float32)
             for k in range(K):
-                r_ids = sample_clients(round_idx + k, self.data.client_num, m)
+                r_ids = self._sample_round(round_idx + k)
                 ids[k, :len(r_ids)] = r_ids
                 live[k, :len(r_ids)] = 1.0
             rng, chunk_rng = jax.random.split(rng)

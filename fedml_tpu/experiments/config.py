@@ -54,6 +54,10 @@ class ExperimentConfig:
     ditto_lambda: float = 0.1            # Ditto: personalization pull λ
     personal_lr: float = 0.0             # Ditto: 0 → inherit --lr
     personal_epochs: int = 0             # Ditto: 0 → inherit --epochs
+    feddyn_alpha: float = 0.01           # FedDyn: dynamic-reg strength α
+    dp_clip: float = 1.0                 # dp_fedavg: per-user L2 bound S
+    dp_noise_multiplier: float = 1.0     # dp_fedavg: z (std = S·z/m)
+    dp_delta: float = 1e-5               # dp_fedavg: δ for reported ε
     gmf: float = 0.0                     # FedNova global momentum factor
     norm_bound: float = 5.0              # robust: clip threshold
     stddev: float = 0.025                # robust: weak-DP noise

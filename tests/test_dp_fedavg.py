@@ -1,0 +1,210 @@
+"""DP-FedAvg with RDP accounting (algorithms/dp_fedavg.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms import FedAvg, FedAvgConfig
+from fedml_tpu.algorithms.dp_fedavg import (DPFedAvg, DPFedAvgConfig,
+                                            make_dp_aggregate)
+from fedml_tpu.data.stacking import FederatedData, stack_client_data
+from fedml_tpu.models import LogisticRegression
+from fedml_tpu.trainer.workload import ClassificationWorkload
+
+
+def _clients(n_clients=4, dim=6, per=24, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = [rng.randn(per, dim).astype(np.float32) for _ in range(n_clients)]
+    ys = [rng.randint(0, 4, per).astype(np.int32) for _ in range(n_clients)]
+    return xs, ys
+
+
+def _fed(xs, ys, batch=8, classes=4):
+    train = stack_client_data(xs, ys, batch)
+    return FederatedData(client_num=len(xs), class_num=classes,
+                         train=train, test=train)
+
+
+@pytest.fixture(scope="module")
+def workload():
+    return ClassificationWorkload(LogisticRegression(6, 4), num_classes=4,
+                                  grad_clip_norm=None)
+
+
+def test_no_noise_huge_clip_equals_fedavg_on_equal_shards(workload):
+    """z=0 and a clip far above any update norm leaves only the UNIFORM
+    mean — which equals FedAvg's sample-weighted mean exactly when every
+    client holds the same number of samples."""
+    xs, ys = _clients()
+    data = _fed(xs, ys)
+    cfg = dict(comm_round=2, client_num_per_round=4, epochs=2,
+               batch_size=8, lr=0.1, frequency_of_the_test=100)
+    fa = FedAvg(workload, data, FedAvgConfig(**cfg))
+    dp = DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_clip=1e9, dp_noise_multiplier=0.0, **cfg))
+    p0 = fa.init_params(jax.random.key(3))
+    out_fa = fa.run(params=jax.tree.map(jnp.copy, p0),
+                    rng=jax.random.key(4))
+    out_dp = dp.run(params=jax.tree.map(jnp.copy, p0),
+                    rng=jax.random.key(4))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-6),
+                 out_fa, out_dp)
+
+
+def test_clip_bounds_the_round_update(workload):
+    """With z=0 the server update is a mean of per-client deltas each
+    clipped to S, so its global L2 norm is <= S."""
+    xs, ys = _clients()
+    data = _fed(xs, ys)
+    clip = 0.05
+    cfg = dict(comm_round=1, client_num_per_round=4, epochs=3,
+               batch_size=8, lr=1.0, frequency_of_the_test=100)
+    dp = DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_clip=clip, dp_noise_multiplier=0.0, **cfg))
+    p0 = dp.init_params(jax.random.key(0))
+    out = dp.run(params=jax.tree.map(jnp.copy, p0), rng=jax.random.key(1))
+    delta_sq = sum(float(jnp.sum(jnp.square(a - b)))
+                   for a, b in zip(jax.tree.leaves(out),
+                                   jax.tree.leaves(p0)))
+    assert np.sqrt(delta_sq) <= clip + 1e-6
+    # sanity: the unclipped update would have exceeded the bound
+    fa = FedAvg(workload, data, FedAvgConfig(**cfg))
+    out_fa = fa.run(params=jax.tree.map(jnp.copy, p0),
+                    rng=jax.random.key(1))
+    fa_sq = sum(float(jnp.sum(jnp.square(a - b)))
+                for a, b in zip(jax.tree.leaves(out_fa),
+                                jax.tree.leaves(p0)))
+    assert np.sqrt(fa_sq) > clip
+
+
+def test_noise_is_deterministic_per_seed_and_fresh_per_round(workload):
+    xs, ys = _clients()
+    data = _fed(xs, ys)
+    cfg = dict(comm_round=1, client_num_per_round=4, epochs=1,
+               batch_size=8, lr=0.1, frequency_of_the_test=100)
+
+    def run_once(key):
+        dp = DPFedAvg(workload, data, DPFedAvgConfig(
+            dp_clip=0.5, dp_noise_multiplier=1.0, **cfg))
+        p0 = dp.init_params(jax.random.key(9))
+        return dp.run(params=jax.tree.map(jnp.copy, p0), rng=key)
+
+    a, b = run_once(jax.random.key(5)), run_once(jax.random.key(5))
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(x, y), a, b)
+    c = run_once(jax.random.key(6))
+    assert any(not np.array_equal(x, y)
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(c)))
+
+
+def test_aggregate_ignores_padded_slots():
+    """Padded (weight-0) cohort slots must not shift the uniform mean."""
+    agg = make_dp_aggregate(clip=10.0, noise_multiplier=0.0)
+    g = {"w": jnp.zeros((3,))}
+    stacked = {"w": jnp.stack([jnp.ones(3), 3 * jnp.ones(3),
+                               jnp.full(3, 99.0)])}
+    out = agg(stacked, jnp.asarray([4.0, 4.0, 0.0]), g, jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               np.full(3, 2.0), atol=1e-6)
+
+
+def test_epsilon_reported_and_grows(workload):
+    xs, ys = _clients()
+    data = _fed(xs, ys)
+    cfg = dict(comm_round=4, client_num_per_round=2, epochs=1,
+               batch_size=8, lr=0.1, frequency_of_the_test=1)
+    dp = DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_clip=0.5, dp_noise_multiplier=1.0, dp_delta=1e-5, **cfg))
+    dp.run(rng=jax.random.key(0))
+    eps = [h["dp_epsilon"] for h in dp.history]
+    assert all(np.isfinite(e) and e > 0 for e in eps)
+    assert all(b > a for a, b in zip(eps, eps[1:]))
+    assert dp.history[-1]["dp_delta"] == 1e-5
+    # z=0 is honestly non-private
+    dp0 = DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_clip=0.5, dp_noise_multiplier=0.0,
+        **{**cfg, "comm_round": 1}))
+    dp0.run(rng=jax.random.key(0))
+    assert np.isinf(dp0.history[-1]["dp_epsilon"])
+
+
+def test_resume_keeps_total_privacy_spent(workload, tmp_path):
+    """A kill-and-resume run must report ε for ALL rounds ever run, not
+    just the post-resume tail."""
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+    xs, ys = _clients()
+    data = _fed(xs, ys)
+    cfg = dict(comm_round=4, client_num_per_round=2, epochs=1,
+               batch_size=8, lr=0.1, frequency_of_the_test=100)
+    full = DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_clip=0.5, dp_noise_multiplier=1.0, **cfg))
+    full.run(rng=jax.random.key(0))
+    eps_full = full.accountant.epsilon()
+
+    half = DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_clip=0.5, dp_noise_multiplier=1.0,
+        **{**cfg, "comm_round": 2}))
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1)
+    half.run(rng=jax.random.key(0), checkpointer=ck)
+    resumed = DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_clip=0.5, dp_noise_multiplier=1.0, **cfg))
+    resumed.run(rng=jax.random.key(0),
+                checkpointer=RoundCheckpointer(str(tmp_path / "ck"),
+                                               save_every=1))
+    assert resumed.accountant.epsilon() == pytest.approx(eps_full)
+
+
+def test_rejects_bad_configs(workload):
+    xs, ys = _clients()
+    data = _fed(xs, ys)
+    base = dict(comm_round=1, client_num_per_round=2, epochs=1,
+                batch_size=8, lr=0.1)
+    with pytest.raises(ValueError, match="dp_clip"):
+        DPFedAvg(workload, data, DPFedAvgConfig(dp_clip=0.0, **base))
+    with pytest.raises(ValueError, match="noise_multiplier"):
+        DPFedAvg(workload, data,
+                 DPFedAvgConfig(dp_noise_multiplier=-1.0, **base))
+    from fedml_tpu.parallel.mesh import make_mesh
+    with pytest.raises(ValueError, match="single-chip"):
+        DPFedAvg(workload, data, DPFedAvgConfig(**base), mesh=make_mesh())
+
+
+def test_cli_dp_fedavg_end_to_end():
+    from fedml_tpu.experiments.main import main
+    summary = main(["--algo", "dp_fedavg", "--model", "lr", "--dataset",
+                    "mnist", "--client_num_in_total", "8",
+                    "--client_num_per_round", "4", "--comm_round", "2",
+                    "--frequency_of_the_test", "1", "--batch_size", "4",
+                    "--dp_noise_multiplier", "1.0", "--dp_clip", "0.5",
+                    "--log_stdout", "false"])
+    assert np.isfinite(summary["train_loss"])
+    assert summary["dp_epsilon"] > 0
+
+
+def test_cohort_sampling_is_secret_not_the_public_chain(workload):
+    """Amplification soundness: with m < N the dp cohorts must come from
+    the run rng (secret), NOT the framework's public round-index chain —
+    and must be reproducible given the same rng."""
+    from fedml_tpu.core.sampling import sample_clients
+    xs, ys = _clients(n_clients=8)
+    data = _fed(xs, ys)
+    cfg = dict(comm_round=6, client_num_per_round=2, epochs=1,
+               batch_size=8, lr=0.1, frequency_of_the_test=100)
+
+    def cohorts(key):
+        dp = DPFedAvg(workload, data, DPFedAvgConfig(
+            dp_clip=0.5, dp_noise_multiplier=1.0, **cfg))
+        dp.run(rng=key)
+        return [tuple(sorted(dp._sample_round(i).tolist()))
+                for i in range(6)]
+
+    a = cohorts(jax.random.key(0))
+    assert a == cohorts(jax.random.key(0))  # deterministic per run rng
+    assert a != cohorts(jax.random.key(1))  # but rng-dependent (secret)
+    public = [tuple(sorted(sample_clients(i, data.client_num, 2).tolist()))
+              for i in range(6)]
+    assert a != public
+    # every cohort is m distinct, in-range clients
+    for c in a:
+        assert len(c) == 2 and len(set(c)) == 2
+        assert all(0 <= i < data.client_num for i in c)
