@@ -41,7 +41,6 @@ from fedml_tpu.algorithms.fedavg import (FedAvg, FedAvgConfig,
                                          gather_client_rows,
                                          scatter_client_rows,
                                          zeros_client_state)
-from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.trainer.workload import Workload
 
 Pytree = Any
@@ -169,8 +168,9 @@ class Ditto(FedAvg):
                 params)
         # global stream: EXACTLY FedAvg, consuming the round rng unchanged
         new_params, aux = self._base_cohort_step(params, cohort, rng)
-        ids = sample_clients(self._round_counter, self.data.client_num,
-                             self.cfg.client_num_per_round)
+        # THE loop's own sampling hook (not sample_clients directly), so a
+        # subclass overriding _sample_round cannot desync the state mirror
+        ids = self._sample_round(self._round_counter)
         self._round_counter += 1
         v_cohort = gather_client_rows(self.v_locals, ids,
                                       cohort["num_samples"].shape[0])

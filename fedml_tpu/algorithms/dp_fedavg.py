@@ -47,8 +47,6 @@ import numpy as np
 from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
 from fedml_tpu.core.privacy import RdpAccountant
 from fedml_tpu.parallel.cohort import make_cohort_step
-from fedml_tpu.trainer.local_sgd import make_local_trainer
-from fedml_tpu.trainer.workload import make_client_optimizer
 
 # distinct fold_in streams: the DP noise draw ("DPNZ") and the secret
 # cohort-sampling chain ("DPSG")
@@ -113,10 +111,10 @@ class DPFedAvg(FedAvg):
                              "(0 = clipped, non-private FedAvg)")
         super().__init__(workload, data, config, mesh=mesh, sink=sink)
         cfg = config
-        opt = make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd)
-        local_train = make_local_trainer(workload, opt, cfg.epochs)
+        # the base class already built the local trainer; only the
+        # aggregate differs (clipped uniform mean + central noise)
         self.cohort_step = make_cohort_step(
-            local_train,
+            self._local_train,
             aggregate=make_dp_aggregate(cfg.dp_clip,
                                         cfg.dp_noise_multiplier),
             client_axis=cfg.client_axis)

@@ -32,7 +32,6 @@ from fedml_tpu.algorithms.fedavg import (FedAvg, FedAvgConfig,
                                          gather_client_rows,
                                          scatter_client_rows,
                                          zeros_client_state)
-from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.trainer.workload import Workload
 
 Pytree = Any
@@ -173,8 +172,9 @@ class Scaffold(FedAvg):
         if self.c_global is None:
             self.c_global = jax.tree.map(jnp.zeros_like, params)
             self.c_locals = zeros_client_state(params, self.data.client_num)
-        ids = sample_clients(self._round_counter, self.data.client_num,
-                             self.cfg.client_num_per_round)
+        # THE loop's own sampling hook (not sample_clients directly), so a
+        # subclass overriding _sample_round cannot desync the state mirror
+        ids = self._sample_round(self._round_counter)
         self._round_counter += 1
         c_cohort = gather_client_rows(self.c_locals, ids,
                                       cohort["num_samples"].shape[0])

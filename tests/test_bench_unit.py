@@ -34,22 +34,94 @@ def test_peak_env_override_wins(monkeypatch):
     assert bench._peak_for_device(_FakeDev("TPU v6e")) == 123.5
 
 
-def test_emit_skipped_contract(capsys):
-    """The wedged-tunnel line must carry skipped + stale + the committed
-    TPU figures, and MUST NOT carry vs_baseline (the round-2 failure was
-    a CPU fallback dressed as a cross-platform comparison)."""
+def _emit_skipped_line(tmp_path, monkeypatch, capsys, files):
+    monkeypatch.setattr(bench, "_repo_path",
+                        lambda name: str(tmp_path / name))
+    for name, content in files.items():
+        (tmp_path / name).write_text(json.dumps(content))
     bench._emit_skipped()
-    line = json.loads(capsys.readouterr().out.strip())
+    return json.loads(capsys.readouterr().out.strip())
+
+
+def test_emit_skipped_stale_fallback(tmp_path, monkeypatch, capsys):
+    """With only a clean BENCH_DETAILS.json, the wedged-tunnel line must
+    carry skipped + stale + those figures, and MUST NOT carry vs_baseline
+    (the round-2 failure was a CPU fallback dressed as a cross-platform
+    comparison)."""
+    line = _emit_skipped_line(tmp_path, monkeypatch, capsys, {
+        "BENCH_DETAILS.json": {
+            "platform": "tpu",
+            "configs": {"femnist_cnn_c10": {"rounds_per_s": 100.0},
+                        "femnist_cnn_c10_scan20": {"rounds_per_s": 300.0}}}})
     assert line["stale"] is True
     assert "unreachable" in line["skipped"]
     assert "vs_baseline" not in line
     assert line["metric"] == "fedavg_round_time_femnist_cnn"
-    # sourced from the committed clean-TPU BENCH_DETAILS.json
     assert line["last_good_tpu"]["platform"] == "tpu"
-    assert line["value"] == pytest.approx(
-        max(line["last_good_tpu"]["rounds_per_s_dispatch"],
-            line["last_good_tpu"]["rounds_per_s_scan20"]))
+    assert line["value"] == pytest.approx(300.0)
     assert "STALE" in line["last_good_tpu"]["source"]
+
+
+def test_emit_skipped_prefers_newer_committed_partial(tmp_path, monkeypatch,
+                                                      capsys):
+    """A committed BENCH_PARTIAL_LATEST.json NEWER than the clean artifact
+    (real on-chip measurements from a partial capture) must beat it —
+    labeled partial, NOT stale."""
+    line = _emit_skipped_line(tmp_path, monkeypatch, capsys, {
+        "BENCH_DETAILS.json": {
+            "platform": "tpu", "captured_at": 1000.0,
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 300.0}}},
+        "BENCH_PARTIAL_LATEST.json": {
+            "platform": "tpu", "captured_at": 2000.0,
+            "configs": {"femnist_cnn_c10": {"rounds_per_s": 150.0},
+                        "femnist_cnn_c10_scan20": {"rounds_per_s": 400.0}}}})
+    assert line["stale"] is False
+    assert line["partial"] is True
+    assert line["value"] == pytest.approx(400.0)
+    assert "REAL on-chip" in line["partial_capture"]["source"]
+    assert "last_good_tpu" not in line
+    assert "vs_baseline" not in line
+
+
+def test_emit_skipped_old_partial_loses_to_newer_clean(tmp_path,
+                                                       monkeypatch, capsys):
+    """An OLD committed partial (e.g. from a fresh checkout where a later
+    clean capture superseded it) must NOT outrank the newer clean
+    artifact — the round-3 dishonest-labeling failure mode."""
+    line = _emit_skipped_line(tmp_path, monkeypatch, capsys, {
+        "BENCH_DETAILS.json": {
+            "platform": "tpu", "captured_at": 2000.0,
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 300.0}}},
+        "BENCH_PARTIAL_LATEST.json": {
+            "platform": "tpu", "captured_at": 1000.0,
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 400.0}}}})
+    assert line["stale"] is True
+    assert "partial_capture" not in line
+    assert line["value"] == pytest.approx(300.0)
+    # a clean artifact with no stamp (legacy) counts as older than a
+    # stamped partial
+    line2 = _emit_skipped_line(tmp_path, monkeypatch, capsys, {
+        "BENCH_DETAILS.json": {
+            "platform": "tpu",
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 300.0}}},
+        "BENCH_PARTIAL_LATEST.json": {
+            "platform": "tpu", "captured_at": 1000.0,
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 400.0}}}})
+    assert line2["partial"] is True and line2["value"] == pytest.approx(400.0)
+
+
+def test_emit_skipped_ignores_cpu_partial(tmp_path, monkeypatch, capsys):
+    """A cpu-platform partial must not masquerade as TPU evidence."""
+    line = _emit_skipped_line(tmp_path, monkeypatch, capsys, {
+        "BENCH_DETAILS.json": {
+            "platform": "tpu",
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 300.0}}},
+        "BENCH_PARTIAL_LATEST.json": {
+            "platform": "cpu",
+            "configs": {"femnist_cnn_c10": {"rounds_per_s": 999.0}}}})
+    assert line["stale"] is True
+    assert line["value"] == pytest.approx(300.0)
+    assert "partial_capture" not in line
 
 
 def test_round_spread_statistics(monkeypatch):
