@@ -36,6 +36,7 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedml_tpu.algorithms.fedavg import (FedAvg, FedAvgConfig,
                                          gather_client_rows,
@@ -161,11 +162,12 @@ class Ditto(FedAvg):
 
     def _ditto_step(self, params, cohort, rng):
         if self.v_locals is None:
-            # paper init: v_i = w^0 (round-start globals on first sight)
+            # paper init: v_i = w^0, as HOST buffers (the stacked-state
+            # convention, fedavg.py — full [N, ...] state never sits in HBM)
             self.v_locals = jax.tree.map(
-                lambda x: jnp.broadcast_to(
-                    x[None], (self.data.client_num,) + x.shape).copy(),
-                params)
+                lambda x: np.broadcast_to(
+                    np.asarray(x)[None],
+                    (self.data.client_num,) + x.shape).copy(), params)
         # global stream: EXACTLY FedAvg, consuming the round rng unchanged
         new_params, aux = self._base_cohort_step(params, cohort, rng)
         # THE loop's own sampling hook (not sample_clients directly), so a
@@ -222,9 +224,12 @@ class Ditto(FedAvg):
         out.update(self.evaluate_personalized())
         return out
 
-    # personalized state rides the round checkpoint
+    # personalized state rides the round checkpoint.  The stacked buffers
+    # are SNAPSHOTTED (np.array copies): scatter_client_rows mutates them
+    # in place, so handing live references to an async checkpointer could
+    # serialize torn state mixing rows from two rounds.
     def _extra_state(self):
-        return {"v_locals": self.v_locals,
+        return {"v_locals": jax.tree.map(np.array, self.v_locals),
                 "round_counter": self._round_counter}
 
     def _extra_state_template(self, params):
@@ -233,5 +238,6 @@ class Ditto(FedAvg):
                 "round_counter": 0}
 
     def _load_extra_state(self, extra) -> None:
-        self.v_locals = extra["v_locals"]
+        # stacked state is host-resident by convention (fedavg.py)
+        self.v_locals = jax.tree.map(np.asarray, extra["v_locals"])
         self._round_counter = int(extra["round_counter"])

@@ -97,38 +97,49 @@ def sweep_eval_chunks(stacked, chunk: int, run_chunk):
 
 # -- stacked per-client persistent state -----------------------------------
 # Algorithms with per-client state that outlives a round (SCAFFOLD control
-# variates, Ditto personalized models) keep it as ONE stacked pytree
-# [client_num_in_total, ...] host-side and gather/scatter the cohort's rows
-# each round.  These three helpers are THE convention: padded cohort slots
-# alias client 0 via the zero-filled id vector, so round steps must freeze
-# padded rows (live mask) before the scatter — which writes live rows only.
+# variates, Ditto personalized models, FedDyn lambdas) keep it as ONE
+# stacked pytree [client_num_in_total, ...] of HOST numpy buffers — at
+# cross-device scale the full state cannot live in HBM (342k stackoverflow
+# clients x even a 40 KB model is ~14 GB), so only the sampled cohort's
+# rows ride to the device each round, mirroring how the DATA corpus stays
+# host/memmap-resident (data/stacking.py).  These helpers are THE
+# convention: padded cohort slots alias client 0 via the zero-filled id
+# vector, so round steps must freeze padded rows (live mask) before the
+# scatter — which writes live rows only.
 
 
 def zeros_client_state(template, client_num: int):
-    """A zeroed stacked state tree: one row per client, shaped like
-    ``template`` (checkpoint templates use this too)."""
+    """A zeroed stacked state tree: one HOST (numpy) row per client,
+    shaped like ``template`` (checkpoint templates use this too)."""
     return jax.tree.map(
-        lambda x: jax.numpy.zeros((client_num,) + x.shape, x.dtype),
-        template)
+        lambda x: np.zeros((client_num,) + x.shape, x.dtype), template)
 
 
 def gather_client_rows(stacked_tree, ids, pad_to: int):
-    """Cohort rows of a stacked per-client state tree, with the id vector
-    zero-padded to the cohort's static width (padded slots alias client 0
-    — consumers freeze them via the cohort's live mask)."""
-    jnp = jax.numpy
-    padded = jnp.zeros(pad_to, jnp.int32).at[:len(ids)].set(
-        jnp.asarray(ids, jnp.int32))
-    return jax.tree.map(lambda v: jnp.take(v, padded, axis=0), stacked_tree)
+    """The cohort's rows of a stacked per-client state tree, uploaded as
+    device arrays; the id vector is zero-padded to the cohort's static
+    width (padded slots alias client 0 — consumers freeze them via the
+    cohort's live mask)."""
+    padded = np.zeros(pad_to, np.int32)
+    padded[:len(ids)] = np.asarray(ids, np.int32)
+    return jax.tree.map(
+        lambda v: jax.numpy.asarray(np.asarray(v)[padded]), stacked_tree)
 
 
 def scatter_client_rows(stacked_tree, ids, new_rows):
-    """Write the LIVE cohort rows back into the stacked state (padded rows
-    are dropped, so an aliased client-0 slot cannot clobber real state)."""
-    idx = jax.numpy.asarray(ids, jax.numpy.int32)
+    """Write the LIVE cohort rows back into the host-resident stacked
+    state IN PLACE (padded rows are dropped, so an aliased client-0 slot
+    cannot clobber real state).  Returns the same buffers for the
+    ``state = scatter_client_rows(state, ...)`` idiom."""
+    idx = np.asarray(ids, np.int64)
     live_n = len(ids)
-    return jax.tree.map(
-        lambda v, nv: v.at[idx].set(nv[:live_n]), stacked_tree, new_rows)
+
+    def _write(v, nv):
+        v = np.asarray(v)
+        v[idx] = np.asarray(nv)[:live_n]
+        return v
+
+    return jax.tree.map(_write, stacked_tree, new_rows)
 
 
 class FedAvg:

@@ -29,6 +29,7 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedml_tpu.algorithms.fedavg import (FedAvg, FedAvgConfig,
                                          gather_client_rows,
@@ -177,9 +178,13 @@ class FedDyn(FedAvg):
                                               new_lam)
         return params, {}
 
-    # correction state rides the round checkpoint
+    # correction state rides the round checkpoint.  The stacked buffers
+    # are SNAPSHOTTED (np.array copies): scatter_client_rows mutates them
+    # in place, so handing live references to an async checkpointer could
+    # serialize torn state mixing rows from two rounds.
     def _extra_state(self):
-        return {"h_state": self.h_state, "lam_locals": self.lam_locals,
+        return {"h_state": self.h_state,
+                "lam_locals": jax.tree.map(np.array, self.lam_locals),
                 "round_counter": self._round_counter}
 
     def _extra_state_template(self, params):
@@ -190,5 +195,6 @@ class FedDyn(FedAvg):
 
     def _load_extra_state(self, extra) -> None:
         self.h_state = extra["h_state"]
-        self.lam_locals = extra["lam_locals"]
+        # stacked state is host-resident by convention (fedavg.py)
+        self.lam_locals = jax.tree.map(np.asarray, extra["lam_locals"])
         self._round_counter = int(extra["round_counter"])

@@ -27,6 +27,7 @@ from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedml_tpu.algorithms.fedavg import (FedAvg, FedAvgConfig,
                                          gather_client_rows,
@@ -186,9 +187,13 @@ class Scaffold(FedAvg):
                                             new_c_cohort)
         return params, {}
 
-    # control-variate state rides the round checkpoint
+    # control-variate state rides the round checkpoint.  The stacked
+    # buffers are SNAPSHOTTED (np.array copies): scatter_client_rows
+    # mutates them in place, so handing live references to an async
+    # checkpointer could serialize torn state mixing rows from two rounds.
     def _extra_state(self):
-        return {"c_global": self.c_global, "c_locals": self.c_locals,
+        return {"c_global": self.c_global,
+                "c_locals": jax.tree.map(np.array, self.c_locals),
                 "round_counter": self._round_counter}
 
     def _extra_state_template(self, params):
@@ -199,5 +204,6 @@ class Scaffold(FedAvg):
 
     def _load_extra_state(self, extra) -> None:
         self.c_global = extra["c_global"]
-        self.c_locals = extra["c_locals"]
+        # stacked state is host-resident by convention (fedavg.py)
+        self.c_locals = jax.tree.map(np.asarray, extra["c_locals"])
         self._round_counter = int(extra["round_counter"])

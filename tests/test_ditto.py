@@ -215,3 +215,49 @@ def test_personalized_eval_never_pads_above_corpus():
     algo._personal_eval = spy
     metrics = algo.evaluate_personalized()
     assert metrics and seen and max(seen) == 3
+
+
+def test_stacked_state_is_host_resident_at_scale():
+    """The full [N, ...] personalized state must be HOST numpy, never a
+    device array — at stackoverflow scale (342k clients) HBM cannot hold
+    N model copies; only the cohort's rows ride to the device per round
+    (the stacked-state convention, fedavg.py)."""
+    n = 20_000
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(2, 8).astype(np.float32) for _ in range(n)]
+    ys = [rng.randint(0, 2, 2).astype(np.int32) for _ in range(n)]
+    algo = Ditto(_wl(), _fed(xs, ys, batch=2),
+                 DittoConfig(ditto_lambda=0.1, comm_round=2,
+                             client_num_per_round=8, epochs=1, batch_size=2,
+                             lr=0.1, frequency_of_the_test=100,
+                             eval_chunk_clients=512))
+    algo.run()
+    for leaf in jax.tree.leaves(algo.v_locals):
+        assert isinstance(leaf, np.ndarray), type(leaf)
+    assert jax.tree.leaves(algo.v_locals)[0].shape[0] == n
+
+
+def test_async_checkpoint_snapshots_state_not_live_buffers(tmp_path):
+    """With async orbax saves, the checkpointer must serialize a SNAPSHOT
+    of the stacked personalized state: the next round's in-place scatter
+    must not tear the saved state (resume == straight run exactly)."""
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+    xs, ys = _concept_shift_clients()
+    kw = _cfg_kwargs(rounds=4, clients=2)
+    straight = Ditto(_wl(), _fed(xs, ys), DittoConfig(ditto_lambda=0.2, **kw))
+    w_straight = straight.run()
+
+    half = Ditto(_wl(), _fed(xs, ys),
+                 DittoConfig(ditto_lambda=0.2, **{**kw, "comm_round": 2}))
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1,
+                           async_save=True)
+    half.run(checkpointer=ck)
+    resumed = Ditto(_wl(), _fed(xs, ys),
+                    DittoConfig(ditto_lambda=0.2, **kw))
+    w_resumed = resumed.run(
+        checkpointer=RoundCheckpointer(str(tmp_path / "ck"), save_every=1))
+    for a, b in zip(jax.tree.leaves(w_straight), jax.tree.leaves(w_resumed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(straight.v_locals),
+                    jax.tree.leaves(resumed.v_locals)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
