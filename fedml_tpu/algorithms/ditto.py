@@ -224,12 +224,10 @@ class Ditto(FedAvg):
         out.update(self.evaluate_personalized())
         return out
 
-    # personalized state rides the round checkpoint.  The stacked buffers
-    # are SNAPSHOTTED (np.array copies): scatter_client_rows mutates them
-    # in place, so handing live references to an async checkpointer could
-    # serialize torn state mixing rows from two rounds.
+    # personalized state rides the round checkpoint (async saves snapshot
+    # the mutable numpy buffers — RoundCheckpointer.save)
     def _extra_state(self):
-        return {"v_locals": jax.tree.map(np.array, self.v_locals),
+        return {"v_locals": self.v_locals,
                 "round_counter": self._round_counter}
 
     def _extra_state_template(self, params):

@@ -178,13 +178,10 @@ class FedDyn(FedAvg):
                                               new_lam)
         return params, {}
 
-    # correction state rides the round checkpoint.  The stacked buffers
-    # are SNAPSHOTTED (np.array copies): scatter_client_rows mutates them
-    # in place, so handing live references to an async checkpointer could
-    # serialize torn state mixing rows from two rounds.
+    # correction state rides the round checkpoint (async saves snapshot
+    # the mutable numpy buffers — RoundCheckpointer.save)
     def _extra_state(self):
-        return {"h_state": self.h_state,
-                "lam_locals": jax.tree.map(np.array, self.lam_locals),
+        return {"h_state": self.h_state, "lam_locals": self.lam_locals,
                 "round_counter": self._round_counter}
 
     def _extra_state_template(self, params):

@@ -187,13 +187,10 @@ class Scaffold(FedAvg):
                                             new_c_cohort)
         return params, {}
 
-    # control-variate state rides the round checkpoint.  The stacked
-    # buffers are SNAPSHOTTED (np.array copies): scatter_client_rows
-    # mutates them in place, so handing live references to an async
-    # checkpointer could serialize torn state mixing rows from two rounds.
+    # control-variate state rides the round checkpoint (async saves
+    # snapshot the mutable numpy buffers — RoundCheckpointer.save)
     def _extra_state(self):
-        return {"c_global": self.c_global,
-                "c_locals": jax.tree.map(np.array, self.c_locals),
+        return {"c_global": self.c_global, "c_locals": self.c_locals,
                 "round_counter": self._round_counter}
 
     def _extra_state_template(self, params):

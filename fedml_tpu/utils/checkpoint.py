@@ -77,6 +77,19 @@ class RoundCheckpointer:
 
     def save(self, round_idx: int, state: Dict[str, Any]) -> None:
         state = _pack_keys(state)
+        if self.async_save:
+            # snapshot MUTABLE host leaves before enqueueing: stacked
+            # per-client state (algorithms/fedavg.py stacked-state
+            # convention) is numpy and scattered into IN PLACE next round.
+            # Current orbax already copies at enqueue (probed empirically;
+            # test_ditto.py pins the observable contract), so this is
+            # defense-in-depth against that implementation detail changing
+            # — a torn save would silently break bit-identical resume.
+            # jax arrays are immutable and the sync path blocks until
+            # durable, so only async numpy leaves need the copy.
+            state = jax.tree.map(
+                lambda x: np.array(x) if isinstance(x, np.ndarray) else x,
+                state)
         self._mngr.save(round_idx,
                         args=self._ocp.args.StandardSave(state))
         if not self.async_save:

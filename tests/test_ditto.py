@@ -237,27 +237,22 @@ def test_stacked_state_is_host_resident_at_scale():
     assert jax.tree.leaves(algo.v_locals)[0].shape[0] == n
 
 
-def test_async_checkpoint_snapshots_state_not_live_buffers(tmp_path):
-    """With async orbax saves, the checkpointer must serialize a SNAPSHOT
-    of the stacked personalized state: the next round's in-place scatter
-    must not tear the saved state (resume == straight run exactly)."""
+def test_async_save_is_immune_to_post_save_mutation(tmp_path):
+    """THE async-save contract the stacked-state algorithms rely on:
+    mutating a host numpy buffer IN PLACE right after save() returns (what
+    scatter_client_rows does every round) must never change what the
+    checkpoint restores.  Today orbax copies at enqueue AND
+    RoundCheckpointer snapshots numpy leaves (defense-in-depth,
+    checkpoint.py:save); this pins the observable contract against either
+    layer changing."""
     from fedml_tpu.utils.checkpoint import RoundCheckpointer
-    xs, ys = _concept_shift_clients()
-    kw = _cfg_kwargs(rounds=4, clients=2)
-    straight = Ditto(_wl(), _fed(xs, ys), DittoConfig(ditto_lambda=0.2, **kw))
-    w_straight = straight.run()
-
-    half = Ditto(_wl(), _fed(xs, ys),
-                 DittoConfig(ditto_lambda=0.2, **{**kw, "comm_round": 2}))
     ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1,
                            async_save=True)
-    half.run(checkpointer=ck)
-    resumed = Ditto(_wl(), _fed(xs, ys),
-                    DittoConfig(ditto_lambda=0.2, **kw))
-    w_resumed = resumed.run(
-        checkpointer=RoundCheckpointer(str(tmp_path / "ck"), save_every=1))
-    for a, b in zip(jax.tree.leaves(w_straight), jax.tree.leaves(w_resumed)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-    for a, b in zip(jax.tree.leaves(straight.v_locals),
-                    jax.tree.leaves(resumed.v_locals)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    state = {"buf": np.ones((64, 8), np.float32), "round": 0}
+    ck.save(0, state)
+    state["buf"][:] = 999.0  # next round's in-place scatter, simulated
+    ck.flush()
+    restored = ck.restore(0, like={"buf": np.zeros((64, 8), np.float32),
+                                   "round": 0})
+    np.testing.assert_array_equal(np.asarray(restored["buf"]),
+                                  np.ones((64, 8), np.float32))

@@ -1,0 +1,89 @@
+"""Cohort padding invariance, across the algorithm zoo.
+
+THE core static-shape contract (SURVEY.md §7 hard part (a)): cohorts are
+padded to a static size with weight-0 slots, and padded slots must be
+bit-invisible — identical final params whether the configured cohort is
+exactly the client count or far larger (every extra slot is padding).
+Pinned for FedNova since round 2 (test_fednova_detail); this sweep pins it
+for every cohort-engine algorithm, including the stateful ones whose
+per-client state gather/scatter must also ignore padded slots."""
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.data.stacking import FederatedData, stack_client_data
+from fedml_tpu.models import LogisticRegression
+from fedml_tpu.trainer.workload import ClassificationWorkload
+
+
+def _data(n_clients=3, dim=6, per=12, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = [rng.randn(per, dim).astype(np.float32) for _ in range(n_clients)]
+    ys = [rng.randint(0, 4, per).astype(np.int32) for _ in range(n_clients)]
+    train = stack_client_data(xs, ys, 4)
+    return FederatedData(client_num=n_clients, class_num=4, train=train,
+                         test=train)
+
+
+def _wl():
+    return ClassificationWorkload(LogisticRegression(6, 4), num_classes=4,
+                                  grad_clip_norm=None)
+
+
+def _make(algo_name, data, m):
+    base = dict(comm_round=3, client_num_per_round=m, epochs=2,
+                batch_size=4, lr=0.1, frequency_of_the_test=100)
+    if algo_name == "fedavg":
+        from fedml_tpu.algorithms import FedAvg, FedAvgConfig
+        return FedAvg(_wl(), data, FedAvgConfig(**base))
+    if algo_name == "fedprox":
+        from fedml_tpu.algorithms import FedProx, FedProxConfig
+        return FedProx(_wl(), data, FedProxConfig(mu=0.1, **base))
+    if algo_name == "fedopt":
+        from fedml_tpu.algorithms import FedOpt, FedOptConfig
+        return FedOpt(_wl(), data, FedOptConfig(
+            server_optimizer="adam", server_lr=0.01, **base))
+    if algo_name == "fednova":
+        from fedml_tpu.algorithms import FedNova, FedNovaConfig
+        return FedNova(_wl(), data, FedNovaConfig(**base))
+    if algo_name == "scaffold":
+        from fedml_tpu.algorithms import Scaffold, ScaffoldConfig
+        return Scaffold(_wl(), data, ScaffoldConfig(**base))
+    if algo_name == "feddyn":
+        from fedml_tpu.algorithms import FedDyn, FedDynConfig
+        return FedDyn(_wl(), data, FedDynConfig(feddyn_alpha=0.05, **base))
+    if algo_name == "ditto":
+        from fedml_tpu.algorithms import Ditto, DittoConfig
+        return Ditto(_wl(), data, DittoConfig(ditto_lambda=0.1, **base))
+    if algo_name == "dp_fedavg":
+        from fedml_tpu.algorithms import DPFedAvg, DPFedAvgConfig
+        return DPFedAvg(_wl(), data, DPFedAvgConfig(
+            dp_clip=0.5, dp_noise_multiplier=1.0, **base))
+    if algo_name == "fedavg_robust":
+        from fedml_tpu.algorithms import FedAvgRobust, FedAvgRobustConfig
+        return FedAvgRobust(_wl(), data, FedAvgRobustConfig(
+            defense="norm_diff_clipping", norm_bound=1.0, **base))
+    raise KeyError(algo_name)
+
+
+ALGOS = ("fedavg", "fedprox", "fedopt", "fednova", "scaffold", "feddyn",
+         "ditto", "dp_fedavg", "fedavg_robust")
+
+
+@pytest.mark.parametrize("algo_name", ALGOS)
+def test_padded_cohort_slots_are_invisible(algo_name):
+    """m = N (no padding) vs m = 2N (half the cohort is weight-0 padding):
+    same clients, same rng chain, so the final global params must match to
+    float tolerance (the padded slots' rng streams exist but their
+    contributions are masked everywhere)."""
+    data = _data()
+    n = data.client_num
+    exact = _make(algo_name, data, n)
+    padded = _make(algo_name, data, 2 * n)
+    out_a = exact.run(rng=jax.random.key(7))
+    out_b = padded.run(rng=jax.random.key(7))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6),
+        out_a, out_b)
