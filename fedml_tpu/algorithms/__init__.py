@@ -6,6 +6,7 @@ from fedml_tpu.algorithms.fednova import FedNova, FedNovaConfig
 from fedml_tpu.algorithms.scaffold import Scaffold, ScaffoldConfig
 from fedml_tpu.algorithms.ditto import Ditto, DittoConfig
 from fedml_tpu.algorithms.feddyn import FedDyn, FedDynConfig
+from fedml_tpu.algorithms.fedac import FedAC, FedACConfig
 from fedml_tpu.algorithms.dp_fedavg import DPFedAvg, DPFedAvgConfig
 from fedml_tpu.algorithms.fedavg_robust import FedAvgRobust, FedAvgRobustConfig
 from fedml_tpu.algorithms.decentralized import (

@@ -1,0 +1,195 @@
+"""FedAC — Federated Accelerated SGD (Yuan & Ma 2020, arXiv:2006.08950;
+PAPERS.md).  Principled Nesterov acceleration of FedAvg: provably better
+communication/convergence trade-off on strongly convex objectives, and in
+practice faster on ill-conditioned problems at the SAME rounds budget
+(pinned by test_fedac's conditioning test).
+
+Beyond the reference's algorithm list — its only server-side optimizer
+machinery is FedOpt's pseudo-gradient (FedOptAggregator.py:93-122), which
+accelerates the SERVER update only; FedAC couples acceleration through
+the LOCAL steps themselves.
+
+Algorithm 1 of the paper, cohort-engine form.  The server state is a
+coupled pair (x, x^ag); each round both are broadcast, every client runs
+K local steps of
+
+    x^md = (1/β)·x + (1 − 1/β)·x^ag
+    g    = ∇F_i(x^md; ξ)
+    x^ag ← x^md − η·g
+    x    ← (1 − 1/α)·x + (1/α)·x^md − γ·g
+
+and the server sample-weight-averages both sequences (the paper averages
+uniformly over full participation; the weighted mean is the standard FL
+extension and reduces to it on equal shards).  The explicit knobs
+``(α=1, β=1, γ=η)`` collapse both sequences onto plain local SGD —
+bit-identical FedAvg (parity-tested).  FedAC-I coupling (Lemma 1 of the
+paper): given η ≤ 1/L and strong convexity μ ≤ 1/η,
+
+    γ = max(sqrt(η / (μ·K)), η),   α = 1/(γμ),   β = α + 1.
+
+``fedac_mu > 0`` derives (γ, α, β) this way from ``lr`` and the local
+step count; otherwise the explicit knobs are used.  The model is
+evaluated/reported at x^ag (the paper's output iterate); the x sequence
+rides the checkpoint as server state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.trainer.workload import Workload
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class FedACConfig(FedAvgConfig):
+    fedac_mu: float = 0.0     # >0: derive (gamma, alpha, beta) (FedAC-I)
+    fedac_gamma: float = 0.0  # explicit knobs (0 -> gamma = lr)
+    fedac_alpha: float = 1.0
+    fedac_beta: float = 1.0
+
+
+def fedac_coupling(lr: float, mu: float, k_steps: int):
+    """FedAC-I hyperparameter coupling (arXiv:2006.08950 Lemma 1)."""
+    import math
+    gamma = max(math.sqrt(lr / (mu * max(k_steps, 1))), lr)
+    alpha = 1.0 / (gamma * mu)
+    beta = alpha + 1.0
+    return gamma, alpha, beta
+
+
+def make_fedac_local(workload: Workload, lr: float, epochs: int,
+                     gamma: float, alpha: float, beta: float):
+    """``train(x, x_ag, data, rng) -> (x', x_ag')`` — K coupled local
+    steps.  Fully-padded batches freeze BOTH sequences (the x^ag ← x^md
+    assignment must not fire on masked steps, or ragged clients would
+    drift)."""
+    import optax
+    clip = (optax.clip_by_global_norm(workload.grad_clip_norm)
+            if workload.grad_clip_norm is not None else None)
+    grad_fn = jax.grad(lambda p, b, r: workload.loss_fn(p, b, r, True)[0])
+
+    def train(x: Pytree, x_ag: Pytree, data: Dict[str, jax.Array],
+              rng: jax.Array):
+        num_steps = jax.tree.leaves(data)[0].shape[0]
+        clip_state = clip.init(x) if clip is not None else None
+
+        def step(carry, step_idx):
+            x, x_ag, rng = carry
+            rng, drng = jax.random.split(rng)
+            batch = jax.tree.map(lambda v: v[step_idx % num_steps], data)
+            x_md = jax.tree.map(
+                lambda xi, ai: xi / beta + (1.0 - 1.0 / beta) * ai,
+                x, x_ag)
+            grads = grad_fn(x_md, batch, drng)
+            if clip is not None:
+                grads, _ = clip.update(grads, clip_state)
+            live = jnp.sum(batch["mask"]) > 0
+            new_ag = jax.tree.map(lambda m, g: m - lr * g, x_md, grads)
+            new_x = jax.tree.map(
+                lambda xi, m, g: (1.0 - 1.0 / alpha) * xi + m / alpha
+                - gamma * g, x, x_md, grads)
+            x_ag = jax.tree.map(
+                lambda n, o: jnp.where(live, n, o), new_ag, x_ag)
+            x = jax.tree.map(lambda n, o: jnp.where(live, n, o), new_x, x)
+            return (x, x_ag, rng), None
+
+        (x, x_ag, _), _ = jax.lax.scan(step, (x, x_ag, rng),
+                                       jnp.arange(epochs * num_steps))
+        return x, x_ag
+
+    return train
+
+
+class FedAC(FedAvg):
+    """``run()``'s params ARE x^ag (the reported iterate); the coupled x
+    sequence is server state riding ``_extra_state``.  FedAvg.run drives
+    this via the replaced ``cohort_step`` (host-gather path)."""
+
+    def __init__(self, workload, data, config: FedACConfig, mesh=None,
+                 sink=None):
+        if mesh is not None:
+            raise ValueError("fedac couples a second server sequence "
+                             "host-side; mesh sharding is not wired — run "
+                             "single-chip")
+        if config.client_optimizer != "sgd":
+            raise ValueError(
+                "fedac's local update IS the accelerated rule (Yuan&Ma'20 "
+                "Alg. 1); --client_optimizer sgd only")
+        if getattr(workload, "stateful", False):
+            raise ValueError(
+                "fedac does not support stateful (BatchNorm) workloads: "
+                "the coupled sequences over running statistics are "
+                "undefined — use a GroupNorm model (e.g. resnet18_gn)")
+        super().__init__(workload, data, config, mesh=mesh, sink=sink)
+        cfg = config
+        steps = int(self.data.train["x"].shape[1])  # S batches per epoch
+        if cfg.fedac_mu > 0.0:
+            gamma, alpha, beta = fedac_coupling(cfg.lr, cfg.fedac_mu,
+                                                cfg.epochs * steps)
+        else:
+            gamma = cfg.fedac_gamma or cfg.lr
+            alpha, beta = cfg.fedac_alpha, cfg.fedac_beta
+        if alpha < 1.0 or beta < 1.0:
+            hint = ""
+            if cfg.fedac_mu > 0.0:
+                hint = (f" — derived from --fedac_mu {cfg.fedac_mu}: the "
+                        f"coupling needs mu <= 1/lr (= {1.0 / cfg.lr:g}); "
+                        "lower --fedac_mu or raise --lr")
+            raise ValueError(f"fedac needs alpha >= 1 and beta >= 1 "
+                             f"(got alpha={alpha:g}, beta={beta:g}){hint}")
+        self.coupling = {"gamma": gamma, "alpha": alpha, "beta": beta}
+        self._x_state = None  # the coupled x sequence (params == x^ag)
+        local = make_fedac_local(workload, cfg.lr, cfg.epochs, gamma,
+                                 alpha, beta)
+
+        @jax.jit
+        def round_step(x_ag, cohort, rng, x):
+            n = cohort["num_samples"].shape[0]
+            rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
+                jnp.arange(n))
+            batches = {k: v for k, v in cohort.items()
+                       if k != "num_samples"}
+            xs, ags = jax.vmap(local, in_axes=(None, None, 0, 0))(
+                x, x_ag, batches, rngs)
+            w = cohort["num_samples"].astype(jnp.float32)
+            ratio = w / jnp.maximum(jnp.sum(w), 1.0)
+
+            def _mean(stacked):
+                return jax.tree.map(
+                    lambda s: jnp.sum(
+                        s * ratio.reshape((-1,) + (1,) * (s.ndim - 1)),
+                        axis=0), stacked)
+
+            return _mean(ags), _mean(xs)
+
+        self._round_step = round_step
+        self.cohort_step = self._coupled_step
+
+    def run(self, params=None, rng=None, checkpointer=None):
+        self._x_state = None  # x^0 = x^ag,0 (fresh runs re-couple)
+        return super().run(params=params, rng=rng,
+                           checkpointer=checkpointer)
+
+    def _coupled_step(self, params, cohort, rng):
+        if self._x_state is None:
+            self._x_state = jax.tree.map(jnp.copy, params)
+        new_ag, self._x_state = self._round_step(params, cohort, rng,
+                                                 self._x_state)
+        return new_ag, {}
+
+    # the x sequence rides the round checkpoint beside params (= x^ag)
+    def _extra_state(self):
+        return {"x_state": self._x_state}
+
+    def _extra_state_template(self, params):
+        return {"x_state": jax.tree.map(jnp.zeros_like, params)}
+
+    def _load_extra_state(self, extra) -> None:
+        self._x_state = extra["x_state"]

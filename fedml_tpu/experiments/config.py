@@ -55,6 +55,10 @@ class ExperimentConfig:
     personal_lr: float = 0.0             # Ditto: 0 → inherit --lr
     personal_epochs: int = 0             # Ditto: 0 → inherit --epochs
     feddyn_alpha: float = 0.01           # FedDyn: dynamic-reg strength α
+    fedac_mu: float = 0.0                # FedAC: >0 derives (γ,α,β)
+    fedac_gamma: float = 0.0             # FedAC explicit knobs (0 → lr)
+    fedac_alpha: float = 1.0
+    fedac_beta: float = 1.0
     dp_clip: float = 1.0                 # dp_fedavg: per-user L2 bound S
     dp_noise_multiplier: float = 1.0     # dp_fedavg: z (std = S·z/m)
     dp_delta: float = 1e-5               # dp_fedavg: δ for reported ε

@@ -406,6 +406,21 @@ def run_feddyn(cfg, data, mesh, sink):
     return algo.history[-1] if algo.history else {}
 
 
+@runner("fedac")
+def run_fedac(cfg, data, mesh, sink):
+    """FedAC accelerated federated SGD (beyond the reference —
+    algorithms/fedac.py, arXiv:2006.08950): Nesterov-coupled local steps;
+    --fedac_mu derives the paper's (gamma, alpha, beta) coupling."""
+    from fedml_tpu.algorithms.fedac import FedAC, FedACConfig
+    wl = _make_workload(cfg, data)
+    algo = FedAC(wl, data, FedACConfig(
+        fedac_mu=cfg.fedac_mu, fedac_gamma=cfg.fedac_gamma,
+        fedac_alpha=cfg.fedac_alpha, fedac_beta=cfg.fedac_beta,
+        **_fedavg_cfg_kwargs(cfg)), mesh=mesh, sink=sink)
+    algo.run(checkpointer=_make_checkpointer(cfg))
+    return algo.history[-1] if algo.history else {}
+
+
 @runner("dp_fedavg")
 def run_dp_fedavg(cfg, data, mesh, sink):
     """User-level DP FedAvg with a real RDP accountant (beyond the
@@ -995,7 +1010,7 @@ def main(argv=None) -> Dict[str, Any]:
     _DTYPE_RUNNERS = {"fedavg", "fedprox", "fedopt", "fednova",
                       "fedavg_robust", "hierarchical", "centralized",
                       "decentralized", "turboaggregate", "ditto",
-                      "feddyn", "dp_fedavg"}
+                      "feddyn", "dp_fedavg", "fedac"}
     if cfg.compute_dtype and cfg.algo not in _DTYPE_RUNNERS:
         raise ValueError(
             f"--compute_dtype is not wired into --algo {cfg.algo}; "

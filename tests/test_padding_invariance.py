@@ -60,6 +60,9 @@ def _make(algo_name, data, m):
         from fedml_tpu.algorithms import DPFedAvg, DPFedAvgConfig
         return DPFedAvg(_wl(), data, DPFedAvgConfig(
             dp_clip=0.5, dp_noise_multiplier=1.0, **base))
+    if algo_name == "fedac":
+        from fedml_tpu.algorithms import FedAC, FedACConfig
+        return FedAC(_wl(), data, FedACConfig(fedac_mu=0.1, **base))
     if algo_name == "fedavg_robust":
         from fedml_tpu.algorithms import FedAvgRobust, FedAvgRobustConfig
         return FedAvgRobust(_wl(), data, FedAvgRobustConfig(
@@ -68,7 +71,7 @@ def _make(algo_name, data, m):
 
 
 ALGOS = ("fedavg", "fedprox", "fedopt", "fednova", "scaffold", "feddyn",
-         "ditto", "dp_fedavg", "fedavg_robust")
+         "ditto", "dp_fedavg", "fedac", "fedavg_robust")
 
 
 @pytest.mark.parametrize("algo_name", ALGOS)
