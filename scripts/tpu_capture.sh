@@ -45,12 +45,12 @@ probe || { echo "backend unreachable — aborting capture"; exit 1; }
 FAILED=0
 
 echo "== 1/5 canonical full f32 bench (cache-warm; BENCH_DETAILS.json) =="
-timeout 5400 env BENCH_MODE=full python bench.py \
+timeout 5400 env BENCH_MODE=full BENCH_STALL_S=1500 python bench.py \
   || { echo "stage 1 FAILED or partial (rc=$?) — see BENCH_DETAILS.json.partial"; FAILED=1; }
 
 probe || { echo "tunnel wedged after stage 1 — stopping"; exit 2; }
 echo "== 2/5 bf16 comparison (BENCH_DETAILS_bf16.json) =="
-timeout 3600 env BENCH_DTYPE=bfloat16 BENCH_SCALING=0 \
+timeout 3600 env BENCH_DTYPE=bfloat16 BENCH_SCALING=0 BENCH_STALL_S=1500 \
   BENCH_OUT=BENCH_DETAILS_bf16.json python bench.py \
   || { echo "stage 2 FAILED or partial (rc=$?)"; FAILED=1; }
 
