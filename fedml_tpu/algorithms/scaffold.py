@@ -90,14 +90,21 @@ class Scaffold(FedAvg):
     path — the stacked c_i state is scattered back per round, which the
     HBM fast paths don't model).  The step re-derives the round's client
     ids from the same seeded sampling chain run() used to gather the
-    cohort, tracked by an internal round counter."""
+    cohort, tracked by an internal round counter.
+
+    ``mesh=`` shards the cohort's clients axis across devices (shard_map +
+    psum; matches single-chip to float tolerance — the psum reassociates
+    the reduction order — parity-tested); the c_i state stays
+    host-resident either way.  Single-process meshes only: the per-round
+    scatter gathers the updated cohort variates to one host."""
 
     def __init__(self, workload, data, config: ScaffoldConfig, mesh=None,
                  sink=None):
-        if mesh is not None:
-            raise ValueError("scaffold tracks per-client control variates "
-                             "host-side; mesh sharding is not wired — run "
-                             "single-chip")
+        if mesh is not None and jax.process_count() > 1:
+            raise ValueError(
+                "scaffold's control variates are host-resident and the "
+                "cohort scatter gathers them to one host; multi-process "
+                "meshes are not wired — run a single-process mesh")
         if config.client_optimizer != "sgd":
             raise ValueError(
                 "scaffold's local update is plain SGD with control-variate "
@@ -117,11 +124,20 @@ class Scaffold(FedAvg):
         self.c_locals = None  # stacked [client_num_in_total, ...]
         local = make_scaffold_local(workload, cfg.lr, cfg.epochs)
 
-        @jax.jit
-        def round_step(params, cohort, rng, c_global, c_cohort):
+        def _core(params, cohort, rng, c_global, c_cohort,
+                  psum_axis=None, index_offset=0):
+            """One SCAFFOLD round over (a shard of) the cohort — the ONE
+            body both execution paths share (the FedNova _nova_core
+            pattern): single-chip calls it with no axis; the mesh path
+            per-device with psum reductions and the shard's global slot
+            offset for rng folding (parallel/cohort.py convention)."""
+            def allsum(x):
+                return (jax.lax.psum(x, psum_axis)
+                        if psum_axis is not None else x)
+
             n_clients = cohort["num_samples"].shape[0]
             rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
-                jnp.arange(n_clients))
+                jnp.arange(n_clients) + index_offset)
             c_diffs = jax.tree.map(lambda cg, ci: cg[None] - ci,
                                    c_global, c_cohort)
             batches = {k: v for k, v in cohort.items()
@@ -130,12 +146,12 @@ class Scaffold(FedAvg):
                 params, batches, rngs, c_diffs)
             w = cohort["num_samples"].astype(jnp.float32)
             live = (w > 0).astype(jnp.float32)
-            ratio = (w / jnp.maximum(jnp.sum(w), 1.0))
+            ratio = w / jnp.maximum(allsum(jnp.sum(w)), 1.0)
             # x+ = x + Σ_i r_i (y_i − x)  (sample-weighted server step)
             new_params = jax.tree.map(
-                lambda x, y: x + jnp.sum(
+                lambda x, y: x + allsum(jnp.sum(
                     (y - x[None])
-                    * ratio.reshape((-1,) + (1,) * (x.ndim)), axis=0),
+                    * ratio.reshape((-1,) + (1,) * (x.ndim)), axis=0)),
                 params, ys)
             # c_i+ = c_i − c + (x − y_i)/(K·lr); frozen for padded slots
             k_safe = jnp.maximum(ks, 1.0)
@@ -147,16 +163,33 @@ class Scaffold(FedAvg):
                     ci),
                 c_cohort, c_global, params, ys)
             # c+ = c + (|S|/N)·mean_{i∈S}(c_i+ − c_i)
-            m = jnp.maximum(jnp.sum(live), 1.0)
+            m = jnp.maximum(allsum(jnp.sum(live)), 1.0)
             frac = m / self.data.client_num
             new_c_global = jax.tree.map(
-                lambda cg, nci, ci: cg + frac * jnp.sum(
+                lambda cg, nci, ci: cg + frac * allsum(jnp.sum(
                     (nci - ci) * live.reshape((-1,) + (1,) * (nci.ndim - 1)),
-                    axis=0) / m,
+                    axis=0)) / m,
                 c_global, new_c_cohort, c_cohort)
             return new_params, new_c_cohort, new_c_global
 
-        self._round_step = round_step
+        if mesh is None:
+            self._round_step = jax.jit(_core)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            def per_device(params, cohort, rng, c_global, c_cohort):
+                local_c = cohort["num_samples"].shape[0]
+                offset = jax.lax.axis_index("clients") * local_c
+                return _core(params, cohort, rng, c_global, c_cohort,
+                             psum_axis="clients", index_offset=offset)
+
+            # check_vma off: the local trainer's scan carries a scalar step
+            # counter that starts unvarying (the FedNova mesh path's
+            # pattern, fednova.py); semantics are unaffected
+            self._round_step = jax.jit(jax.shard_map(
+                per_device, mesh=mesh,
+                in_specs=(P(), P("clients"), P(), P(), P("clients")),
+                out_specs=(P(), P("clients"), P()), check_vma=False))
         self.cohort_step = self._stateful_step
 
     def run(self, params=None, rng=None, checkpointer=None):

@@ -143,3 +143,52 @@ def test_cli_scaffold_end_to_end():
                     "--frequency_of_the_test", "1", "--batch_size", "4",
                     "--log_stdout", "false"])
     assert np.isfinite(summary["train_loss"])
+
+
+def test_mesh_sharded_scaffold_equals_single_chip(workload):
+    """The 8-device mesh path (shard_map + psum, per-client rng folded by
+    GLOBAL cohort slot) must match the single-chip run to float tolerance
+    (the psum reassociates the reduction order) — params AND control
+    variates."""
+    from fedml_tpu.parallel.mesh import make_mesh
+    xs, ys = _skewed_clients(n_clients=8)
+    data = _fed(xs, ys, batch=8, classes=4)
+    cfg = dict(comm_round=3, client_num_per_round=8, epochs=2, batch_size=8,
+               lr=0.1, frequency_of_the_test=100)
+    single = Scaffold(workload, data, ScaffoldConfig(**cfg))
+    meshed = Scaffold(workload, data, ScaffoldConfig(**cfg),
+                      mesh=make_mesh(client_axis=8))
+    p0 = single.init_params(jax.random.key(3))
+    out_s = single.run(params=jax.tree.map(jnp.copy, p0),
+                       rng=jax.random.key(4))
+    out_m = meshed.run(params=jax.tree.map(jnp.copy, p0),
+                       rng=jax.random.key(4))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-6), out_s, out_m)
+    for a, b in zip(jax.tree.leaves(single.c_locals),
+                    jax.tree.leaves(meshed.c_locals)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_mesh_sharded_scaffold_with_genuinely_padded_cohort(workload):
+    """6 live clients in an 8-slot cohort over 4 devices: two slots are
+    REAL padding (live==0), exercising the live-mask freeze, k_safe
+    guard, and aliased client-0 slot under psum — and the padded slots
+    must leave the stacked variates of every client untouched relative
+    to the single-chip run."""
+    from fedml_tpu.parallel.mesh import make_mesh
+    xs, ys = _skewed_clients(n_clients=6)
+    data = _fed(xs, ys, batch=8, classes=4)
+    cfg = dict(comm_round=2, client_num_per_round=8, epochs=2, batch_size=8,
+               lr=0.1, frequency_of_the_test=100)
+    single = Scaffold(workload, data, ScaffoldConfig(**cfg))
+    meshed = Scaffold(workload, data, ScaffoldConfig(**cfg),
+                      mesh=make_mesh(client_axis=4,
+                                     devices=jax.devices()[:4]))
+    out_s = single.run(rng=jax.random.key(0))
+    out_m = meshed.run(rng=jax.random.key(0))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), atol=1e-6), out_s, out_m)
+    for a, b in zip(jax.tree.leaves(single.c_locals),
+                    jax.tree.leaves(meshed.c_locals)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
