@@ -88,14 +88,20 @@ def make_feddyn_local(workload: Workload, lr: float, epochs: int,
 class FedDyn(FedAvg):
     """FedAvg.run drives this via the replaced ``cohort_step`` (host-gather
     path — the stacked λ_k state is scattered back per round).  Client ids
-    are re-derived from the seeded sampling chain, the SCAFFOLD pattern."""
+    are re-derived from the seeded sampling chain, the SCAFFOLD pattern.
+
+    ``mesh=`` shards the cohort's clients axis across devices (shard_map +
+    psum; matches single-chip to float tolerance — parity-tested); the
+    λ_k state stays host-resident either way.  Single-process meshes
+    only: the per-round scatter gathers the updated rows to one host."""
 
     def __init__(self, workload, data, config: FedDynConfig, mesh=None,
                  sink=None):
-        if mesh is not None:
-            raise ValueError("feddyn tracks per-client correction state "
-                             "host-side; mesh sharding is not wired — run "
-                             "single-chip")
+        if mesh is not None and jax.process_count() > 1:
+            raise ValueError(
+                "feddyn's correction state is host-resident and the cohort "
+                "scatter gathers it to one host; multi-process meshes are "
+                "not wired — run a single-process mesh")
         if config.client_optimizer != "sgd":
             raise ValueError(
                 "feddyn's local solver is SGD on the dynamically "
@@ -117,22 +123,31 @@ class FedDyn(FedAvg):
         self.lam_locals = None  # stacked [client_num_in_total, ...]
         local = make_feddyn_local(workload, cfg.lr, cfg.epochs, alpha)
 
-        @jax.jit
-        def round_step(params, cohort, rng, h, lam_cohort):
+        def _core(params, cohort, rng, h, lam_cohort,
+                  psum_axis=None, index_offset=0):
+            """One FedDyn round over (a shard of) the cohort — the ONE body
+            both execution paths share (the SCAFFOLD/FedNova shared-core
+            pattern): single-chip calls it with no axis; the mesh path
+            per-device with psum reductions and the shard's global slot
+            offset for rng folding (parallel/cohort.py convention)."""
+            def allsum(x):
+                return (jax.lax.psum(x, psum_axis)
+                        if psum_axis is not None else x)
+
             n = cohort["num_samples"].shape[0]
             rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
-                jnp.arange(n))
+                jnp.arange(n) + index_offset)
             batches = {k: v for k, v in cohort.items()
                        if k != "num_samples"}
             thetas = jax.vmap(local, in_axes=(None, 0, 0, 0))(
                 params, lam_cohort, batches, rngs)
             live = (cohort["num_samples"] > 0).astype(jnp.float32)
-            m_live = jnp.maximum(jnp.sum(live), 1.0)
+            m_live = jnp.maximum(allsum(jnp.sum(live)), 1.0)
 
             def _live_mean(y):
-                return jnp.sum(
+                return allsum(jnp.sum(
                     y * live.reshape((-1,) + (1,) * (y.ndim - 1)),
-                    axis=0) / m_live
+                    axis=0)) / m_live
 
             # λ_k ← λ_k − α(θ_k − θ^t); padded slots frozen
             new_lam = jax.tree.map(
@@ -150,7 +165,15 @@ class FedDyn(FedAvg):
                 lambda y, hh: _live_mean(y) - hh / alpha, thetas, new_h)
             return new_params, new_lam, new_h
 
-        self._round_step = round_step
+        if mesh is None:
+            self._round_step = jax.jit(_core)
+        else:
+            from jax.sharding import PartitionSpec as P
+            from fedml_tpu.parallel.cohort import make_sharded_stateful_round
+            self._round_step = make_sharded_stateful_round(
+                _core, mesh,
+                in_specs=(P(), P("clients"), P(), P(), P("clients")),
+                out_specs=(P(), P("clients"), P()))
         self.cohort_step = self._stateful_step
 
     def run(self, params=None, rng=None, checkpointer=None):

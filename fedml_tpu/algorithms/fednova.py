@@ -164,19 +164,11 @@ class FedNova(FedAvg):
                                   psum_axis=None)
         else:
             from jax.sharding import PartitionSpec as P
-
-            def per_device(global_params, cohort_data, rng, gmf_buf):
-                local_c = cohort_data["num_samples"].shape[0]
-                offset = jax.lax.axis_index("clients") * local_c
-                return _nova_core(global_params, cohort_data, rng, gmf_buf,
-                                  psum_axis="clients", index_offset=offset)
-
-            # check_vma off: the local trainer's scan creates scalar carries
-            # (a_i, counter) that start unvarying; semantics are unaffected
-            step = jax.jit(jax.shard_map(
-                per_device, mesh=mesh,
+            from fedml_tpu.parallel.cohort import make_sharded_stateful_round
+            step = make_sharded_stateful_round(
+                _nova_core, mesh,
                 in_specs=(P(), P("clients"), P(), P()),
-                out_specs=(P(), P()), check_vma=False))
+                out_specs=(P(), P()))
 
         self._nova_step = step
         self.cohort_step = self._stateful_step

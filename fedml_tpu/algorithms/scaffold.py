@@ -176,20 +176,11 @@ class Scaffold(FedAvg):
             self._round_step = jax.jit(_core)
         else:
             from jax.sharding import PartitionSpec as P
-
-            def per_device(params, cohort, rng, c_global, c_cohort):
-                local_c = cohort["num_samples"].shape[0]
-                offset = jax.lax.axis_index("clients") * local_c
-                return _core(params, cohort, rng, c_global, c_cohort,
-                             psum_axis="clients", index_offset=offset)
-
-            # check_vma off: the local trainer's scan carries a scalar step
-            # counter that starts unvarying (the FedNova mesh path's
-            # pattern, fednova.py); semantics are unaffected
-            self._round_step = jax.jit(jax.shard_map(
-                per_device, mesh=mesh,
+            from fedml_tpu.parallel.cohort import make_sharded_stateful_round
+            self._round_step = make_sharded_stateful_round(
+                _core, mesh,
                 in_specs=(P(), P("clients"), P(), P(), P("clients")),
-                out_specs=(P(), P("clients"), P()), check_vma=False))
+                out_specs=(P(), P("clients"), P()))
         self.cohort_step = self._stateful_step
 
     def run(self, params=None, rng=None, checkpointer=None):

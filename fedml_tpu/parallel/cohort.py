@@ -258,6 +258,27 @@ def make_scanned_rounds(local_train, clients_per_round: int,
     return rounds_fn
 
 
+def make_sharded_stateful_round(core, mesh: Mesh, in_specs, out_specs):
+    """Wrap a shared round body ``core(params, cohort, rng, *state,
+    psum_axis=, index_offset=)`` as a jitted shard_map over the mesh's
+    ``clients`` axis — THE one home for the stateful-algorithm mesh-wrap
+    convention (FedNova/SCAFFOLD/FedDyn share it): the per-device wrapper
+    derives the shard's GLOBAL cohort-slot offset from the cohort arg
+    (second positional, leaves [C/D, ...]) so per-client rng folding
+    matches single-chip exactly, and ``check_vma`` is off because the
+    local trainers' scans carry scalar counters that start unvarying
+    (semantics unaffected)."""
+
+    def per_device(params, cohort, rng, *state):
+        local_c = cohort["num_samples"].shape[0]
+        offset = jax.lax.axis_index("clients") * local_c
+        return core(params, cohort, rng, *state,
+                    psum_axis="clients", index_offset=offset)
+
+    return jax.jit(jax.shard_map(per_device, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False))
+
+
 def pad_clients(data: CohortData, n_dev: int) -> CohortData:
     """Zero-pad the leading clients axis to a multiple of ``n_dev``; padded
     rows carry mask 0 / weight 0, so they contribute nothing to training or

@@ -141,9 +141,34 @@ def test_rejects_unsupported_configs(workload):
         LogisticRegression(6, 4), num_classes=4, stateful=True)
     with pytest.raises(ValueError, match="stateful"):
         FedDyn(stateful_wl, data, FedDynConfig(**base))
+
+
+def test_mesh_sharded_feddyn_equals_single_chip(workload):
+    """The mesh path (shard_map + psum, rng folded by GLOBAL cohort slot)
+    must match single-chip to float tolerance — params AND λ state —
+    including a genuinely padded cohort (second case: 4 live clients in
+    8 slots over 4 devices, so devices 2-3 hold ONLY padding: live-mask
+    freeze + aliased client-0 slot under psum)."""
     from fedml_tpu.parallel.mesh import make_mesh
-    with pytest.raises(ValueError, match="single-chip"):
-        FedDyn(workload, data, FedDynConfig(**base), mesh=make_mesh())
+    for n_clients, m, axis in ((4, 4, 4), (4, 8, 4)):
+        xs, ys = _overlapping_clients(n_clients=n_clients)
+        data = _fed(xs, ys)
+        cfg = dict(comm_round=2, client_num_per_round=m, epochs=2,
+                   batch_size=8, lr=0.1, frequency_of_the_test=100)
+        single = FedDyn(workload, data,
+                        FedDynConfig(feddyn_alpha=0.05, **cfg))
+        meshed = FedDyn(workload, data,
+                        FedDynConfig(feddyn_alpha=0.05, **cfg),
+                        mesh=make_mesh(client_axis=axis,
+                                       devices=jax.devices()[:axis]))
+        out_s = single.run(rng=jax.random.key(0))
+        out_m = meshed.run(rng=jax.random.key(0))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6), out_s, out_m)
+        for a, b in zip(jax.tree.leaves(single.lam_locals),
+                        jax.tree.leaves(meshed.lam_locals)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
 
 
 def test_cli_feddyn_end_to_end():
