@@ -104,14 +104,21 @@ class Ditto(FedAvg):
     path — the stacked v_i state is scattered back per round, which the
     HBM fast paths don't model).  The step re-derives the round's client
     ids from the same seeded sampling chain run() used to gather the
-    cohort (the SCAFFOLD pattern)."""
+    cohort (the SCAFFOLD pattern).
+
+    ``mesh=`` shards the clients axis: the global stream rides FedAvg's
+    sharded cohort step and the personal pass is a pure shard_map (no
+    cross-client reductions; matches single-chip to float tolerance —
+    parity-tested).  v_i stays host-resident; single-process meshes only
+    (the per-round scatter gathers the cohort's rows to one host)."""
 
     def __init__(self, workload, data, config: DittoConfig, mesh=None,
                  sink=None):
-        if mesh is not None:
-            raise ValueError("ditto tracks per-client personalized models "
-                             "host-side; mesh sharding is not wired — run "
-                             "single-chip")
+        if mesh is not None and jax.process_count() > 1:
+            raise ValueError(
+                "ditto's personalized models are host-resident and the "
+                "cohort scatter gathers them to one host; multi-process "
+                "meshes are not wired — run a single-process mesh")
         if getattr(workload, "stateful", False):
             raise ValueError(
                 "ditto does not support stateful (BatchNorm) workloads: "
@@ -126,11 +133,16 @@ class Ditto(FedAvg):
         personal = make_ditto_local(workload, p_lr, p_epochs,
                                     cfg.ditto_lambda)
 
-        @jax.jit
-        def personal_round(v_cohort, w_ref, cohort, rng):
+        def personal_core(w_ref, cohort, rng, v_cohort,
+                          psum_axis=None, index_offset=0):
+            """The personal pass over (a shard of) the cohort.  Purely
+            per-client — no cross-client reductions, so ``psum_axis`` is
+            accepted for the shared mesh-wrap convention but unused; rng
+            folds by GLOBAL cohort slot (parallel/cohort.py)."""
+            del psum_axis
             n = cohort["num_samples"].shape[0]
             rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
-                jnp.arange(n))
+                jnp.arange(n) + index_offset)
             batches = {k: v for k, v in cohort.items()
                        if k != "num_samples"}
             new_v = jax.vmap(personal, in_axes=(0, None, 0, 0))(
@@ -142,7 +154,16 @@ class Ditto(FedAvg):
                     live.reshape((-1,) + (1,) * (v.ndim - 1)) > 0, nv, v),
                 new_v, v_cohort)
 
-        self._personal_round = personal_round
+        if mesh is None:
+            jitted = jax.jit(personal_core)
+        else:
+            from jax.sharding import PartitionSpec as P
+            from fedml_tpu.parallel.cohort import make_sharded_stateful_round
+            jitted = make_sharded_stateful_round(
+                personal_core, mesh,
+                in_specs=(P(), P("clients"), P(), P("clients")),
+                out_specs=P("clients"))
+        self._personal_round = jitted
         # vmapped per-client evaluator: client i's OWN params on its OWN
         # shard; metric dicts are sums, so cross-client aggregation is a
         # tree-sum (same convention as cohort_eval)
@@ -177,7 +198,7 @@ class Ditto(FedAvg):
         v_cohort = gather_client_rows(self.v_locals, ids,
                                       cohort["num_samples"].shape[0])
         p_rng = jax.random.fold_in(rng, _PERSONAL_STREAM)
-        new_v = self._personal_round(v_cohort, params, cohort, p_rng)
+        new_v = self._personal_round(params, cohort, p_rng, v_cohort)
         self.v_locals = scatter_client_rows(self.v_locals, ids, new_v)
         return new_params, aux
 

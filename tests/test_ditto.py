@@ -167,17 +167,39 @@ def test_kill_and_resume_bit_identical(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
-def test_rejects_mesh_and_stateful():
+def test_rejects_stateful():
     xs, ys = _concept_shift_clients()
-    with pytest.raises(ValueError, match="single-chip"):
-        from fedml_tpu.parallel.mesh import make_mesh
-        Ditto(_wl(), _fed(xs, ys), DittoConfig(**_cfg_kwargs()),
-              mesh=make_mesh())
 
     class _Stateful:
         stateful = True
     with pytest.raises(ValueError, match="stateful"):
         Ditto(_Stateful(), _fed(xs, ys), DittoConfig(**_cfg_kwargs()))
+
+
+def test_mesh_sharded_ditto_equals_single_chip():
+    """Mesh runs (global stream on FedAvg's sharded cohort step, personal
+    pass as a pure shard_map with GLOBAL-slot rng folding) must match
+    single-chip to float tolerance — global params AND personalized
+    state — including a padded cohort (second case: 4 live clients in 8
+    slots over 4 devices)."""
+    from fedml_tpu.parallel.mesh import make_mesh
+    for n_clients, m, axis in ((4, 4, 4), (4, 8, 4)):
+        xs, ys = _concept_shift_clients(n_clients=n_clients)
+        cfg = dict(ditto_lambda=0.2, comm_round=2, client_num_per_round=m,
+                   epochs=2, batch_size=8, lr=0.1,
+                   frequency_of_the_test=100)
+        single = Ditto(_wl(), _fed(xs, ys), DittoConfig(**cfg))
+        meshed = Ditto(_wl(), _fed(xs, ys), DittoConfig(**cfg),
+                       mesh=make_mesh(client_axis=axis,
+                                      devices=jax.devices()[:axis]))
+        out_s = single.run(rng=jax.random.key(0))
+        out_m = meshed.run(rng=jax.random.key(0))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6), out_s, out_m)
+        for a, b in zip(jax.tree.leaves(single.v_locals),
+                        jax.tree.leaves(meshed.v_locals)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
 
 
 def test_personalized_eval_chunking_is_exact():
