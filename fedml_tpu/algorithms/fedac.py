@@ -110,14 +110,19 @@ def make_fedac_local(workload: Workload, lr: float, epochs: int,
 class FedAC(FedAvg):
     """``run()``'s params ARE x^ag (the reported iterate); the coupled x
     sequence is server state riding ``_extra_state``.  FedAvg.run drives
-    this via the replaced ``cohort_step`` (host-gather path)."""
+    this via the replaced ``cohort_step`` (host-gather path).
+
+    ``mesh=`` shards the cohort's clients axis (shared round body +
+    shard_map/psum; matches single-chip to float tolerance —
+    parity-tested); single-process meshes only."""
 
     def __init__(self, workload, data, config: FedACConfig, mesh=None,
                  sink=None):
-        if mesh is not None:
-            raise ValueError("fedac couples a second server sequence "
-                             "host-side; mesh sharding is not wired — run "
-                             "single-chip")
+        if mesh is not None and jax.process_count() > 1:
+            raise ValueError(
+                "fedac couples a second server sequence host-side; "
+                "multi-process meshes are not wired — run a "
+                "single-process mesh")
         if config.client_optimizer != "sgd":
             raise ValueError(
                 "fedac's local update IS the accelerated rule (Yuan&Ma'20 "
@@ -149,27 +154,41 @@ class FedAC(FedAvg):
         local = make_fedac_local(workload, cfg.lr, cfg.epochs, gamma,
                                  alpha, beta)
 
-        @jax.jit
-        def round_step(x_ag, cohort, rng, x):
+        def _core(x_ag, cohort, rng, x, psum_axis=None, index_offset=0):
+            """One FedAC round over (a shard of) the cohort — the shared
+            round body (SCAFFOLD/FedDyn/FedNova pattern); rng folds by
+            GLOBAL cohort slot (parallel/cohort.py convention)."""
+            def allsum(v):
+                return (jax.lax.psum(v, psum_axis)
+                        if psum_axis is not None else v)
+
             n = cohort["num_samples"].shape[0]
             rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(
-                jnp.arange(n))
+                jnp.arange(n) + index_offset)
             batches = {k: v for k, v in cohort.items()
                        if k != "num_samples"}
             xs, ags = jax.vmap(local, in_axes=(None, None, 0, 0))(
                 x, x_ag, batches, rngs)
             w = cohort["num_samples"].astype(jnp.float32)
-            ratio = w / jnp.maximum(jnp.sum(w), 1.0)
+            ratio = w / jnp.maximum(allsum(jnp.sum(w)), 1.0)
 
             def _mean(stacked):
                 return jax.tree.map(
-                    lambda s: jnp.sum(
+                    lambda s: allsum(jnp.sum(
                         s * ratio.reshape((-1,) + (1,) * (s.ndim - 1)),
-                        axis=0), stacked)
+                        axis=0)), stacked)
 
             return _mean(ags), _mean(xs)
 
-        self._round_step = round_step
+        if mesh is None:
+            self._round_step = jax.jit(_core)
+        else:
+            from jax.sharding import PartitionSpec as P
+            from fedml_tpu.parallel.cohort import make_sharded_stateful_round
+            self._round_step = make_sharded_stateful_round(
+                _core, mesh,
+                in_specs=(P(), P("clients"), P(), P()),
+                out_specs=(P(), P()))
         self.cohort_step = self._coupled_step
 
     def run(self, params=None, rng=None, checkpointer=None):

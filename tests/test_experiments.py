@@ -341,3 +341,24 @@ def test_flagship_partial_sink_checkpoints_curve(tmp_path):
     # evals at rounds 0, 2, 3 (every 2 + final)
     assert [c["round"] for c in curve] == [0, 2, 3]
     assert all(c["train_acc"] is not None for c in curve)
+
+
+@pytest.mark.parametrize("algo,extra", [
+    ("scaffold", []),
+    ("feddyn", ["--feddyn_alpha", "0.05"]),
+    ("ditto", ["--ditto_lambda", "0.1"]),
+    ("fedac", ["--fedac_mu", "0.1"]),
+])
+def test_cli_stateful_mesh_equals_single_chip(devices, algo, extra):
+    """--mesh_clients on the stateful/coupled algorithms (whose mesh paths
+    are the shared sharded round bodies) must reproduce the single-chip
+    CLI run to float tolerance — covering the experiments/main.py wiring,
+    not just the library API."""
+    argv = ["--algo", algo, "--model", "lr", "--dataset", "mnist"] \
+        + _BASE + extra
+    single = main(argv)
+    sharded = main(argv + ["--mesh_clients", "4"])
+    np.testing.assert_allclose(single["train_loss"], sharded["train_loss"],
+                               rtol=1e-5)
+    np.testing.assert_allclose(single["train_acc"], sharded["train_acc"],
+                               rtol=1e-5)

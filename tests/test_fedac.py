@@ -121,9 +121,31 @@ def test_rejects_unsupported_configs():
         FedAC(_wl(), data, FedACConfig(client_optimizer="adam", **base))
     with pytest.raises(ValueError, match="alpha >= 1"):
         FedAC(_wl(), data, FedACConfig(fedac_alpha=0.5, **base))
+
+
+def test_mesh_sharded_fedac_equals_single_chip():
+    """Mesh == single-chip to float tolerance for x^ag AND the coupled x
+    sequence, full and padded cohorts (second case: 4 live clients in 8
+    slots over 4 devices)."""
     from fedml_tpu.parallel.mesh import make_mesh
-    with pytest.raises(ValueError, match="single-chip"):
-        FedAC(_wl(), data, FedACConfig(**base), mesh=make_mesh())
+    for n_clients, m, axis in ((4, 4, 4), (4, 8, 4)):
+        xs, ys = _ill_conditioned_clients(n_clients=n_clients)
+        data = _fed(xs, ys)
+        cfg = dict(fedac_mu=0.1, comm_round=2, client_num_per_round=m,
+                   epochs=2, batch_size=8, lr=0.05,
+                   frequency_of_the_test=100)
+        single = FedAC(_wl(), data, FedACConfig(**cfg))
+        meshed = FedAC(_wl(), data, FedACConfig(**cfg),
+                       mesh=make_mesh(client_axis=axis,
+                                      devices=jax.devices()[:axis]))
+        out_s = single.run(rng=jax.random.key(0))
+        out_m = meshed.run(rng=jax.random.key(0))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6), out_s, out_m)
+        for a, b in zip(jax.tree.leaves(single._x_state),
+                        jax.tree.leaves(meshed._x_state)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
 
 
 def test_cli_fedac_end_to_end():
