@@ -61,14 +61,24 @@ class DPFedAvgConfig(FedAvgConfig):
     dp_delta: float = 1e-5           # δ for the reported ε
 
 
-def make_dp_aggregate(clip: float, noise_multiplier: float):
+def make_dp_aggregate(clip: float, noise_multiplier: float,
+                      psum_axis=None):
     """``aggregate(stacked, weights, global_params, rng)`` — clip each
     client's update, uniform-mean the live slots, add one central
-    Gaussian draw calibrated to sensitivity S/m."""
+    Gaussian draw calibrated to sensitivity S/m.
+
+    ``psum_axis``: when the cohort is sharded over a mesh axis, the
+    per-client clip stays shard-local, the live count and mean cross the
+    axis via psum, and the noise key is identical on every device (rng
+    is replicated), so the ONE central draw replicates exactly — mesh
+    and single-chip runs match even with noise on (parity-tested)."""
+
+    def allsum(v):
+        return jax.lax.psum(v, psum_axis) if psum_axis is not None else v
 
     def aggregate(stacked, weights, global_params, rng):
         live = (weights > 0).astype(jnp.float32)
-        m = jnp.maximum(jnp.sum(live), 1.0)
+        m = jnp.maximum(allsum(jnp.sum(live)), 1.0)
         deltas = jax.tree.map(lambda y, x: y - x[None], stacked,
                               global_params)
         # per-client global L2 norm across the whole pytree -> [C]
@@ -80,7 +90,7 @@ def make_dp_aggregate(clip: float, noise_multiplier: float):
 
         def _mean(d):
             s = scale.reshape((-1,) + (1,) * (d.ndim - 1)).astype(d.dtype)
-            return jnp.sum(d * s, axis=0) / m.astype(d.dtype)
+            return allsum(jnp.sum(d * s, axis=0)) / m.astype(d.dtype)
 
         mean_delta = jax.tree.map(_mean, deltas)
         nrng = jax.random.fold_in(rng, _NOISE_STREAM)
@@ -99,11 +109,11 @@ def make_dp_aggregate(clip: float, noise_multiplier: float):
 class DPFedAvg(FedAvg):
     def __init__(self, workload, data, config: DPFedAvgConfig, mesh=None,
                  sink=None):
-        if mesh is not None:
+        if mesh is not None and jax.process_count() > 1:
             raise ValueError(
-                "dp_fedavg adds ONE central noise draw after a cohort-wide "
-                "clip; the mesh path's per-shard psum aggregate would draw "
-                "per-device noise — run single-chip")
+                "dp_fedavg's central noise draw and accounting are "
+                "verified single-process only; multi-process meshes are "
+                "not wired")
         if config.dp_clip <= 0.0:
             raise ValueError("dp_clip must be > 0")
         if config.dp_noise_multiplier < 0.0:
@@ -113,11 +123,36 @@ class DPFedAvg(FedAvg):
         cfg = config
         # the base class already built the local trainer; only the
         # aggregate differs (clipped uniform mean + central noise)
-        self.cohort_step = make_cohort_step(
-            self._local_train,
-            aggregate=make_dp_aggregate(cfg.dp_clip,
-                                        cfg.dp_noise_multiplier),
-            client_axis=cfg.client_axis)
+        if mesh is None:
+            self.cohort_step = make_cohort_step(
+                self._local_train,
+                aggregate=make_dp_aggregate(cfg.dp_clip,
+                                            cfg.dp_noise_multiplier),
+                client_axis=cfg.client_axis)
+        else:
+            from jax.sharding import PartitionSpec as P
+            from fedml_tpu.parallel.cohort import (
+                make_sharded_stateful_round, train_cohort)
+            local_train = self._local_train
+
+            def _core(params, cohort, rng, psum_axis=None,
+                      index_offset=0):
+                stacked, metrics = train_cohort(
+                    local_train, params, cohort, rng,
+                    index_offset=index_offset,
+                    client_axis=cfg.client_axis)
+                # aggregate built from the wrapper's axis, so the mesh
+                # convention stays defined in ONE place (cohort.py)
+                dp_agg = make_dp_aggregate(cfg.dp_clip,
+                                           cfg.dp_noise_multiplier,
+                                           psum_axis=psum_axis)
+                return dp_agg(stacked, cohort["num_samples"], params,
+                              rng), metrics
+
+            self.cohort_step = make_sharded_stateful_round(
+                _core, mesh,
+                in_specs=(P(), P("clients"), P()),
+                out_specs=(P(), P("clients")))
         # Poisson-approximated q for fixed-size cohorts (core/privacy.py
         # caveat); z=0 yields eps=inf — reported honestly, not hidden
         q = min(cfg.client_num_per_round, data.client_num) \

@@ -164,9 +164,31 @@ def test_rejects_bad_configs(workload):
     with pytest.raises(ValueError, match="noise_multiplier"):
         DPFedAvg(workload, data,
                  DPFedAvgConfig(dp_noise_multiplier=-1.0, **base))
+
+
+@pytest.mark.parametrize("z", [0.0, 1.0])
+def test_mesh_sharded_dp_fedavg_equals_single_chip(workload, z):
+    """Mesh == single-chip for DP-FedAvg even WITH noise on: the clip is
+    per-client (shard-local), the uniform mean psums, and the one
+    central draw uses the replicated rng key so every device adds the
+    IDENTICAL noise.  Includes a padded cohort (4 live in 8 slots over
+    4 devices).  ε accounting must match too."""
     from fedml_tpu.parallel.mesh import make_mesh
-    with pytest.raises(ValueError, match="single-chip"):
-        DPFedAvg(workload, data, DPFedAvgConfig(**base), mesh=make_mesh())
+    for n_clients, m, axis in ((4, 4, 4), (4, 8, 4)):
+        xs, ys = _clients(n_clients=n_clients)
+        data = _fed(xs, ys)
+        cfg = dict(dp_clip=0.5, dp_noise_multiplier=z, comm_round=2,
+                   client_num_per_round=m, epochs=2, batch_size=8,
+                   lr=0.1, frequency_of_the_test=100)
+        single = DPFedAvg(workload, data, DPFedAvgConfig(**cfg))
+        meshed = DPFedAvg(workload, data, DPFedAvgConfig(**cfg),
+                          mesh=make_mesh(client_axis=axis,
+                                         devices=jax.devices()[:axis]))
+        out_s = single.run(rng=jax.random.key(0))
+        out_m = meshed.run(rng=jax.random.key(0))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-6), out_s, out_m)
+        assert single.accountant.epsilon() == meshed.accountant.epsilon()
 
 
 def test_cli_dp_fedavg_end_to_end():

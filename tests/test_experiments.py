@@ -348,6 +348,7 @@ def test_flagship_partial_sink_checkpoints_curve(tmp_path):
     ("feddyn", ["--feddyn_alpha", "0.05"]),
     ("ditto", ["--ditto_lambda", "0.1"]),
     ("fedac", ["--fedac_mu", "0.1"]),
+    ("dp_fedavg", ["--dp_clip", "0.5", "--dp_noise_multiplier", "1.0"]),
 ])
 def test_cli_stateful_mesh_equals_single_chip(devices, algo, extra):
     """--mesh_clients on the stateful/coupled algorithms (whose mesh paths
