@@ -172,7 +172,8 @@ def test_mesh_sharded_dp_fedavg_equals_single_chip(workload, z):
     per-client (shard-local), the uniform mean psums, and the one
     central draw uses the replicated rng key so every device adds the
     IDENTICAL noise.  Includes a padded cohort (4 live in 8 slots over
-    4 devices).  ε accounting must match too."""
+    4 devices).  The accountant must actually count mesh rounds (the
+    counted_step wrapper wraps the sharded step too)."""
     from fedml_tpu.parallel.mesh import make_mesh
     for n_clients, m, axis in ((4, 4, 4), (4, 8, 4)):
         xs, ys = _clients(n_clients=n_clients)
@@ -188,7 +189,10 @@ def test_mesh_sharded_dp_fedavg_equals_single_chip(workload, z):
         out_m = meshed.run(rng=jax.random.key(0))
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-6), out_s, out_m)
-        assert single.accountant.epsilon() == meshed.accountant.epsilon()
+        # the mesh path's counted_step must tick the accountant per round
+        assert meshed.accountant.steps == cfg["comm_round"]
+        if z > 0:
+            assert 0 < meshed.accountant.epsilon() < np.inf
 
 
 def test_cli_dp_fedavg_end_to_end():
