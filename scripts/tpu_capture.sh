@@ -15,14 +15,19 @@
 #
 # Stages:
 #   1. canonical full f32 bench -> BENCH_DETAILS.json (the committed
-#      artifact: honest FLOPs, device_kind, spreads, flash+moe T=2048)
-#   2. bf16 comparison          -> BENCH_DETAILS_bf16.json (BENCH_OUT —
+#      artifact: honest FLOPs, device_kind, spreads, flash+moe T=2048;
+#      bench.py now leads with its own timing-sanity gate — a failed gate
+#      exits 3 and quarantines the artifact)
+#   2. MNIST-LR published row   -> MNIST_LR_TPU.json (VERDICT r4 item 8:
+#      a published accuracy row reproduced end-to-end on the chip;
+#      LR compiles are trivial, so this is the lowest-wedge-risk stage)
+#   3. bf16 comparison          -> BENCH_DETAILS_bf16.json (BENCH_OUT —
 #      never clobbers the canonical artifact)
-#   3. resnet56 investigation   -> BENCH_R56_SPREAD.json (spread repeats,
-#      {vmap,scan} x {f32,bf16} grid, E=20 published-config row;
-#      written incrementally, cell by cell)
-#   4. profiler traces          -> profiles/ (local only, gitignored)
-#   5. flagship accuracy run    -> FLAGSHIP_CURVE.json (the published
+#   4. resnet56 investigation   -> BENCH_R56_SPREAD.json (timing-sanity
+#      gate, then spread repeats, {vmap,scan} x {f32,bf16} grid, E=20
+#      published-config row; written incrementally, cell by cell)
+#   5. profiler traces          -> profiles/ (local only, gitignored)
+#   6. flagship accuracy run    -> FLAGSHIP_CURVE.json (the published
 #      resnet56 config end-to-end; longest stage, so it goes last)
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -44,21 +49,27 @@ probe || { echo "backend unreachable — aborting capture"; exit 1; }
 # tpu_watch.sh keys on to keep retrying instead of declaring COMPLETE
 FAILED=0
 
-echo "== 1/5 canonical full f32 bench (cache-warm; BENCH_DETAILS.json) =="
+echo "== 1/6 canonical full f32 bench (cache-warm; BENCH_DETAILS.json) =="
 timeout 5400 env BENCH_MODE=full BENCH_STALL_S=1500 python bench.py \
   || { echo "stage 1 FAILED or partial (rc=$?) — see BENCH_DETAILS.json.partial"; FAILED=1; }
 
 probe || { echo "tunnel wedged after stage 1 — stopping"; exit 2; }
-echo "== 2/5 bf16 comparison (BENCH_DETAILS_bf16.json) =="
-timeout 3600 env BENCH_DTYPE=bfloat16 BENCH_SCALING=0 BENCH_STALL_S=1500 \
-  BENCH_OUT=BENCH_DETAILS_bf16.json python bench.py \
-  || { echo "stage 2 FAILED or partial (rc=$?)"; FAILED=1; }
+echo "== 2/6 MNIST-LR published accuracy row on-chip (MNIST_LR_TPU.json) =="
+timeout 3600 python scripts/mnist_lr_tpu.py \
+  || { echo "stage 2 FAILED or partial (rc=$?) — see MNIST_LR_TPU.json.partial"; FAILED=1; }
 
 probe || { echo "tunnel wedged after stage 2 — stopping"; exit 2; }
-echo "== 3/5 resnet56 investigation: spreads + client-axis x dtype grid =="
-timeout 3600 python - <<'EOF' || { echo "stage 3 FAILED or partial (rc=$?)"; FAILED=1; }
+echo "== 3/6 bf16 comparison (BENCH_DETAILS_bf16.json) =="
+timeout 3600 env BENCH_DTYPE=bfloat16 BENCH_SCALING=0 BENCH_STALL_S=1500 \
+  BENCH_OUT=BENCH_DETAILS_bf16.json python bench.py \
+  || { echo "stage 3 FAILED or partial (rc=$?)"; FAILED=1; }
+
+probe || { echo "tunnel wedged after stage 3 — stopping"; exit 2; }
+echo "== 4/6 resnet56 investigation: spreads + client-axis x dtype grid =="
+timeout 3600 python - <<'EOF' || { echo "stage 4 FAILED or partial (rc=$?)"; FAILED=1; }
 import json
 import os
+import sys
 import jax
 import bench
 
@@ -70,13 +81,24 @@ def save(out):
 # The measured matmul rate floors it (device_kind is untrusted, bench.py)
 # unless an explicit BENCH_PEAK_TFLOPS pins the denominator
 bench.PEAK_TFLOPS = bench._peak_for_device(jax.devices()[0])
-mm = bench.bench_matmul_peak()
+# timing trust gate first — bench.run_timing_gate is THE gate (sanity
+# probe + retry + matmul-peak plausibility cap), shared with bench.main
+# so the two cannot drift; an untrusted timer makes every grid cell
+# fiction, so bail with the evidence on disk
+sanity, mm, failures = bench.run_timing_gate()
 if not os.environ.get("BENCH_PEAK_TFLOPS"):
     bench.PEAK_TFLOPS = max(bench.PEAK_TFLOPS, mm["bf16"])
 out = {"spread_reps": [], "grid": {},
        "device_kind": jax.devices()[0].device_kind,
+       "timing_sanity": sanity,
        "measured_matmul_tflops": mm,
        "peak_tflops": bench.PEAK_TFLOPS}
+if failures:
+    out["timing_untrusted"] = failures
+    with open("BENCH_R56_SPREAD.json.untrusted", "w") as f:
+        json.dump(out, f, indent=2)
+    print("timing untrusted:", failures)
+    sys.exit(3)
 for rep in range(3):
     round_s, flops, steps, spread = bench.bench_resnet56_cifar10(8)
     out["spread_reps"].append(
@@ -119,8 +141,8 @@ save(out)
 print("wrote BENCH_R56_SPREAD.json")
 EOF
 
-probe || { echo "tunnel wedged after stage 3 — stopping"; exit 2; }
-echo "== 4/5 profiler traces (resnet56 + shakespeare rounds) =="
+probe || { echo "tunnel wedged after stage 4 — stopping"; exit 2; }
+echo "== 5/6 profiler traces (resnet56 + shakespeare rounds) =="
 for cfg in "resnet56 cifar10" "rnn shakespeare"; do
   set -- $cfg
   if ! timeout 1800 python -m fedml_tpu --algo fedavg --model "$1" \
@@ -133,10 +155,10 @@ for cfg in "resnet56 cifar10" "rnn shakespeare"; do
   fi
 done
 
-probe || { echo "tunnel wedged after stage 4 — stopping"; exit 2; }
-echo "== 5/5 flagship accuracy (published resnet56 config, longest) =="
+probe || { echo "tunnel wedged after stage 5 — stopping"; exit 2; }
+echo "== 6/6 flagship accuracy (published resnet56 config, longest) =="
 timeout 14400 python scripts/flagship_accuracy.py \
-  || { echo "stage 5 FAILED or partial (rc=$?) — see FLAGSHIP_CURVE.json.partial"; FAILED=1; }
+  || { echo "stage 6 FAILED or partial (rc=$?) — see FLAGSHIP_CURVE.json.partial"; FAILED=1; }
 
 if [ "$FAILED" -ne 0 ]; then
   echo "capture INCOMPLETE — at least one measurement stage failed or went"

@@ -274,3 +274,132 @@ def test_promote_partial_refuses_cpu_or_empty(tmp_path, monkeypatch):
     assert "skipped" in out
     assert not (tmp_path / "BENCH_PARTIAL_LATEST.json").exists()
     assert "no capture partial" in bench.promote_partial() or True  # path
+
+def test_promote_partial_refuses_mfu_over_one(tmp_path, monkeypatch):
+    """Round-4 verdict item 1, the hard contract: an artifact whose MFU
+    exceeds 1.0 documents a timing failure — it must NEVER reach the
+    committed partial name."""
+    out = _promote(tmp_path, monkeypatch, {
+        "BENCH_DETAILS.json.partial": {
+            "platform": "tpu", "captured_at": 2000.0,
+            "configs": {"femnist_cnn_c10":
+                        {"rounds_per_s": 1500.0, "mfu": 1.14}}}})
+    assert "refused" in out and "mfu" in out
+    assert not (tmp_path / "BENCH_PARTIAL_LATEST.json").exists()
+    # same for a scaling-curve cell over 1.0 (the round-2 128-client case)
+    out2 = _promote(tmp_path, monkeypatch, {
+        "BENCH_DETAILS.json.partial": {
+            "platform": "tpu", "captured_at": 2000.0,
+            "configs": {"femnist_cnn_c10":
+                        {"rounds_per_s": 1500.0, "mfu": 0.4}},
+            "cohort_scaling": {"128": {"rounds_per_s": 99.0, "mfu": 1.57}}}})
+    assert "refused" in out2
+    # and an explicit timing_untrusted mark is refused regardless of mfu
+    out3 = _promote(tmp_path, monkeypatch, {
+        "BENCH_DETAILS.json.partial": {
+            "platform": "tpu", "captured_at": 2000.0,
+            "timing_untrusted": "linearity 1.02",
+            "configs": {"femnist_cnn_c10":
+                        {"rounds_per_s": 1500.0, "mfu": 0.4}}}})
+    assert "refused" in out3 and "timing_untrusted" in out3
+
+
+def test_max_mfu_scans_configs_and_scaling():
+    assert bench._max_mfu({}) == 0.0
+    assert bench._max_mfu({
+        "configs": {"a": {"mfu": 0.3}, "b": {"round_s_xla": 1.0}},
+        "cohort_scaling": {"64": {"mfu": 0.9}, "128": {"mfu": 1.57}},
+    }) == pytest.approx(1.57)
+
+
+def _run_quarantine(tmp_path, checkpointed):
+    import os
+    import subprocess
+    import sys
+    code = (
+        "import json, os, bench\n"
+        f"bench._repo_path = lambda name: os.path.join({str(tmp_path)!r}, name)\n"
+        "bench._WATCH.update(details={'platform': 'tpu', 'configs': {}},\n"
+        "                    out='BENCH_TESTOUT.json',\n"
+        f"                    checkpointed={checkpointed!r})\n"
+        f"open(os.path.join({str(tmp_path)!r}, "
+        "'BENCH_TESTOUT.json.partial', ), 'w').write('{}')\n"
+        "bench._quarantine('linearity ratio 1.02 outside [1.7, 2.3]')\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert proc.returncode == 3, (proc.returncode, proc.stderr)
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_quarantine_writes_untrusted_and_exits_3(tmp_path):
+    """A failed timing self-check must quarantine the artifact under
+    <out>.untrusted (committed names untouched), delete the .partial
+    checkpoint THIS run wrote, emit an honest JSON line, and exit 3 so
+    the capture scripts retry."""
+    line = _run_quarantine(tmp_path, checkpointed=True)
+    assert line["value"] is None
+    assert "linearity" in line["timing_untrusted"]
+    quarantined = json.loads(
+        (tmp_path / "BENCH_TESTOUT.json.untrusted").read_text())
+    assert "linearity" in quarantined["timing_untrusted"]
+    assert not (tmp_path / "BENCH_TESTOUT.json").exists()
+    assert not (tmp_path / "BENCH_TESTOUT.json.partial").exists()
+
+
+def test_quarantine_spares_previous_runs_partial(tmp_path):
+    """A run that fails the gate BEFORE checkpointing anything must not
+    delete a .partial left by an earlier (trusted) run — that evidence
+    is not this run's to destroy."""
+    _run_quarantine(tmp_path, checkpointed=False)
+    assert (tmp_path / "BENCH_TESTOUT.json.partial").exists()
+    assert (tmp_path / "BENCH_TESTOUT.json.untrusted").exists()
+
+
+def test_timing_sanity_on_cpu_backend():
+    """The gate itself, end-to-end on the CPU backend: a synchronous
+    backend must pass all three checks (linearity, sync, checksum) and
+    report a finite verified throughput.  Retried like main() does: this
+    1-core container's background probes can blur one timing run."""
+    out = bench.bench_timing_sanity(n=512, iters=4)
+    if not out["trusted"]:
+        out = bench.bench_timing_sanity(n=512, iters=4)
+    assert out["trusted"], out["failures"]
+    assert np.isfinite(out["checksum"])
+    assert out["tflops_readback_verified"] > 0
+
+
+def test_emit_skipped_refuses_mfu_over_one_carry(tmp_path, monkeypatch,
+                                                 capsys):
+    """The carry path honors the same contract: a committed partial whose
+    own MFU exceeds 1.0 (the round-4 artifact) must not be carried as
+    evidence — fall through to the clean artifact."""
+    line = _emit_skipped_line(tmp_path, monkeypatch, capsys, {
+        "BENCH_DETAILS.json": {
+            "platform": "tpu", "captured_at": 1000.0,
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 300.0,
+                                                   "mfu": 0.3}}},
+        "BENCH_PARTIAL_LATEST.json": {
+            "platform": "tpu", "captured_at": 2000.0,
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 4058.0,
+                                                   "mfu": 3.08}}}})
+    assert "partial_capture" not in line
+    assert line["value"] == pytest.approx(300.0)
+    assert line["stale"] is True
+
+
+def test_watchdog_stall_with_mfu_over_one_not_quoted(tmp_path):
+    """A mid-run wedge whose measured configs read mfu > 1.0 must NOT
+    quote those values as the evidence line (same contract as
+    promote_partial) — it falls back to the skip-on-wedge shape."""
+    line = _run_stalled(tmp_path, {
+        "details": {"platform": "tpu",
+                    "configs": {"femnist_cnn_c10":
+                                {"rounds_per_s": 1507.0, "mfu": 1.14}}},
+        "out": "BENCH_TESTOUT.json", "torch_s": 2.0,
+        "stage": "resnet56", "beat": 0.0})
+    assert line["value"] is None
+    assert "vs_baseline" not in line
+    # the .partial stays on disk for forensics but promotion refuses it
+    part = json.loads((tmp_path / "BENCH_TESTOUT.json.partial").read_text())
+    assert part["configs"]["femnist_cnn_c10"]["mfu"] == 1.14
