@@ -109,16 +109,12 @@ class Ditto(FedAvg):
     ``mesh=`` shards the clients axis: the global stream rides FedAvg's
     sharded cohort step and the personal pass is a pure shard_map (no
     cross-client reductions; matches single-chip to float tolerance —
-    parity-tested).  v_i stays host-resident; single-process meshes only
-    (the per-round scatter gathers the cohort's rows to one host)."""
+    parity-tested).  v_i stays host-resident; multi-process meshes ride
+    the shared wrap (make_sharded_stateful_round: global input staging +
+    replicated state outputs, every process mirrors the full state)."""
 
     def __init__(self, workload, data, config: DittoConfig, mesh=None,
                  sink=None):
-        if mesh is not None and jax.process_count() > 1:
-            raise ValueError(
-                "ditto's personalized models are host-resident and the "
-                "cohort scatter gathers them to one host; multi-process "
-                "meshes are not wired — run a single-process mesh")
         if getattr(workload, "stateful", False):
             raise ValueError(
                 "ditto does not support stateful (BatchNorm) workloads: "

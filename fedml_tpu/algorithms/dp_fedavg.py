@@ -109,11 +109,6 @@ def make_dp_aggregate(clip: float, noise_multiplier: float,
 class DPFedAvg(FedAvg):
     def __init__(self, workload, data, config: DPFedAvgConfig, mesh=None,
                  sink=None):
-        if mesh is not None and jax.process_count() > 1:
-            raise ValueError(
-                "dp_fedavg's central noise draw and accounting are "
-                "verified single-process only; multi-process meshes are "
-                "not wired")
         if config.dp_clip <= 0.0:
             raise ValueError("dp_clip must be > 0")
         if config.dp_noise_multiplier < 0.0:

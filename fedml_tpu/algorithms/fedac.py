@@ -114,15 +114,11 @@ class FedAC(FedAvg):
 
     ``mesh=`` shards the cohort's clients axis (shared round body +
     shard_map/psum; matches single-chip to float tolerance —
-    parity-tested); single-process meshes only."""
+    parity-tested); multi-process meshes ride the shared wrap's global
+    input staging (the x sequence is replicated server state)."""
 
     def __init__(self, workload, data, config: FedACConfig, mesh=None,
                  sink=None):
-        if mesh is not None and jax.process_count() > 1:
-            raise ValueError(
-                "fedac couples a second server sequence host-side; "
-                "multi-process meshes are not wired — run a "
-                "single-process mesh")
         if config.client_optimizer != "sgd":
             raise ValueError(
                 "fedac's local update IS the accelerated rule (Yuan&Ma'20 "

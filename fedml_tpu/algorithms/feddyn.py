@@ -92,16 +92,12 @@ class FedDyn(FedAvg):
 
     ``mesh=`` shards the cohort's clients axis across devices (shard_map +
     psum; matches single-chip to float tolerance — parity-tested); the
-    λ_k state stays host-resident either way.  Single-process meshes
-    only: the per-round scatter gathers the updated rows to one host."""
+    λ_k state stays host-resident either way.  Multi-process meshes
+    ride the shared wrap (make_sharded_stateful_round: global input
+    staging + replicated state outputs; every process mirrors the state)."""
 
     def __init__(self, workload, data, config: FedDynConfig, mesh=None,
                  sink=None):
-        if mesh is not None and jax.process_count() > 1:
-            raise ValueError(
-                "feddyn's correction state is host-resident and the cohort "
-                "scatter gathers it to one host; multi-process meshes are "
-                "not wired — run a single-process mesh")
         if config.client_optimizer != "sgd":
             raise ValueError(
                 "feddyn's local solver is SGD on the dynamically "

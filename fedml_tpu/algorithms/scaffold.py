@@ -95,16 +95,14 @@ class Scaffold(FedAvg):
     ``mesh=`` shards the cohort's clients axis across devices (shard_map +
     psum; matches single-chip to float tolerance — the psum reassociates
     the reduction order — parity-tested); the c_i state stays
-    host-resident either way.  Single-process meshes only: the per-round
-    scatter gathers the updated cohort variates to one host."""
+    host-resident either way.  Multi-process meshes work through the
+    shared wrap (make_sharded_stateful_round): inputs are staged global,
+    and the updated cohort variates come back replicated (in-mesh
+    all_gather), so every process scatters the same rows into its own
+    host mirror — 2-proc×4-device parity in tests/test_multihost.py."""
 
     def __init__(self, workload, data, config: ScaffoldConfig, mesh=None,
                  sink=None):
-        if mesh is not None and jax.process_count() > 1:
-            raise ValueError(
-                "scaffold's control variates are host-resident and the "
-                "cohort scatter gathers them to one host; multi-process "
-                "meshes are not wired — run a single-process mesh")
         if config.client_optimizer != "sgd":
             raise ValueError(
                 "scaffold's local update is plain SGD with control-variate "

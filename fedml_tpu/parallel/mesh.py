@@ -141,12 +141,20 @@ def stage_global(tree: Any, mesh: Optional[Mesh], spec: Optional[P] = None):
 
     ``spec=None`` replicates (params / rng keys); ``P("clients")`` shards
     the leading cohort axis.
+
+    IDEMPOTENT: a leaf that is already a global (not fully addressable)
+    jax.Array — e.g. the previous round's output fed back in, or an
+    argument a caller staged earlier — passes through untouched, so
+    layered staging (FedAvg.run stages params/cohort/rng; the stateful
+    mesh wrap re-stages every positional arg) is safe.
     """
     if mesh is None or jax.process_count() == 1:
         return tree
     sharding = NamedSharding(mesh, spec if spec is not None else P())
 
     def mk(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x  # already global (idempotent staging)
         if hasattr(x, "dtype") and jax.dtypes.issubdtype(
                 x.dtype, jax.dtypes.prng_key):
             # typed PRNG keys can't round-trip through numpy; globalize the
