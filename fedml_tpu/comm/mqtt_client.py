@@ -1,0 +1,149 @@
+"""Minimal MQTT 3.1.1 client with the paho surface MqttTransport uses.
+
+The reference's transport rides ``paho.mqtt.client``
+(mqtt_comm_manager.py:47-56); paho is not installed here, so this class
+speaks the same wire protocol itself (mqtt_wire framing over one TCP
+socket) and mimics exactly the paho API slice the transport touches:
+``Client(client_id=)``, ``connect``, ``subscribe``, ``publish``,
+``loop_start``/``loop_stop``, ``disconnect``, and the ``on_message``
+callback receiving an object with ``.topic``/``.payload``.
+
+Blocking semantics chosen for correctness of the federated choreography:
+
+* ``connect`` performs the CONNECT/CONNACK handshake synchronously and
+  then starts the reader thread, so no inbound frame can be lost in a
+  paho-style connect→loop_start gap;
+* ``subscribe`` waits for the matching SUBACK — when it returns, the
+  broker IS routing to this client (the fire-and-forget alternative
+  races any publisher that was unblocked by this subscribe);
+* ``publish`` at QoS 1 sends with a packet id and returns; the PUBACK is
+  drained by the reader (at-least-once fire-and-forget, matching the
+  transport's at-most-once inbox semantics);
+* CONNECT advertises keepalive 0 — §3.1.2.10 disables the broker's
+  inactivity timer, so a silo idling at an upload barrier for minutes is
+  never dropped and no PINGREQ scheduler is needed;
+* an UNEXPECTED connection loss (broker died, TCP reset) invokes
+  ``on_disconnect(client, userdata, rc=1)`` from the reader thread —
+  callers that block on inbound messages must map it to a wakeup or
+  they would wedge silently.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import types
+from typing import Optional
+
+from fedml_tpu.comm import mqtt_wire as w
+
+
+class MiniMqttClient:
+    def __init__(self, client_id: str = ""):
+        self.client_id = client_id or "fedml-tpu"
+        self.on_message = None
+        self.on_disconnect = None  # (client, userdata, rc) on UNEXPECTED loss
+        self._sock: Optional[socket.socket] = None
+        self._reader: Optional[threading.Thread] = None
+        self._wlock = threading.Lock()
+        self._pid = 0
+        self._suback = threading.Event()
+        self._closing = False
+
+    # -- paho surface ------------------------------------------------------
+    def connect(self, host: str, port: int = 1883,
+                keepalive: int = 0) -> None:
+        self._sock = socket.create_connection((host, port), timeout=30)
+        body = (w.encode_string("MQTT") + bytes([4])   # protocol level 4
+                + bytes([0x02])                        # clean session
+                + struct.pack(">H", keepalive)
+                + w.encode_string(self.client_id))
+        self._send(w.make_packet(w.CONNECT, 0, body))
+        pkt = w.read_packet(self._sock)
+        if pkt is None or pkt[0] != w.CONNACK or pkt[2][1] != 0:
+            raise ConnectionError(f"MQTT CONNECT refused: {pkt!r}")
+        self._sock.settimeout(None)
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name=f"mqtt-{self.client_id}",
+                                        daemon=True)
+        self._reader.start()
+
+    def subscribe(self, topic: str, qos: int = 0) -> None:
+        self._suback.clear()
+        body = (struct.pack(">H", self._next_pid())
+                + w.encode_string(topic) + bytes([qos]))
+        self._send(w.make_packet(w.SUBSCRIBE, 0x02, body))
+        if not self._suback.wait(timeout=10):
+            raise TimeoutError(f"no SUBACK for {topic!r}")
+
+    def publish(self, topic: str, payload: bytes, qos: int = 0) -> None:
+        payload = bytes(payload)
+        head = w.encode_string(topic)
+        if qos:
+            head += struct.pack(">H", self._next_pid())
+        self._send(w.make_packet(w.PUBLISH, (qos & 0x3) << 1,
+                                 head + payload))
+
+    def loop_start(self) -> None:
+        pass  # the reader runs from connect() — see module docstring
+
+    def loop_stop(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        self._closing = True
+        try:
+            self._send(w.make_packet(w.DISCONNECT, 0, b""))
+        except OSError:
+            pass
+        try:  # shutdown wakes the reader's blocked recv(); close alone
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._reader is not None:
+            self._reader.join(timeout=5)
+
+    # -- internals ---------------------------------------------------------
+    def _next_pid(self) -> int:
+        self._pid = self._pid % 0xFFFF + 1
+        return self._pid
+
+    def _send(self, packet: bytes) -> None:
+        with self._wlock:
+            self._sock.sendall(packet)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                pkt = w.read_packet(self._sock)
+                if pkt is None:
+                    self._lost()
+                    return
+                ptype, flags, body = pkt
+                if ptype == w.PUBLISH:
+                    topic, off = w.decode_string(body, 0)
+                    if (flags >> 1) & 0x3:
+                        (pid,) = struct.unpack_from(">H", body, off)
+                        off += 2
+                        self._send(w.make_packet(
+                            w.PUBACK, 0, struct.pack(">H", pid)))
+                    if self.on_message is not None:
+                        self.on_message(self, None, types.SimpleNamespace(
+                            topic=topic, payload=body[off:]))
+                elif ptype == w.SUBACK:
+                    self._suback.set()
+                # PUBACK / PINGRESP / UNSUBACK: drained
+        except (OSError, ValueError):
+            self._lost()
+
+    def _lost(self) -> None:
+        """Unexpected connection loss: tell the owner from the reader
+        thread (a silent reader exit would wedge anything blocking on
+        inbound messages)."""
+        if not self._closing and self.on_disconnect is not None:
+            self.on_disconnect(self, None, 1)

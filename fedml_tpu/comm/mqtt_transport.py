@@ -7,19 +7,18 @@ server→client and ``fedml0_<sender>`` for client→server, JSON payloads.
 
 Differences: broker host/port are constructor args (the reference hardcodes
 a broker IP in ``client_manager.py:23-26``); payloads are the binary array
-frames of `fedml_tpu.comm.message` published as MQTT bytes.  Requires
-``paho-mqtt``, which is optional — import of this module raises a clear
-error if the dependency is absent (the rest of the framework never needs it).
+frames of `fedml_tpu.comm.message` published as MQTT bytes.  ``paho-mqtt``
+is used when installed; without it the transport falls back to the
+in-repo ``MiniMqttClient`` (comm/mqtt_client.py), which speaks the same
+MQTT 3.1.1 wire protocol over a real TCP socket — so the transport is
+fully functional in this sandbox against the in-repo loopback broker
+(comm/mqtt_broker.py) or any external MQTT 3.1.1 daemon.
 
-Validation decision (documented end state): this transport is verified
-against a FAKE in-process broker (tests/test_comm.py) that reproduces the
-paho client surface (connect/subscribe/publish/callbacks, topic routing,
-QoS-0 at-most-once) — the part of the stack this module owns.  A live
-interop smoke needs a real broker plus paho, neither of which exists in
-the build sandbox (no mosquitto binary, no paho/amqtt/hbmqtt, installs
-disallowed); anyone deploying against a real broker gets the reference's
-exact semantics because the topic scheme and payload framing here are
-byte-for-byte what the fake asserts.
+Validation: the fake-paho test (tests/test_comm.py) pins the topic
+scheme + payload codec in isolation, and tests/test_mqtt_broker.py runs
+the FULL cross-silo FedAvg choreography over real TCP MQTT framing
+(MiniMqttClient ↔ MqttBroker) — the live-broker interop the reference
+only ever ran manually (mqtt_comm_manager.py has no test).
 """
 
 from __future__ import annotations
@@ -37,21 +36,27 @@ except ImportError:  # pragma: no cover - environment without paho-mqtt
     HAVE_MQTT = False
 
 _STOP = object()
+_LOST = object()   # unexpected broker disconnect (MiniMqttClient)
 
 
 class MqttTransport(Transport):
     def __init__(self, node_id: int, broker_host: str, broker_port: int = 1883,
                  topic_prefix: str = "fedml_tpu"):
-        if not HAVE_MQTT:
-            raise ImportError(
-                "paho-mqtt is not installed; MqttTransport is unavailable. "
-                "Use GrpcTransport or LocalTransport instead.")
         super().__init__()
         self.node_id = node_id
         self.topic_prefix = topic_prefix
         self._inbox: "queue.Queue" = queue.Queue()
         cid = f"{topic_prefix}_{node_id}"
-        if hasattr(_mqtt, "CallbackAPIVersion"):  # paho-mqtt >= 2.0
+        if not HAVE_MQTT:
+            # no paho: the in-repo MQTT 3.1.1 client speaks the same wire
+            # protocol over a real socket (works against mqtt_broker.py or
+            # any external 3.1.1 daemon).  An unexpected broker loss wakes
+            # run() with ConnectionError instead of wedging the inbox.
+            from fedml_tpu.comm.mqtt_client import MiniMqttClient
+            self._client = MiniMqttClient(client_id=cid)
+            self._client.on_disconnect = (
+                lambda c, u, rc: self._inbox.put(_LOST))
+        elif hasattr(_mqtt, "CallbackAPIVersion"):  # paho-mqtt >= 2.0
             self._client = _mqtt.Client(_mqtt.CallbackAPIVersion.VERSION1,
                                         client_id=cid)
         else:
@@ -76,6 +81,9 @@ class MqttTransport(Transport):
             item = self._inbox.get()
             if item is _STOP:
                 return
+            if item is _LOST:
+                raise ConnectionError(
+                    "MQTT broker connection lost (unexpected disconnect)")
             self._notify(item)
 
     def stop(self) -> None:

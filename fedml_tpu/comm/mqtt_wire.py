@@ -1,0 +1,107 @@
+"""MQTT 3.1.1 wire framing — the byte-level subset shared by the in-repo
+loopback broker (mqtt_broker.py) and the minimal client (mqtt_client.py).
+
+Reference anchor: the reference's MQTT backend
+(fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:99-120)
+delegates framing to paho and runs against a live daemon; neither exists
+in this build sandbox, so the frame codec lives here, in ~100 lines of
+spec (MQTT 3.1.1, OASIS §2-§3): fixed header = packet type/flags byte +
+variable-length Remaining Length (7 bits per byte, MSB = continuation),
+UTF-8 strings with 2-byte big-endian length prefixes.
+
+Only the packet types the pub/sub choreography needs are modeled:
+CONNECT/CONNACK, PUBLISH (QoS 0/1) + PUBACK, SUBSCRIBE/SUBACK,
+UNSUBSCRIBE/UNSUBACK, PINGREQ/PINGRESP, DISCONNECT.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional, Tuple
+
+CONNECT = 1
+CONNACK = 2
+PUBLISH = 3
+PUBACK = 4
+SUBSCRIBE = 8
+SUBACK = 9
+UNSUBSCRIBE = 10
+UNSUBACK = 11
+PINGREQ = 12
+PINGRESP = 13
+DISCONNECT = 14
+
+
+def encode_varint(n: int) -> bytes:
+    """Remaining Length encoding (spec §2.2.3): 7 bits per byte, MSB set
+    while more bytes follow; max 4 bytes (268 435 455)."""
+    if n < 0 or n > 0x0FFFFFFF:
+        raise ValueError(f"remaining length {n} out of MQTT range")
+    out = bytearray()
+    while True:
+        n, digit = divmod(n, 128)
+        out.append(digit | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def encode_string(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">H", len(b)) + b
+
+
+def decode_string(buf: bytes, off: int) -> Tuple[str, int]:
+    (n,) = struct.unpack_from(">H", buf, off)
+    off += 2
+    return buf[off:off + n].decode("utf-8"), off + n
+
+
+def make_packet(ptype: int, flags: int, body: bytes) -> bytes:
+    return bytes([(ptype << 4) | (flags & 0x0F)]) + encode_varint(
+        len(body)) + body
+
+
+def _read_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def read_packet(sock: socket.socket
+                ) -> Optional[Tuple[int, int, bytes]]:
+    """Blocking read of one full control packet; None on clean EOF."""
+    head = _read_exact(sock, 1)
+    if head is None:
+        return None
+    ptype, flags = head[0] >> 4, head[0] & 0x0F
+    length, shift = 0, 0
+    while True:
+        b = _read_exact(sock, 1)
+        if b is None:
+            return None
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 21:
+            raise ValueError("malformed MQTT remaining length")
+    body = _read_exact(sock, length) if length else b""
+    if body is None:
+        return None
+    return ptype, flags, body
+
+
+def topic_matches(filt: str, topic: str) -> bool:
+    """MQTT topic-filter matching (spec §4.7): '+' one level, '#' tail."""
+    fl, tl = filt.split("/"), topic.split("/")
+    for i, f in enumerate(fl):
+        if f == "#":
+            return True
+        if i >= len(tl) or (f != "+" and f != tl[i]):
+            return False
+    return len(fl) == len(tl)

