@@ -25,7 +25,14 @@ honest version:
   arange, keeping the FedAvg parity case bit-identical);
 * an RDP moments accountant (core/privacy.py) composes the subsampled
   Gaussian over rounds with q = cohort/N and reports ε at ``dp_delta``
-  in every eval row — the number the reference never computes.
+  in every eval row — the number the reference never computes.  By
+  default the accountant uses the fixed-size without-replacement bound
+  (``dp_accounting="fixed_size"``) — a rigorous bound that APPLIES to
+  the sampler actually used (choice without replacement, replace-one
+  adjacency), which the Poisson analysis does not;
+  ``dp_accounting="poisson"`` selects the literature-standard Poisson
+  approximation instead (optimistic for this sampler — documented in
+  core/privacy.py).
 
 The whole defended round stays ONE jit: the per-client clip, the noisy
 uniform mean, and the single central noise draw are fused into the
@@ -38,6 +45,7 @@ cannot change the weighting or add one shared draw.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Dict
 
 import jax
@@ -59,6 +67,10 @@ class DPFedAvgConfig(FedAvgConfig):
     dp_clip: float = 1.0             # S: per-user update L2 bound
     dp_noise_multiplier: float = 1.0  # z: noise std = S·z/m on the mean
     dp_delta: float = 1e-5           # δ for the reported ε
+    # "fixed_size": rigorous bound for the fixed-size without-
+    # replacement sampler actually used (WBK'19, replace-one adjacency);
+    # "poisson": the literature-standard approximation (core/privacy.py)
+    dp_accounting: str = "fixed_size"
 
 
 def make_dp_aggregate(clip: float, noise_multiplier: float,
@@ -114,6 +126,11 @@ class DPFedAvg(FedAvg):
         if config.dp_noise_multiplier < 0.0:
             raise ValueError("dp_noise_multiplier must be >= 0 "
                              "(0 = clipped, non-private FedAvg)")
+        if config.dp_accounting not in ("fixed_size", "poisson"):
+            raise ValueError(
+                f"unknown dp_accounting {config.dp_accounting!r}; use "
+                "'fixed_size' (valid for the sampler used) or 'poisson' "
+                "(literature approximation)")
         super().__init__(workload, data, config, mesh=mesh, sink=sink)
         cfg = config
         # the base class already built the local trainer; only the
@@ -148,12 +165,16 @@ class DPFedAvg(FedAvg):
                 _core, mesh,
                 in_specs=(P(), P("clients"), P()),
                 out_specs=(P(), P("clients")))
-        # Poisson-approximated q for fixed-size cohorts (core/privacy.py
-        # caveat); z=0 yields eps=inf — reported honestly, not hidden
+        # q for the cohort fraction; z=0 yields eps=inf — reported
+        # honestly, not hidden.  The analysis matches the config:
+        # fixed_size = valid bound for the choice(replace=False) sampler,
+        # poisson = the documented approximation (core/privacy.py)
         q = min(cfg.client_num_per_round, data.client_num) \
             / data.client_num
-        self.accountant = RdpAccountant(q, cfg.dp_noise_multiplier,
-                                        cfg.dp_delta)
+        self.accountant = RdpAccountant(
+            q, cfg.dp_noise_multiplier, cfg.dp_delta,
+            sampling=("fixed_size_wor" if cfg.dp_accounting == "fixed_size"
+                      else "poisson"))
         base_step = self.cohort_step
 
         def counted_step(params, cohort, rng):
@@ -190,13 +211,48 @@ class DPFedAvg(FedAvg):
         out["dp_delta"] = self.accountant.delta
         return out
 
-    # the accountant's round count rides the checkpoint so a resumed run
-    # keeps reporting the TOTAL privacy spent, not just the tail's
+    # the accountant's round count AND the secret sampling chain ride the
+    # checkpoint: a resumed run keeps reporting the TOTAL privacy spent,
+    # and post-resume cohorts continue the ORIGINAL run's secret schedule
+    # even if run() is resumed with a different rng argument (advisor r4:
+    # re-deriving _sample_base from the resume rng would silently fork
+    # the cohort schedule while the accountant composes as one run).
+    # Typed keys pass through as-is — RoundCheckpointer packs/unpacks
+    # them (utils/checkpoint.py _pack_keys).
     def _extra_state(self):
-        return {"dp_rounds": self.accountant.steps}
+        return {"dp_rounds": self.accountant.steps,
+                "sample_base": self._sample_base}
 
     def _extra_state_template(self, params):
-        return {"dp_rounds": 0}
+        t = {"dp_rounds": 0}
+        if not getattr(self, "_legacy_extra", False):
+            t["sample_base"] = jax.random.key(0)
+        return t
 
     def _load_extra_state(self, extra) -> None:
         self.accountant.steps = int(extra["dp_rounds"])
+        if "sample_base" in extra:
+            self._sample_base = extra["sample_base"]
+        # legacy checkpoint (pre sample_base): keep the chain run()
+        # derived from the rng argument — the pre-change behavior,
+        # correct when resume passes the original run's rng
+
+    def _maybe_resume(self, checkpointer, params, rng):
+        try:
+            return super()._maybe_resume(checkpointer, params, rng)
+        except Exception:
+            if checkpointer is None or checkpointer.latest_round() is None:
+                raise
+            # migration: a pre-change checkpoint has no sample_base entry
+            # and fails the new restore template — retry with the legacy
+            # template and fall back to the rng-derived chain
+            self._legacy_extra = True
+            try:
+                out = super()._maybe_resume(checkpointer, params, rng)
+            finally:
+                self._legacy_extra = False
+            logging.getLogger(__name__).warning(
+                "resumed a legacy dp_fedavg checkpoint (no sample_base): "
+                "the secret cohort schedule is re-derived from the rng "
+                "argument — pass the ORIGINAL run's rng or cohorts fork")
+            return out

@@ -62,6 +62,7 @@ class ExperimentConfig:
     dp_clip: float = 1.0                 # dp_fedavg: per-user L2 bound S
     dp_noise_multiplier: float = 1.0     # dp_fedavg: z (std = S·z/m)
     dp_delta: float = 1e-5               # dp_fedavg: δ for reported ε
+    dp_accounting: str = "fixed_size"    # dp_fedavg: fixed_size | poisson
     gmf: float = 0.0                     # FedNova global momentum factor
     norm_bound: float = 5.0              # robust: clip threshold
     stddev: float = 0.025                # robust: weak-DP noise

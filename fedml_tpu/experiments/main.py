@@ -432,7 +432,8 @@ def run_dp_fedavg(cfg, data, mesh, sink):
     algo = DPFedAvg(wl, data, DPFedAvgConfig(
         dp_clip=cfg.dp_clip,
         dp_noise_multiplier=cfg.dp_noise_multiplier,
-        dp_delta=cfg.dp_delta, **_fedavg_cfg_kwargs(cfg)),
+        dp_delta=cfg.dp_delta, dp_accounting=cfg.dp_accounting,
+        **_fedavg_cfg_kwargs(cfg)),
         mesh=mesh, sink=sink)
     algo.run(checkpointer=_make_checkpointer(cfg))
     return algo.history[-1] if algo.history else {}

@@ -234,3 +234,92 @@ def test_cohort_sampling_is_secret_not_the_public_chain(workload):
     for c in a:
         assert len(c) == 2 and len(set(c)) == 2
         assert all(0 <= i < data.client_num for i in c)
+
+
+def test_resume_with_different_rng_keeps_secret_cohort_schedule(
+        workload, tmp_path):
+    """Advisor r4: the secret sampling chain must ride the checkpoint.
+    A run resumed with a DIFFERENT rng argument must continue the
+    ORIGINAL run's cohort schedule (and therefore reproduce the full
+    run's params exactly at z=0), not silently fork it while the
+    accountant composes as one run."""
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+    xs, ys = _clients(n_clients=6)
+    data = _fed(xs, ys)
+    cfg = dict(comm_round=4, client_num_per_round=2, epochs=1,
+               batch_size=8, lr=0.1, frequency_of_the_test=100, seed=3)
+    mk = lambda rounds: DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_clip=100.0, dp_noise_multiplier=0.0,
+        **{**cfg, "comm_round": rounds}))
+
+    p_full = mk(4).run(rng=jax.random.key(0))
+
+    half = mk(2)
+    half.run(rng=jax.random.key(0),
+             checkpointer=RoundCheckpointer(str(tmp_path / "ck"),
+                                            save_every=1))
+    resumed = mk(4)
+    # deliberately different rng on resume: the checkpointed sample_base
+    # must win, so cohorts (and thus params at z=0) match the full run
+    p_res = resumed.run(rng=jax.random.key(99),
+                        checkpointer=RoundCheckpointer(
+                            str(tmp_path / "ck"), save_every=1))
+    for a, b in zip(jax.tree.leaves(p_full), jax.tree.leaves(p_res)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_accounting_mode_default_exact_and_poisson_option(workload):
+    """The default accountant is the fixed-size WOR bound (valid for
+    the sampler used); --dp_accounting poisson selects the approximation,
+    which reads strictly lower epsilon at the same config."""
+    xs, ys = _clients(n_clients=6)
+    data = _fed(xs, ys)
+    cfg = dict(comm_round=3, client_num_per_round=2, epochs=1,
+               batch_size=8, lr=0.1, frequency_of_the_test=100)
+    exact = DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_noise_multiplier=1.0, **cfg))
+    assert exact.accountant.sampling == "fixed_size_wor"
+    poisson = DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_noise_multiplier=1.0, dp_accounting="poisson", **cfg))
+    assert poisson.accountant.sampling == "poisson"
+    exact.accountant.step(3)
+    poisson.accountant.step(3)
+    assert exact.accountant.epsilon() > poisson.accountant.epsilon() > 0
+    with pytest.raises(ValueError):
+        DPFedAvg(workload, data, DPFedAvgConfig(
+            dp_accounting="bogus", **cfg))
+
+
+def test_resume_from_legacy_checkpoint_without_sample_base(
+        workload, tmp_path, monkeypatch):
+    """Migration: a pre-round-5 checkpoint (extra = dp_rounds only) must
+    still resume — falling back to the rng-derived sampling chain (the
+    old behavior), which is correct when the resume passes the ORIGINAL
+    run's rng."""
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+    xs, ys = _clients(n_clients=6)
+    data = _fed(xs, ys)
+    cfg = dict(comm_round=4, client_num_per_round=2, epochs=1,
+               batch_size=8, lr=0.1, frequency_of_the_test=100, seed=3)
+    mk = lambda rounds: DPFedAvg(workload, data, DPFedAvgConfig(
+        dp_clip=100.0, dp_noise_multiplier=0.0,
+        **{**cfg, "comm_round": rounds}))
+
+    p_full = mk(4).run(rng=jax.random.key(0))
+
+    # write the checkpoint the OLD code would have written
+    monkeypatch.setattr(
+        DPFedAvg, "_extra_state",
+        lambda self: {"dp_rounds": self.accountant.steps})
+    half = mk(2)
+    half.run(rng=jax.random.key(0),
+             checkpointer=RoundCheckpointer(str(tmp_path / "ck"),
+                                            save_every=1))
+    monkeypatch.undo()
+
+    resumed = mk(4)
+    p_res = resumed.run(rng=jax.random.key(0),
+                        checkpointer=RoundCheckpointer(
+                            str(tmp_path / "ck"), save_every=1))
+    for a, b in zip(jax.tree.leaves(p_full), jax.tree.leaves(p_res)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
