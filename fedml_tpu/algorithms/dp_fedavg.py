@@ -240,8 +240,13 @@ class DPFedAvg(FedAvg):
     def _maybe_resume(self, checkpointer, params, rng):
         try:
             return super()._maybe_resume(checkpointer, params, rng)
-        except Exception:
-            if checkpointer is None or checkpointer.latest_round() is None:
+        except Exception as e:
+            # only the legacy-layout mismatch earns the retry: an
+            # unrelated restore failure (shape change, corrupt write)
+            # must surface as ITSELF, not as a misleading sample_base
+            # structure error from the legacy-template attempt
+            if (checkpointer is None or checkpointer.latest_round() is None
+                    or "sample_base" not in str(e)):
                 raise
             # migration: a pre-change checkpoint has no sample_base entry
             # and fails the new restore template — retry with the legacy

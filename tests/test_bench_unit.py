@@ -403,3 +403,20 @@ def test_watchdog_stall_with_mfu_over_one_not_quoted(tmp_path):
     # the .partial stays on disk for forensics but promotion refuses it
     part = json.loads((tmp_path / "BENCH_TESTOUT.json.partial").read_text())
     assert part["configs"]["femnist_cnn_c10"]["mfu"] == 1.14
+
+
+def test_agg_kernels_flagship_wiring_toy_size():
+    """The flagship Pallas-vs-XLA rows must be wired correctly BEFORE a
+    live capture reaches them (a mid-capture API break costs a tunnel
+    window): run the full function on CPU (interpret mode) at toy size
+    and check the row contract."""
+    from fedml_tpu.models import LogisticRegression
+    from fedml_tpu.trainer.workload import ClassificationWorkload
+    wl = ClassificationWorkload(LogisticRegression(16, 4), num_classes=4)
+    rows = bench.bench_agg_kernels_flagship(
+        iters=2, clients=4, workload=wl, sample_shape=(4, 16))
+    assert set(rows) == {"robust_agg_r56_f32", "robust_agg_r56_bf16",
+                         "secagg_mask_r56_f32"}
+    for name, r in rows.items():
+        assert r["xla_ms"] > 0 and r["pallas_ms"] > 0
+        assert r["speedup"] == pytest.approx(r["xla_ms"] / r["pallas_ms"])
