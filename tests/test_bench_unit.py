@@ -6,6 +6,7 @@ path and validated against hand math in BENCH notes; these tests pin
 the host-side logic that never touches an accelerator.)"""
 
 import json
+import os
 
 import numpy as np
 import pytest
@@ -420,3 +421,38 @@ def test_agg_kernels_flagship_wiring_toy_size():
     for name, r in rows.items():
         assert r["xla_ms"] > 0 and r["pallas_ms"] > 0
         assert r["speedup"] == pytest.approx(r["xla_ms"] / r["pallas_ms"])
+
+
+def test_capture_script_api_contract():
+    """scripts/tpu_capture.sh stage 4's embedded python calls this exact
+    bench surface; an API drift discovered mid-capture would burn a live
+    tunnel window, so pin it here.  Also parse the embedded script."""
+    import inspect
+    import re
+    import subprocess
+
+    assert callable(bench.run_timing_gate)
+    assert callable(bench.bench_matmul_peak)
+    assert callable(bench._peak_for_device)
+    assert isinstance(bench._PEAK_SANITY_CAP_TFLOPS, float)
+    sig = inspect.signature(bench.bench_resnet56_cifar10)
+    assert {"rounds", "samples", "epochs",
+            "client_axis"} <= set(sig.parameters)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sh = open(os.path.join(repo, "scripts", "tpu_capture.sh")).read()
+    # EVERY embedded python block must parse (the liveness probe AND the
+    # ~70-line stage-4 grid script; a lone re.search would only see the
+    # first)
+    blocks = re.findall(r"python - <<'EOF'[^\n]*\n(.*?)\nEOF", sh,
+                        re.S)
+    assert len(blocks) >= 2, "expected probe + stage-4 heredocs"
+    for i, block in enumerate(blocks):
+        compile(block, f"tpu_capture_heredoc_{i}", "exec")
+    assert any("run_timing_gate" in b for b in blocks), \
+        "stage-4 heredoc no longer runs the shared timing gate"
+    # the shell itself must parse too
+    subprocess.run(["bash", "-n", os.path.join(repo, "scripts",
+                                               "tpu_capture.sh")],
+                   check=True)
+
