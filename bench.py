@@ -1139,6 +1139,8 @@ def _emit_skipped(partial_stage=None):
                            f"wedged during {partial_stage!r} before any "
                            "config completed; nothing measured this run")
 
+    refused = []
+
     def _load(name):
         try:
             with open(_repo_path(name)) as f:
@@ -1150,7 +1152,14 @@ def _emit_skipped(partial_stage=None):
         if last.get("timing_untrusted") or _max_mfu(last) > 1.0:
             # the round-4 lesson: an artifact whose own MFU exceeds 1.0
             # documents a timing failure — its rounds/s must not be
-            # carried forward as evidence either
+            # carried forward as evidence either.  Say so, or a null
+            # line reads like "never measured" instead of "retracted".
+            why = (f"timing_untrusted ({last['timing_untrusted']})"
+                   if last.get("timing_untrusted")
+                   else f"max mfu {_max_mfu(last):.2f} > 1.0")
+            refused.append(
+                f"{name}: {why} — retracted under the timing trust "
+                "contract; re-capture staged (scripts/tpu_capture.sh)")
             return None
         cfgs = last.get("configs", {})
         scan = cfgs.get("femnist_cnn_c10_scan20", {}).get("rounds_per_s")
@@ -1182,6 +1191,8 @@ def _emit_skipped(partial_stage=None):
         clean["source"] = ("committed BENCH_DETAILS.json — STALE, from a "
                            "previous clean TPU run, not this one")
         line["last_good_tpu"] = clean
+    if line["value"] is None and refused:
+        line["committed_artifacts_refused"] = refused
     print(json.dumps(line))
 
 

@@ -456,3 +456,35 @@ def test_capture_script_api_contract():
                                                "tpu_capture.sh")],
                    check=True)
 
+
+
+def test_emit_skipped_explains_refused_artifacts(tmp_path, monkeypatch,
+                                                 capsys):
+    """When every committed artifact is refused under the trust contract,
+    the null line must say RETRACTED (with the reason), not read like
+    'never measured' — the round-2 table at HEAD is exactly this case
+    (cohort-scaling cell at mfu 1.57)."""
+    line = _emit_skipped_line(tmp_path, monkeypatch, capsys, {
+        "BENCH_DETAILS.json": {
+            "platform": "tpu",
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 3710.0,
+                                                   "mfu": 0.08}},
+            "cohort_scaling": {"128": {"mfu": 1.57}}}})
+    assert line["value"] is None
+    assert any("retracted" in r for r in line["committed_artifacts_refused"])
+
+
+def test_emit_skipped_refusal_names_the_actual_cause(tmp_path, monkeypatch,
+                                                     capsys):
+    """A timing_untrusted artifact with healthy mfu must be refused FOR
+    THAT REASON — not blamed on a nonexistent mfu violation."""
+    line = _emit_skipped_line(tmp_path, monkeypatch, capsys, {
+        "BENCH_DETAILS.json": {
+            "platform": "tpu",
+            "timing_untrusted": "linearity ratio 1.02 outside [1.7, 2.3]",
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 3710.0,
+                                                   "mfu": 0.08}}}})
+    assert line["value"] is None
+    (reason,) = line["committed_artifacts_refused"]
+    assert "linearity ratio 1.02" in reason
+    assert "mfu" not in reason.split("—")[0]
