@@ -81,14 +81,24 @@ def load_synthetic(alpha: float = 0.5, beta: float = 0.5, iid: bool = False,
 
 def mnist_learnable_twin(num_clients: int = 1000, class_num: int = 10,
                          dim: int = 784, batch_size: int = 10,
-                         noise: float = 0.9, max_samples: int = 64,
+                         noise: float = 7.0, max_samples: int = 64,
                          seed: int = 0) -> FederatedData:
     """A LEARNABLE MNIST stand-in for convergence validation: each class is
     a random prototype vector, samples are prototype + N(0, noise), client
     sizes follow the LEAF power law (lognormal), class mix per client is
     non-uniform (two dominant classes per client, like LEAF MNIST's
-    power-law label skew).  Logistic regression reaches >90% here, mirroring
-    real MNIST-LR learnability (benchmark/README.md:12 target >75)."""
+    power-law label skew).
+
+    The default noise is calibrated so the published MNIST-LR config
+    (benchmark/README.md:12 — 1000 clients, 10/round, B=10, lr=0.03,
+    E=1) NEEDS its >100-round budget and lands where real MNIST-LR
+    lands: measured train acc 0.11 → 0.54 → 0.73 → 0.81 → 0.86 at
+    rounds 0/30/60/90/119 (seed 0; 0.88 at seed 1), comfortably past
+    the >75 target but far from saturation.  The earlier noise=0.9
+    setting separated classes by ~40σ along the discriminant — LR hit
+    1.0 within 30 rounds and the published budget proved nothing (the
+    same saturating-proxy trap the CIFAR twin had; see
+    FLAGSHIP_TWIN_KWARGS)."""
     rng = np.random.RandomState(seed)
     protos = rng.randn(class_num, dim).astype(np.float32)
     sizes = np.minimum(rng.lognormal(3.0, 1.0, num_clients).astype(int) + 8,

@@ -67,7 +67,9 @@ def test_rnn_charlm_federated_learning_to_target():
 def test_mnist_lr_to_75():
     """benchmark/README.md:12 — MNIST LR FedAvg: >75 train acc @ >100
     rounds, 1000 clients, 10/round, B=10, SGD lr=0.03, E=1 (hermetic
-    learnable twin standing in for LEAF MNIST)."""
+    learnable twin standing in for LEAF MNIST; twin noise calibrated so
+    the >100-round budget is genuinely needed — 0.54 at round 30,
+    0.86 at 119 — instead of saturating at 1.0 within 30 rounds)."""
     data = mnist_learnable_twin(num_clients=1000, batch_size=10, seed=0)
     wl = ClassificationWorkload(
         LogisticRegression(input_dim=784, output_dim=10), num_classes=10,
