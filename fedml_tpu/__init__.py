@@ -23,3 +23,33 @@ Layer map (mirrors SURVEY.md §1 of the reference):
 """
 
 __version__ = "0.1.0"
+
+# Curated top-level API, resolved lazily: `import fedml_tpu` stays
+# instant (no jax/flax import at package import time — the CLI and tests
+# rely on picking the platform BEFORE anything queries devices), while
+# `fedml_tpu.FedAvg` etc. work as a library user expects.
+_API = {
+    "FedAvg": "fedml_tpu.algorithms",
+    "FedAvgConfig": "fedml_tpu.algorithms",
+    "load_data": "fedml_tpu.data",
+    "make_mesh": "fedml_tpu.parallel.mesh",
+    "make_cohort_step": "fedml_tpu.parallel.cohort",
+    "ClassificationWorkload": "fedml_tpu.trainer.workload",
+    "NWPWorkload": "fedml_tpu.trainer.workload",
+    "make_client_optimizer": "fedml_tpu.trainer.workload",
+    "make_local_trainer": "fedml_tpu.trainer.local_sgd",
+    "RoundCheckpointer": "fedml_tpu.utils.checkpoint",
+}
+
+__all__ = sorted(_API) + ["__version__"]
+
+
+def __getattr__(name: str):
+    if name in _API:
+        import importlib
+        return getattr(importlib.import_module(_API[name]), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_API))

@@ -363,3 +363,29 @@ def test_cli_stateful_mesh_equals_single_chip(devices, algo, extra):
                                rtol=1e-5)
     np.testing.assert_allclose(single["train_acc"], sharded["train_acc"],
                                rtol=1e-5)
+
+
+def test_top_level_api_lazy_exports():
+    """`import fedml_tpu` must stay cheap (no jax import at package
+    import time — platform selection must still be possible afterwards),
+    while the curated names resolve lazily and point at the real
+    objects."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # fresh interpreter: importing the package must not pull in jax
+    code = (f"import sys; sys.path.insert(0, {repo!r}); "
+            "import fedml_tpu; "
+            "assert 'jax' not in sys.modules, 'package import pulled jax'; "
+            "print('lazy-ok')")
+    proc = subprocess.run([sys.executable, "-S", "-c", code],
+                          capture_output=True, text=True)
+    assert "lazy-ok" in proc.stdout, proc.stderr
+
+    import fedml_tpu
+    from fedml_tpu.algorithms import FedAvg
+    assert fedml_tpu.FedAvg is FedAvg
+    assert "FedAvg" in dir(fedml_tpu)
+    with pytest.raises(AttributeError):
+        fedml_tpu.not_a_symbol
