@@ -32,18 +32,23 @@ FedAvgClientActor choreography — INIT/SYNC in, MODEL out.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-from fedml_tpu.comm.actors import ServerManager
+from fedml_tpu.comm.actors import SelfMessageTimer, ServerManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
 from fedml_tpu.algorithms.cross_silo import MsgType
 from fedml_tpu.core.sampling import sample_clients
 
 log = logging.getLogger(__name__)
+
+# server self-message from the re-task watchdog timer (value continues
+# the MsgType numbering in algorithms/cross_silo.py)
+MSG_RETASK_TICK = 7
 
 
 def delta_encoder(new_params, global_params):
@@ -66,7 +71,21 @@ class AsyncFedServerActor(ServerManager):
                  num_versions: int, aggregation_goal: int,
                  staleness_exponent: float = 0.5, server_lr: float = 1.0,
                  on_version: Optional[Callable[[int, object], None]] = None,
-                 seed: int = 0):
+                 seed: int = 0, checkpointer=None,
+                 retask_timeout_s: Optional[float] = None):
+        """``checkpointer``: a `RoundCheckpointer`; every applied version
+        is saved per its ``save_every`` gating and ``start()`` resumes
+        from the latest saved version — a crashed async server restarts
+        mid-federation instead of from version 0.
+
+        ``retask_timeout_s``: liveness watchdog.  The FedBuff tasking
+        rule re-tasks only the silos whose uploads were CONSUMED — if a
+        silo's upload is lost on the wire, that silo falls out of
+        rotation, and once fewer than ``aggregation_goal`` silos remain
+        active the server wedges.  With a watchdog, any silo quiet for
+        this long is re-tasked with a fresh assignment against the
+        current global (a duplicate from a silo that was merely slow is
+        handled by the at-most-once buffer guard)."""
         super().__init__(0, transport)
         if not 1 <= aggregation_goal <= n_silos:
             raise ValueError(
@@ -84,18 +103,77 @@ class AsyncFedServerActor(ServerManager):
         self.staleness_seen: List[int] = []  # per consumed upload
         self._buffer: List[Tuple[object, float, float, int]] = []
         self._task_rng = np.random.RandomState(seed)
+        self.checkpointer = checkpointer
+        self.retask_timeout_s = retask_timeout_s
+        self._last_heard: Dict[int, float] = {}
+        self._retask_timer = SelfMessageTimer()
+        # (silo, base_version) pairs already aggregated — the at-most-once
+        # guard must survive buffer flushes, not just scan the live buffer
+        self._consumed: set = set()
+        self._finished = False
 
     def register_handlers(self) -> None:
         self.register_handler(MsgType.C2S_MODEL, self._on_model)
+        self.register_handler(MSG_RETASK_TICK, self._on_retask_tick)
 
     # -- tasking -----------------------------------------------------------
     def start(self) -> None:
         """Initial tasking: version-0 assignments use the same seeded
         sampler as the synchronous paths, so goal == n_silos reduces to
-        the FedAvg round-0 cohort."""
+        the FedAvg round-0 cohort.  With a ``checkpointer`` holding a
+        saved version, the server resumes from it and re-tasks every
+        silo against the restored global."""
+        if self.checkpointer is not None:
+            step = self.checkpointer.latest_round()
+            if step is not None:
+                state = self.checkpointer.restore(
+                    step, like=self._checkpoint_state())
+                self.params = state["params"]
+                self.version = int(np.asarray(state["version"]))
+                log.info("resumed from checkpoint: continuing at version "
+                         "%d of %d", self.version, self.num_versions)
+        if self.version >= self.num_versions:
+            for silo in range(1, self.n_silos + 1):
+                self.send(MsgType.S2C_FINISH, silo)
+            self.finish()
+            return
         ids = sample_clients(0, self.client_num_in_total, self.n_silos)
+        now = time.monotonic()
         for silo, client_idx in enumerate(ids, start=1):
+            self._last_heard[silo] = now
             self._task(silo, int(client_idx), MsgType.S2C_INIT)
+        self._arm_retask_timer()
+
+    # -- liveness watchdog --------------------------------------------------
+    def _arm_retask_timer(self) -> None:
+        if self.retask_timeout_s is None:
+            return
+        # fire only ENQUEUES a self-message; the re-task scan runs on the
+        # transport's event loop like every other handler
+        self._retask_timer.arm(self.retask_timeout_s,
+                               lambda: self.send(MSG_RETASK_TICK, 0))
+
+    def _cancel_retask_timer(self, join: bool = False) -> None:
+        self._retask_timer.cancel(join=join)
+
+    def _on_retask_tick(self, msg: Message) -> None:
+        if self.version >= self.num_versions:
+            return
+        now = time.monotonic()
+        # a silo with an upload sitting in the buffer is waiting on the
+        # version to close, not lost — re-tasking it would only produce a
+        # duplicate the at-most-once guard rejects
+        buffered = {s for _, _, _, s, _ in self._buffer}
+        for silo in range(1, self.n_silos + 1):
+            if silo in buffered:
+                continue
+            quiet = now - self._last_heard.get(silo, now)
+            if quiet >= self.retask_timeout_s:
+                log.warning("silo %d quiet for %.1fs; re-tasking against "
+                            "version %d", silo, quiet, self.version)
+                self._last_heard[silo] = now  # one nudge per timeout window
+                self._task(silo, self._next_client())
+        self._arm_retask_timer()
 
     def _task(self, silo: int, client_idx: int, msg_type=MsgType.S2C_SYNC):
         host_params = jax.tree.map(np.asarray, self.params)
@@ -107,24 +185,44 @@ class AsyncFedServerActor(ServerManager):
     def _next_client(self) -> int:
         return int(self._task_rng.randint(self.client_num_in_total))
 
+    def _checkpoint_state(self) -> dict:
+        """Version-state pytree (fixed shapes — doubles as the orbax
+        restore template)."""
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "version": np.asarray(self.version, np.int64)}
+
     # -- aggregation -------------------------------------------------------
     def _on_model(self, msg: Message) -> None:
+        self._last_heard[msg.sender_id] = time.monotonic()
         if self.version >= self.num_versions:
             return  # late upload after FINISH
+        base_version = int(msg.get(Message.ARG_ROUND))
+        if (msg.sender_id, base_version) in self._consumed or \
+                any(s == msg.sender_id and b == base_version
+                    for _, _, _, s, b in self._buffer):
+            # at-most-once guard: a duplicated frame (lossy wire re-send,
+            # chaos dup, or a watchdog re-task racing a slow upload) must
+            # not count the same update twice — whether its first copy is
+            # still buffered or was already aggregated into a version
+            log.warning("ignoring duplicate version-%d upload from silo %d",
+                        base_version, msg.sender_id)
+            return
         delta = msg.get(Message.ARG_MODEL_PARAMS)
         num_samples = float(msg.get(Message.ARG_NUM_SAMPLES))
-        base_version = int(msg.get(Message.ARG_ROUND))
         staleness = self.version - base_version
         discount = float(1.0 + staleness) ** (-self.alpha)
         self.staleness_seen.append(staleness)
-        self._buffer.append((delta, num_samples, discount, msg.sender_id))
+        self._buffer.append(
+            (delta, num_samples, discount, msg.sender_id, base_version))
         if len(self._buffer) >= self.goal:
             self._apply_buffer()
 
     def _apply_buffer(self) -> None:
-        deltas = [d for d, _, _, _ in self._buffer]
-        samples = np.asarray([n for _, n, _, _ in self._buffer], np.float64)
-        discounts = np.asarray([c for _, _, c, _ in self._buffer], np.float64)
+        deltas = [d for d, _, _, _, _ in self._buffer]
+        samples = np.asarray([n for _, n, _, _, _ in self._buffer],
+                             np.float64)
+        discounts = np.asarray([c for _, _, c, _, _ in self._buffer],
+                               np.float64)
         # Sample ratios sum to 1; the staleness discount multiplies each
         # term afterwards so stale buffers shrink the applied step itself.
         coeffs = discounts * samples / max(samples.sum(), 1e-12)
@@ -136,9 +234,14 @@ class AsyncFedServerActor(ServerManager):
             lambda p, d: (np.asarray(p, np.float64)
                           + self.server_lr * d).astype(np.asarray(p).dtype),
             self.params, mean)
-        silos = [s for _, _, _, s in self._buffer]
+        silos = [s for _, _, _, s, _ in self._buffer]
+        self._consumed.update((s, b) for _, _, _, s, b in self._buffer)
         self._buffer.clear()
         self.version += 1
+        if self.checkpointer is not None:
+            self.checkpointer.maybe_save(
+                self.version - 1, self._checkpoint_state(),
+                last_round=self.version >= self.num_versions)
         if self.on_version is not None:
             self.on_version(self.version, self.params)
         if self.version >= self.num_versions:
@@ -148,3 +251,8 @@ class AsyncFedServerActor(ServerManager):
             return
         for silo in silos:  # only the consumed silos need new work
             self._task(silo, self._next_client())
+
+    def finish(self) -> None:
+        self._finished = True
+        self._cancel_retask_timer(join=True)
+        super().finish()

@@ -23,12 +23,14 @@ from __future__ import annotations
 import logging
 import math
 import threading
-from typing import Callable, Dict, Optional
+import time
+from typing import Callable, Dict, Optional, Set
 
 import jax
 import numpy as np
 
-from fedml_tpu.comm.actors import ClientManager, ServerManager
+from fedml_tpu.comm.actors import (ClientManager, SelfMessageTimer,
+                                   ServerManager)
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
 from fedml_tpu.core.pytree import tree_weighted_mean
@@ -44,6 +46,80 @@ class MsgType:
     C2S_MODEL = 3         # MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
     S2C_FINISH = 4        # shutdown signal (reference uses MPI Abort instead)
     ROUND_TIMEOUT = 5     # server self-message from the straggler timer
+    C2S_HEARTBEAT = 6     # silo liveness beat (drives the FailureDetector)
+
+
+class FailureDetector:
+    """Heartbeat-driven silo health registry: ALIVE → SUSPECT → DEAD.
+
+    The reference has no notion of silo health at all — a dead client is
+    indistinguishable from a slow one and the barrier waits forever
+    (FedAvgServerManager.py:51).  This detector is the standard
+    timeout-hierarchy design: every message from a silo (heartbeat OR
+    model upload) is a *beat*; a silo unheard for ``suspect_after_s`` is
+    SUSPECT (still counted in the round barrier, but flagged), and one
+    unheard for ``dead_after_s`` is DEAD.  Dead silos are excluded from
+    the next round's expected quorum, so the drop policy stops re-paying
+    the full round timeout for a silo that is known to be gone.
+
+    DEAD is sticky until the silo is heard from again: the first beat
+    from a declared-dead silo reports a *rejoin*, which the server
+    answers with the current global model + round index so the silo can
+    re-enter the federation at the next round's broadcast.
+
+    ``clock`` is injectable for deterministic tests.
+    """
+
+    ALIVE = "alive"
+    SUSPECT = "suspect"
+    DEAD = "dead"
+
+    def __init__(self, suspect_after_s: float = 2.0,
+                 dead_after_s: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if dead_after_s < suspect_after_s:
+            raise ValueError(
+                f"dead_after_s ({dead_after_s}) must be >= suspect_after_s "
+                f"({suspect_after_s})")
+        self.suspect_after_s = suspect_after_s
+        self.dead_after_s = dead_after_s
+        self._clock = clock
+        self._last_heard: Dict[int, float] = {}
+        self._declared_dead: Set[int] = set()
+
+    def register(self, silo: int) -> None:
+        """Start the clock for a silo without marking a real beat (called
+        at federation start so nobody is born dead)."""
+        self._last_heard.setdefault(silo, self._clock())
+
+    def beat(self, silo: int) -> bool:
+        """Record a liveness beat.  Returns True when this beat REJOINS a
+        silo previously declared dead."""
+        rejoined = silo in self._declared_dead
+        self._declared_dead.discard(silo)
+        self._last_heard[silo] = self._clock()
+        return rejoined
+
+    def state(self, silo: int) -> str:
+        if silo in self._declared_dead:
+            return self.DEAD
+        last = self._last_heard.get(silo)
+        if last is None:
+            return self.ALIVE  # never registered: benefit of the doubt
+        quiet = self._clock() - last
+        if quiet >= self.dead_after_s:
+            self._declared_dead.add(silo)  # sticky until the next beat
+            return self.DEAD
+        if quiet >= self.suspect_after_s:
+            return self.SUSPECT
+        return self.ALIVE
+
+    def states(self) -> Dict[int, str]:
+        return {silo: self.state(silo) for silo in sorted(self._last_heard)}
+
+    def dead_silos(self) -> Set[int]:
+        return {silo for silo in self._last_heard
+                if self.state(silo) == self.DEAD}
 
 
 # a silo-local trainer: (global_params, client_idx, round_idx) ->
@@ -62,7 +138,9 @@ class FedAvgServerActor(ServerManager):
                  straggler_policy: str = "wait",
                  round_timeout_s: Optional[float] = None,
                  min_silo_frac: float = 0.5,
-                 decode_upload: Optional[Callable] = None):
+                 decode_upload: Optional[Callable] = None,
+                 failure_detector: Optional[FailureDetector] = None,
+                 checkpointer=None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -70,11 +148,27 @@ class FedAvgServerActor(ServerManager):
         * ``straggler_policy="wait"`` — reference-parity strict barrier;
           with a timeout set it logs the missing silos and keeps waiting.
         * ``"drop"`` — after ``round_timeout_s``, aggregate the silos that
-          DID report, provided at least ``min_silo_frac`` of the cohort
-          arrived (else keep waiting); stragglers' late uploads are
+          DID report, provided at least ``min_silo_frac`` of the live
+          cohort arrived (else keep waiting); stragglers' late uploads are
           discarded by the round tag.
         * ``"abort"`` — after the timeout, send FINISH to every silo and
           stop (the clean version of the reference's MPI abort).
+
+        ``failure_detector``: when set, silo health (driven by heartbeats
+        and uploads) feeds the round barrier — silos declared DEAD are
+        excluded from the expected quorum at broadcast time (logged in
+        ``dropped_silos``), so the drop policy closes rounds as soon as
+        the live cohort reports instead of re-paying the full timeout
+        every round.  A dead silo that is heard from again *rejoins*: it
+        immediately receives the current global + round index and is
+        re-included from the next broadcast.
+
+        ``checkpointer``: a `fedml_tpu.utils.checkpoint.RoundCheckpointer`;
+        when set, every completed round's (params, round_idx, accepted
+        silos) is saved per its ``save_every`` gating, and ``start()``
+        resumes from the latest checkpoint if one exists — a crashed and
+        restarted server continues the federation instead of restarting
+        it from round 0.
         """
         super().__init__(0, transport)
         if straggler_policy not in ("wait", "drop", "abort"):
@@ -93,10 +187,14 @@ class FedAvgServerActor(ServerManager):
         # -> params (comm/compress.py rides here — uploads compressed, the
         # down-link broadcast stays exact)
         self.decode_upload = decode_upload
+        self.failure_detector = failure_detector
+        self.checkpointer = checkpointer
         self.dropped_silos: Dict[int, list] = {}  # round -> missing silo ids
         self._received: Dict[int, tuple] = {}
         self._num_silos = 0  # silos contacted this round (= sampled cohort)
-        self._timer: Optional[threading.Timer] = None
+        self._expected: Set[int] = set()  # silos the barrier waits on
+        self._timer = SelfMessageTimer()
+        self._finished = False
         # silo ids whose uploads were aggregated last round, sent with the
         # next sync so silos can settle deferred error-feedback residuals
         # (a dropped upload must carry its FULL delta forward)
@@ -105,10 +203,37 @@ class FedAvgServerActor(ServerManager):
     def register_handlers(self) -> None:
         self.register_handler(MsgType.C2S_MODEL, self._on_model)
         self.register_handler(MsgType.ROUND_TIMEOUT, self._on_timeout)
+        self.register_handler(MsgType.C2S_HEARTBEAT, self._on_heartbeat)
 
     # -- round logic ---------------------------------------------------------
     def start(self) -> None:
-        """Broadcast initial config (send_init_msg, FedAvgServerManager.py:31-39)."""
+        """Broadcast initial config (send_init_msg, FedAvgServerManager.py:31-39).
+
+        With a ``checkpointer`` attached, a server that finds a saved
+        round on disk resumes from it: params, round index, and the
+        error-feedback ack all restore, and the broadcast picks up at the
+        round after the last completed one."""
+        if self.checkpointer is not None:
+            step = self.checkpointer.latest_round()
+            if step is not None:
+                state = self.checkpointer.restore(
+                    step, like=self._checkpoint_state(step))
+                self.params = state["params"]
+                self.round_idx = int(np.asarray(state["round_idx"])) + 1
+                mask = np.asarray(state["accepted_mask"])
+                self._last_accepted = (
+                    (np.flatnonzero(mask) + 1).astype(np.int32)
+                    if mask.any() else None)
+                log.info("resumed from checkpoint: continuing at round %d "
+                         "of %d", self.round_idx, self.num_rounds)
+        if self.round_idx >= self.num_rounds:
+            # the federation already completed on disk: just dismiss silos
+            cohort = len(sample_clients(0, self.client_num_in_total,
+                                        self.client_num_per_round))
+            for silo in range(1, cohort + 1):
+                self.send(MsgType.S2C_FINISH, silo)
+            self.finish()
+            return
         self._broadcast(MsgType.S2C_INIT)
 
     def _sampled(self) -> np.ndarray:
@@ -117,15 +242,51 @@ class FedAvgServerActor(ServerManager):
         return sample_clients(self.round_idx, self.client_num_in_total,
                               self.client_num_per_round)
 
+    def _checkpoint_state(self, round_idx: int) -> Dict[str, object]:
+        """Round-state pytree saved after round ``round_idx`` completes.
+        Every leaf has a restart-independent shape (the accepted-silo set
+        rides as a fixed-length mask, not a variable-length id list) so
+        the same structure doubles as the orbax restore template."""
+        cohort = len(sample_clients(0, self.client_num_in_total,
+                                    self.client_num_per_round))
+        mask = np.zeros(cohort, np.int8)
+        if self._last_accepted is not None:
+            mask[np.asarray(self._last_accepted) - 1] = 1
+        return {"params": jax.tree.map(np.asarray, self.params),
+                "round_idx": np.asarray(round_idx, np.int64),
+                "accepted_mask": mask}
+
     def _broadcast(self, msg_type) -> None:
         ids = self._sampled()
         # sample_clients caps the cohort at client_num_in_total, so the
         # receive barrier must track the actual cohort size, not the config
         self._num_silos = len(ids)
+        cohort = set(range(1, self._num_silos + 1))
+        dead: Set[int] = set()
+        if self.failure_detector is not None:
+            for silo in cohort:
+                self.failure_detector.register(silo)
+            dead = self.failure_detector.dead_silos() & cohort
+            if dead == cohort:
+                # every silo dead: fall back to expecting the full cohort
+                # (the classic timeout path), so a rejoin can still revive
+                # the federation instead of the barrier closing on nothing
+                dead = set()
+        # silos already known dead are dropped AT BROADCAST: they are
+        # logged for this round immediately and the barrier never waits
+        # on them (the quorum "shrinks" instead of re-paying the timeout)
+        self._expected = cohort - dead
+        if dead:
+            log.info("round %d: excluding dead silos %s from the quorum",
+                     self.round_idx, sorted(dead))
+            self.dropped_silos.setdefault(self.round_idx, []).extend(
+                sorted(dead))
         host_params = jax.tree.map(np.asarray, self.params)
         extra = ({} if self._last_accepted is None
                  else {Message.ARG_ACCEPTED: self._last_accepted})
         for silo, client_idx in enumerate(ids, start=1):
+            if silo in dead:
+                continue
             self.send(msg_type, silo,
                       **{Message.ARG_MODEL_PARAMS: host_params,
                          Message.ARG_CLIENT_INDEX: int(client_idx),
@@ -136,30 +297,28 @@ class FedAvgServerActor(ServerManager):
     def _arm_timer(self) -> None:
         if self.round_timeout_s is None:
             return
-        self._cancel_timer()
         round_at_arm = self.round_idx
-        # the timer thread only ENQUEUES a self-message; all policy logic
-        # runs on the transport's event loop, so handler state stays
-        # single-threaded (SURVEY.md §5.2)
-        self._timer = threading.Timer(
+        # fire only ENQUEUES a self-message; all policy logic runs on the
+        # transport's event loop, so handler state stays single-threaded
+        # (SURVEY.md §5.2)
+        self._timer.arm(
             self.round_timeout_s,
             lambda: self.send(MsgType.ROUND_TIMEOUT, 0,
                               **{Message.ARG_ROUND: round_at_arm}))
-        self._timer.daemon = True
-        self._timer.start()
 
-    def _cancel_timer(self) -> None:
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
+    def _cancel_timer(self, join: bool = False) -> None:
+        self._timer.cancel(join=join)
 
     def _on_timeout(self, msg: Message) -> None:
         if msg.get(Message.ARG_ROUND) != self.round_idx:
             return  # stale timer from an already-completed round
-        missing = sorted(set(range(1, self._num_silos + 1))
-                         - set(self._received))
+        missing = sorted(self._expected - set(self._received))
         if not missing:
             return
+        if self.failure_detector is not None:
+            states = self.failure_detector.states()
+            log.warning("round %d: silo health %s", self.round_idx,
+                        {s: states.get(s, "?") for s in missing})
         log.warning("round %d: silos %s have not reported after %.1fs "
                     "(policy=%s)", self.round_idx, missing,
                     self.round_timeout_s, self.straggler_policy)
@@ -169,14 +328,42 @@ class FedAvgServerActor(ServerManager):
                 self.send(MsgType.S2C_FINISH, silo)
             self.finish()
             return
-        quorum = max(1, math.ceil(self.min_silo_frac * self._num_silos))
+        # quorum over the EXPECTED (live) cohort: dead-excluded silos
+        # neither count toward nor against it
+        quorum = max(1, math.ceil(self.min_silo_frac * len(self._expected)))
         if self.straggler_policy == "drop" and len(self._received) >= quorum:
-            self.dropped_silos[self.round_idx] = missing
+            self.dropped_silos.setdefault(self.round_idx, []).extend(missing)
             self._complete_round()
             return
         self._arm_timer()  # wait (or drop below quorum): keep waiting
 
+    # -- health --------------------------------------------------------------
+    def _on_heartbeat(self, msg: Message) -> None:
+        self._beat(msg.sender_id)
+
+    def _beat(self, silo: int) -> None:
+        if self.failure_detector is None:
+            return
+        rejoined = self.failure_detector.beat(silo)
+        if rejoined and not self._finished and not self.aborted \
+                and self.round_idx < self.num_rounds:
+            # rejoin protocol: the returning silo immediately gets the
+            # current global + round index (+ a client assignment), so it
+            # is warm when the next broadcast re-includes it.  Its upload
+            # for THIS round is not expected (the quorum already closed
+            # over its absence) and will be discarded by _on_model.
+            log.info("silo %d rejoined at round %d; syncing current global",
+                     silo, self.round_idx)
+            ids = self._sampled()
+            client_idx = int(ids[silo - 1]) if silo - 1 < len(ids) else 0
+            self.send(MsgType.S2C_SYNC, silo,
+                      **{Message.ARG_MODEL_PARAMS:
+                         jax.tree.map(np.asarray, self.params),
+                         Message.ARG_CLIENT_INDEX: client_idx,
+                         Message.ARG_ROUND: self.round_idx})
+
     def _on_model(self, msg: Message) -> None:
+        self._beat(msg.sender_id)
         # stale-round guard: a straggler's upload arriving after its round
         # was closed out (drop policy) must not pollute the next barrier
         upload_round = msg.get(Message.ARG_ROUND)
@@ -184,6 +371,14 @@ class FedAvgServerActor(ServerManager):
             log.warning("discarding round-%s upload from silo %d (current "
                         "round %d)", upload_round, msg.sender_id,
                         self.round_idx)
+            return
+        if self._expected and msg.sender_id not in self._expected:
+            # an upload from a silo outside the expected quorum (it was
+            # declared dead at broadcast, then rejoined mid-round): the
+            # round's accounting already closed over it — drop, it will
+            # participate again from the next broadcast
+            log.info("discarding round-%d upload from unexpected silo %d",
+                     self.round_idx, msg.sender_id)
             return
         # barrier semantics: wait for every sampled silo
         # (check_whether_all_receive, FedAvgServerManager.py:51)
@@ -206,18 +401,28 @@ class FedAvgServerActor(ServerManager):
             upload = self.decode_upload(upload, self.params)
         self._received[msg.sender_id] = (
             upload, msg.get(Message.ARG_NUM_SAMPLES))
-        if len(self._received) < self._num_silos:
+        if self._expected:
+            if not self._expected <= set(self._received):
+                return
+        elif len(self._received) < self._num_silos:
             return
         self._complete_round()
 
     def _complete_round(self) -> None:
         self._cancel_timer()
+        if self.round_idx in self.dropped_silos:  # normalize the drop log
+            self.dropped_silos[self.round_idx] = sorted(
+                set(self.dropped_silos[self.round_idx]))
         trees = [self._received[s][0] for s in sorted(self._received)]
         weights = np.array([self._received[s][1] for s in sorted(self._received)],
                            dtype=np.float32)
         self._last_accepted = np.asarray(sorted(self._received), np.int32)
         self._received.clear()
         self.params = tree_weighted_mean(trees, weights)
+        if self.checkpointer is not None:
+            self.checkpointer.maybe_save(
+                self.round_idx, self._checkpoint_state(self.round_idx),
+                last_round=self.round_idx + 1 >= self.num_rounds)
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.params)
         self.round_idx += 1
@@ -229,17 +434,26 @@ class FedAvgServerActor(ServerManager):
             self._broadcast(MsgType.S2C_SYNC)
 
     def finish(self) -> None:
-        self._cancel_timer()
+        self._finished = True
+        self._cancel_timer(join=True)
         super().finish()
 
 
 class FedAvgClientActor(ClientManager):
-    """Silo-side trainer actor (reference FedAvgClientManager.py:18-75)."""
+    """Silo-side trainer actor (reference FedAvgClientManager.py:18-75).
+
+    ``heartbeat_interval_s``: when set, a daemon thread sends
+    C2S_HEARTBEAT beats (tagged with the last synced round) every
+    interval while the actor runs — the signal the server's
+    `FailureDetector` uses to tell a slow silo from a dead one between
+    uploads.  The thread stops with ``finish()``.
+    """
 
     def __init__(self, node_id: int, transport: Transport,
                  train_fn: SiloTrainFn,
                  encode_upload: Optional[Callable] = None,
-                 on_accepted: Optional[Callable] = None):
+                 on_accepted: Optional[Callable] = None,
+                 heartbeat_interval_s: Optional[float] = None):
         super().__init__(node_id, transport)
         self.train_fn = train_fn
         # optional wire compression: encode_upload(new_params,
@@ -249,16 +463,45 @@ class FedAvgClientActor(ClientManager):
         # every sync BEFORE training, so deferred error-feedback residuals
         # settle (ErrorFeedback.resolve) before the next encode reads them
         self.on_accepted = on_accepted
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self._round: Optional[int] = None  # last round synced from server
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
 
     def register_handlers(self) -> None:
         self.register_handler(MsgType.S2C_INIT, self._on_sync)
         self.register_handler(MsgType.S2C_SYNC, self._on_sync)
         self.register_handler(MsgType.S2C_FINISH, lambda m: self.finish())
 
+    def run(self) -> None:
+        if self.heartbeat_interval_s is not None and self._hb_thread is None:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, daemon=True,
+                name=f"heartbeat-silo-{self.node_id}")
+            self._hb_thread.start()
+        super().run()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._hb_stop.wait(self.heartbeat_interval_s):
+            try:
+                self.send(MsgType.C2S_HEARTBEAT, 0,
+                          **({} if self._round is None
+                             else {Message.ARG_ROUND: self._round}))
+            except Exception:  # noqa: BLE001 — transport mid-shutdown
+                return
+
+    def finish(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        super().finish()
+
     def _on_sync(self, msg: Message) -> None:
         params = msg.get(Message.ARG_MODEL_PARAMS)
         client_idx = msg.get(Message.ARG_CLIENT_INDEX)
         round_idx = msg.get(Message.ARG_ROUND)
+        self._round = round_idx
         if self.on_accepted is not None:
             self.on_accepted(msg.get(Message.ARG_ACCEPTED))
         new_params, num_samples = self.train_fn(params, client_idx, round_idx)

@@ -26,8 +26,13 @@ from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Observer, Transport
 from fedml_tpu.comm.local import LocalHub, LocalTransport
 from fedml_tpu.comm.actors import NodeManager, ClientManager, ServerManager
+from fedml_tpu.comm.chaos import (ChaosPlan, ChaosTransport, LinkChaos,
+                                  Partition)
+from fedml_tpu.comm.resilient import ResilientTransport, RetryPolicy
 
 __all__ = [
     "Message", "Observer", "Transport", "LocalHub", "LocalTransport",
     "NodeManager", "ClientManager", "ServerManager",
+    "ChaosPlan", "ChaosTransport", "LinkChaos", "Partition",
+    "ResilientTransport", "RetryPolicy",
 ]

@@ -16,12 +16,74 @@ from __future__ import annotations
 
 import abc
 import logging
+import threading
 from typing import Callable, Dict
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
 
 log = logging.getLogger(__name__)
+
+
+class SelfMessageTimer:
+    """One-shot daemon timer for actor watchdogs (straggler timeout,
+    async re-tasking).
+
+    The callback is expected to ENQUEUE a self-message so all policy
+    logic stays single-threaded on the transport's event loop; this
+    class owns the thread-lifecycle subtleties both server actors need:
+
+    * re-``arm()`` cancels the previous timer first;
+    * ``cancel(join=True)`` (the finish/abort path) joins every timer
+      thread still exiting its wait, so no timer outlives the federation
+      (no late fire, no leaked-thread warning under ``-W error``), and
+      permanently closes the timer — a fire racing the teardown is
+      suppressed, and send errors from a mid-shutdown transport are
+      swallowed.
+    """
+
+    def __init__(self):
+        self._timer: threading.Timer | None = None
+        self._spent: list = []  # cancelled, possibly still exiting
+        self._closed = False
+
+    @property
+    def pending(self) -> bool:
+        return self._timer is not None
+
+    def arm(self, delay_s: float, fire: Callable[[], None]) -> None:
+        self.cancel()
+        if self._closed:
+            return
+
+        def wrapped():
+            if self._closed:
+                return
+            try:
+                fire()
+            except Exception:  # noqa: BLE001 — transport mid-shutdown
+                pass
+
+        timer = threading.Timer(delay_s, wrapped)
+        timer.daemon = True
+        self._timer = timer
+        timer.start()
+
+    def cancel(self, join: bool = False) -> None:
+        timer = self._timer
+        if timer is not None:
+            self._timer = None
+            timer.cancel()
+            # a cancelled Timer thread still takes a beat to exit its
+            # wait; remember it so the join pass can reap every one
+            self._spent = [t for t in self._spent if t.is_alive()]
+            self._spent.append(timer)
+        if join:
+            self._closed = True
+            for t in self._spent:
+                if t is not threading.current_thread():
+                    t.join(timeout=5)
+            self._spent = [t for t in self._spent if t.is_alive()]
 
 
 class NodeManager(abc.ABC):

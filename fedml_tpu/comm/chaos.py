@@ -1,0 +1,235 @@
+"""Deterministic, seeded fault injection for any Transport flavor.
+
+The reference has no failure-injection story at all: its straggler
+"handling" is a barrier that hangs until ``MPI.Abort``
+(FedAvgServerManager.py:51, server_manager.py:64), and nothing in its
+communication stack is ever tested against loss, delay, duplication, or
+partition.  ``tests/test_chaos.py`` originally simulated faults by
+subclassing the client actor; this module promotes that into a
+first-class subsystem: a `ChaosTransport` wraps ANY transport (local,
+gRPC, MQTT, or a `ResilientTransport` stack) and perturbs its SEND path
+according to a seeded `ChaosPlan`:
+
+* **drop** — the message silently vanishes;
+* **delay** — delivery is deferred by a bounded random time (a daemon
+  timer re-sends through the inner transport);
+* **duplicate** — the message is delivered twice;
+* **reorder** — the message is held back and released after the NEXT
+  send on the same link (bounded by a flush timer so a final message
+  cannot be held forever);
+* **partition** — all matching traffic on a link is dropped, either for
+  a wall-clock window (``window_s``, the "mid-round partition" case) or
+  from a round tag onward (``after_round``, a silo death).
+
+Determinism: every fault decision comes from a per-link RNG derived
+from ``(plan.seed, src, dst)``, drawn under a lock — one fixed-size
+draw per message, in send order.  A single-threaded sender (the pump
+hub, or one event loop per node) therefore replays identical fault
+choices for a seed; when several threads send on ONE link (event loop +
+heartbeat), the draws stay race-free but their assignment to messages
+follows the actual send interleaving.  (Actual delivery *timing* of
+delayed messages is likewise wall-clock, as on a real network.)
+
+Liveness escape hatch: message types listed in ``immune_types`` bypass
+all faults — tests protect FINISH with it so client event loops always
+shut down.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.transport import Transport
+
+
+@dataclasses.dataclass
+class Partition:
+    """A one-directional link cut.
+
+    ``after_round``: drop messages whose round tag (Message.ARG_ROUND)
+    is >= this value — models a silo that dies at a known round.
+    ``window_s``: (start, end) seconds relative to ChaosTransport
+    creation — models a transient mid-round network split.  A message
+    is cut if it matches EITHER active criterion.
+    """
+    after_round: Optional[int] = None
+    window_s: Optional[Tuple[float, float]] = None
+
+    def cuts(self, msg: Message, elapsed_s: float) -> bool:
+        if self.after_round is not None:
+            r = msg.get(Message.ARG_ROUND)
+            if r is not None and r >= self.after_round:
+                return True
+        if self.window_s is not None:
+            t0, t1 = self.window_s
+            if t0 <= elapsed_s < t1:
+                return True
+        return False
+
+
+@dataclasses.dataclass
+class LinkChaos:
+    """Per-link fault probabilities and schedules (all default to off)."""
+    drop_prob: float = 0.0
+    delay_prob: float = 0.0
+    max_delay_s: float = 0.0
+    dup_prob: float = 0.0
+    reorder_prob: float = 0.0
+    partition: Optional[Partition] = None
+
+    @property
+    def quiet(self) -> bool:
+        return (self.drop_prob == 0 and self.delay_prob == 0
+                and self.dup_prob == 0 and self.reorder_prob == 0
+                and self.partition is None)
+
+
+class ChaosPlan:
+    """Seeded fault schedule: a default `LinkChaos` plus per-link
+    overrides keyed by ``(sender_id, receiver_id)``.
+
+    ``links[(src, dst)] = LinkChaos(...)`` overrides the default for
+    that directed link — set a quiet ``LinkChaos()`` to exempt a link
+    (e.g. keep one silo immortal so a quorum always exists).
+    """
+
+    def __init__(self, seed: int = 0,
+                 default: Optional[LinkChaos] = None,
+                 links: Optional[Dict[Tuple[int, int], LinkChaos]] = None,
+                 immune_types: tuple = ()):
+        self.seed = int(seed)
+        self.default = default if default is not None else LinkChaos()
+        self.links = dict(links or {})
+        self.immune_types = tuple(immune_types)
+
+    def link(self, src: int, dst: int) -> LinkChaos:
+        return self.links.get((src, dst), self.default)
+
+    def rng_for(self, src: int, dst: int):
+        import numpy as np
+        # stable per-link stream: independent of call order across links
+        mix = (self.seed * 1_000_003 + (src + 1) * 10_007
+               + (dst + 1) * 101) % (2 ** 32)
+        return np.random.RandomState(mix)
+
+
+class ChaosTransport(Transport):
+    """Wrap ``inner``; apply the plan's faults to outgoing messages.
+
+    Observer registration and the receive loop pass through to the
+    inner transport untouched — chaos lives on the wire, not in the
+    dispatcher, so the same wrapper composes with every flavor.
+    """
+
+    def __init__(self, inner: Transport, plan: ChaosPlan):
+        # no super().__init__(): observers belong to the inner transport
+        self.inner = inner
+        self.plan = plan
+        self._t0 = time.monotonic()
+        self._rngs: Dict[Tuple[int, int], object] = {}
+        self._held: Dict[Tuple[int, int], Message] = {}  # reorder buffer
+        self._timers: list = []
+        self._lock = threading.Lock()
+        self._stopped = False
+        # fault kind -> count, for assertions ("chaos actually happened")
+        self.faults: Dict[str, int] = {
+            "drop": 0, "delay": 0, "dup": 0, "reorder": 0, "partition": 0}
+
+    # -- observer passthrough ------------------------------------------------
+    def add_observer(self, observer) -> None:
+        self.inner.add_observer(observer)
+
+    def remove_observer(self, observer) -> None:
+        self.inner.remove_observer(observer)
+
+    # -- fault pipeline ------------------------------------------------------
+    def _rng(self, src: int, dst: int):
+        key = (src, dst)
+        if key not in self._rngs:
+            self._rngs[key] = self.plan.rng_for(src, dst)
+        return self._rngs[key]
+
+    def _deliver(self, msg: Message) -> None:
+        if not self._stopped:
+            self.inner.send_message(msg)
+
+    def _after(self, delay_s: float, fn, *args) -> None:
+        t = threading.Timer(delay_s, fn, args=args)
+        t.daemon = True
+        with self._lock:
+            self._timers = [x for x in self._timers if x.is_alive()]
+            self._timers.append(t)
+        t.start()
+
+    def _flush_held(self, key: Tuple[int, int]) -> None:
+        with self._lock:
+            held = self._held.pop(key, None)
+        if held is not None:
+            self._deliver(held)
+
+    def send_message(self, msg: Message) -> None:
+        if msg.type in self.plan.immune_types:
+            self._deliver(msg)
+            return
+        src, dst = msg.sender_id, msg.receiver_id
+        link = self.plan.link(src, dst)
+        if link.quiet:
+            self._deliver(msg)
+            return
+        elapsed = time.monotonic() - self._t0
+        if link.partition is not None and link.partition.cuts(msg, elapsed):
+            self.faults["partition"] += 1
+            return
+        # one fixed-size draw per message keeps the per-link stream
+        # deterministic even when probabilities differ between links; the
+        # draw happens under the lock because two sender threads (event
+        # loop + heartbeat) can share a link and RandomState is not
+        # thread-safe
+        with self._lock:
+            u_drop, u_delay, u_dup, u_reorder, u_t = \
+                self._rng(src, dst).uniform(size=5)
+        if u_drop < link.drop_prob:
+            self.faults["drop"] += 1
+            return
+        with self._lock:
+            held = self._held.pop((src, dst), None)
+        if u_reorder < link.reorder_prob:
+            # hold this message; it rides AFTER the next send on the link
+            # (or after a flush timeout so it cannot be held forever)
+            self.faults["reorder"] += 1
+            with self._lock:
+                self._held[(src, dst)] = msg
+            self._after(max(link.max_delay_s, 0.05),
+                        self._flush_held, (src, dst))
+        elif u_delay < link.delay_prob:
+            self.faults["delay"] += 1
+            self._after(float(u_t) * link.max_delay_s, self._deliver, msg)
+        else:
+            self._deliver(msg)
+        if u_dup < link.dup_prob:
+            self.faults["dup"] += 1
+            self._deliver(msg)
+        if held is not None:  # release the previously held message last
+            self._deliver(held)
+
+    # -- lifecycle -----------------------------------------------------------
+    def run(self) -> None:
+        self.inner.run()
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        with self._lock:
+            timers, self._timers = self._timers, []
+            held = list(self._held.values())
+            self._held.clear()
+        for t in timers:
+            t.cancel()
+        for msg in held:  # do not strand a reordered message at shutdown
+            self.inner.send_message(msg)
+        self.inner.stop()

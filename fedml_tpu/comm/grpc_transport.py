@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import queue
+import threading
 from typing import Dict
 
 from fedml_tpu.comm.message import Message
@@ -85,23 +86,43 @@ class GrpcTransport(Transport):
         self._opts = opts
         self._send_timeout_s = send_timeout_s
         self._idle_timeout_s = idle_timeout_s
+        self._lock = threading.Lock()
+        self._stopped = False
         self._server.start()
         log.info("grpc transport node %d listening on :%d", node_id, self._port)
 
     def _stub(self, receiver_id: int):
-        if receiver_id not in self._channels:
-            addr = f"{self.ip_table[receiver_id]}:{self.base_port + receiver_id}"
-            channel = self._grpc.insecure_channel(addr, options=self._opts)
-            call = channel.unary_unary(
-                f"/{_SERVICE}/{_METHOD}", request_serializer=_ident,
-                response_deserializer=_ident)
-            self._channels[receiver_id] = (channel, call)
-        return self._channels[receiver_id][1]
+        with self._lock:
+            if self._stopped:
+                # a send racing stop() must not repopulate the channel
+                # cache stop() just closed — that channel would leak
+                raise RuntimeError(
+                    f"grpc transport node {self.node_id} is stopped")
+            if receiver_id not in self._channels:
+                addr = (f"{self.ip_table[receiver_id]}:"
+                        f"{self.base_port + receiver_id}")
+                channel = self._grpc.insecure_channel(addr, options=self._opts)
+                call = channel.unary_unary(
+                    f"/{_SERVICE}/{_METHOD}", request_serializer=_ident,
+                    response_deserializer=_ident)
+                self._channels[receiver_id] = (channel, call)
+            return self._channels[receiver_id][1]
 
     def send_message(self, msg: Message) -> None:
         self._stub(msg.receiver_id)(
             msg.to_bytes(), wait_for_ready=True,
             timeout=self._send_timeout_s or None)
+
+    def reconnect(self) -> None:
+        """Drop every cached client channel so the next send dials fresh.
+
+        The reconnection hook `ResilientTransport` calls between retry
+        attempts: a peer that restarted (new process, same address) gets a
+        clean channel instead of a channel wedged in TRANSIENT_FAILURE."""
+        with self._lock:
+            channels, self._channels = dict(self._channels), {}
+        for channel, _ in channels.values():
+            channel.close()
 
     def run(self) -> None:
         while True:
@@ -122,8 +143,13 @@ class GrpcTransport(Transport):
             self._notify(item)
 
     def stop(self) -> None:
+        with self._lock:
+            if self._stopped:
+                return  # idempotent: run()'s idle path and callers both stop
+            self._stopped = True
+            channels, self._channels = dict(self._channels), {}
         self._inbox.put(_STOP)
-        for channel, _ in self._channels.values():
+        for channel, _ in channels.values():
             channel.close()
         self._server.stop(grace=None)
 

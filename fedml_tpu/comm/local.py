@@ -84,6 +84,7 @@ class LocalTransport(Transport):
         self.hub = hub
         self.node_id = node_id
         self._inbox: "queue.Queue" = queue.Queue()
+        self._stopped = False
 
     def send_message(self, msg: Message) -> None:
         self.hub.route(msg)
@@ -96,4 +97,7 @@ class LocalTransport(Transport):
             self._notify(item)
 
     def stop(self) -> None:
+        if self._stopped:
+            return  # idempotent: a second _STOP would strand a future run()
+        self._stopped = True
         self._inbox.put(_STOP)

@@ -45,7 +45,10 @@ class MqttTransport(Transport):
         super().__init__()
         self.node_id = node_id
         self.topic_prefix = topic_prefix
+        self.broker_host = broker_host
+        self.broker_port = broker_port
         self._inbox: "queue.Queue" = queue.Queue()
+        self._stopped = False
         cid = f"{topic_prefix}_{node_id}"
         if not HAVE_MQTT:
             # no paho: the in-repo MQTT 3.1.1 client speaks the same wire
@@ -76,6 +79,23 @@ class MqttTransport(Transport):
         self._client.publish(self._topic(msg.receiver_id), msg.to_bytes(),
                              qos=1)
 
+    def reconnect(self) -> None:
+        """Tear down and re-run the CONNECT/SUBSCRIBE handshake against the
+        same broker — the hook `ResilientTransport` invokes between retry
+        attempts after a publish fails (broker restarted, TCP reset)."""
+        if self._stopped:
+            return
+        try:
+            self._client.loop_stop()
+            self._client.disconnect()
+        except Exception:  # noqa: BLE001 — the old session may be half-dead
+            pass
+        if hasattr(self._client, "_closing"):  # MiniMqttClient
+            self._client._closing = False
+        self._client.connect(self.broker_host, self.broker_port)
+        self._client.subscribe(self._topic(self.node_id), qos=1)
+        self._client.loop_start()
+
     def run(self) -> None:
         while True:
             item = self._inbox.get()
@@ -87,6 +107,9 @@ class MqttTransport(Transport):
             self._notify(item)
 
     def stop(self) -> None:
+        if self._stopped:
+            return  # idempotent: actor finish + fixture teardown both stop
+        self._stopped = True
         self._inbox.put(_STOP)
         self._client.loop_stop()
         self._client.disconnect()

@@ -1,0 +1,198 @@
+"""Retry / backoff / dead-letter decorator for wire transports.
+
+The reference's transports send exactly once and pray: a gRPC
+``sendMessage`` that raises UNAVAILABLE, or an MQTT publish on a dead
+socket, kills the federation (grpc_comm_manager.py:70-76 has no retry;
+mqtt_comm_manager.py reconnects never).  `ResilientTransport` wraps any
+`Transport` with the production posture:
+
+* **bounded in-flight queue** — ``send_message`` enqueues and returns;
+  a single daemon sender thread drains in FIFO order, so message order
+  per sender is preserved and a slow wire never blocks the event loop.
+  A full queue dead-letters the message instead of blocking (back
+  pressure surfaces as an explicit signal, not a hang).
+* **retries with exponential backoff + decorrelated jitter** — each
+  attempt that raises is retried after ``base_backoff_s * mult^k``
+  seconds, multiplied by a seeded jitter in ``[1-jitter, 1+jitter]``,
+  capped at ``max_backoff_s``.
+* **per-send deadline** — ``send_deadline_s`` bounds the TOTAL time
+  (all attempts + backoffs) spent on one message.
+* **reconnection** — between attempts the wrapper calls the inner
+  transport's ``reconnect()`` (if it has one); gRPC drops its cached
+  channel so the next attempt dials fresh, MQTT re-runs the
+  CONNECT/SUBSCRIBE handshake.
+* **dead-letter callback** — ``on_dead_letter(msg, exc)`` fires when a
+  message exhausts its attempts/deadline or the queue is full; the
+  default logs and drops (an FL upload is retried implicitly by the
+  next round — losing one is degradation, not corruption).
+
+Compose order: ``ResilientTransport(ChaosTransport(inner))`` retries
+THROUGH injected faults (chaos drops are silent, so only transport
+errors trigger retry); ``ChaosTransport(ResilientTransport(inner))``
+injects faults the retry layer never sees.  Tests use the first form
+against a flaky inner transport to prove retry recovers what one-shot
+sends lose.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.transport import Transport
+
+log = logging.getLogger(__name__)
+
+_STOP = object()
+
+
+class SendDeadlineExceeded(RuntimeError):
+    """Raised (into the dead-letter path) when a send's total retry
+    budget is exhausted."""
+
+
+class SendQueueFull(RuntimeError):
+    """Raised (into the dead-letter path) when the bounded in-flight
+    queue rejects a message."""
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Backoff schedule for one message."""
+    max_attempts: int = 5
+    base_backoff_s: float = 0.05
+    backoff_multiplier: float = 2.0
+    max_backoff_s: float = 2.0
+    jitter_frac: float = 0.2            # each backoff scaled by U[1-j, 1+j]
+    send_deadline_s: Optional[float] = 30.0  # total budget per message
+
+    def backoff(self, attempt: int, rng) -> float:
+        raw = min(self.base_backoff_s * self.backoff_multiplier ** attempt,
+                  self.max_backoff_s)
+        if self.jitter_frac <= 0:
+            return raw
+        lo, hi = 1.0 - self.jitter_frac, 1.0 + self.jitter_frac
+        return raw * float(rng.uniform(lo, hi))
+
+
+class ResilientTransport(Transport):
+    """Decorate ``inner`` with queued, retried, dead-lettered sends."""
+
+    def __init__(self, inner: Transport, policy: Optional[RetryPolicy] = None,
+                 max_in_flight: int = 256,
+                 on_dead_letter: Optional[
+                     Callable[[Message, Exception], None]] = None,
+                 seed: int = 0):
+        # no super().__init__(): observers belong to the inner transport
+        self.inner = inner
+        self.policy = policy if policy is not None else RetryPolicy()
+        self.on_dead_letter = on_dead_letter
+        self._rng = np.random.RandomState(seed)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max_in_flight)
+        self._stopped = False
+        self.sent_ok = 0
+        self.retries = 0
+        self.dead_letters = 0
+        self._sender = threading.Thread(target=self._drain, daemon=True,
+                                        name="resilient-sender")
+        self._sender.start()
+
+    # -- observer passthrough ------------------------------------------------
+    def add_observer(self, observer) -> None:
+        self.inner.add_observer(observer)
+
+    def remove_observer(self, observer) -> None:
+        self.inner.remove_observer(observer)
+
+    # -- send path -----------------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        if self._stopped:
+            # the sender thread is gone; an enqueue would vanish silently —
+            # surface it like every other terminal send failure
+            self._dead_letter(msg, RuntimeError(
+                f"transport stopped; dropping {msg!r}"))
+            return
+        try:
+            self._queue.put_nowait(msg)
+        except queue.Full:
+            self._dead_letter(msg, SendQueueFull(
+                f"in-flight queue full ({self._queue.maxsize}); "
+                f"dropping {msg!r}"))
+
+    def _dead_letter(self, msg: Message, exc: Exception) -> None:
+        self.dead_letters += 1
+        if self.on_dead_letter is not None:
+            self.on_dead_letter(msg, exc)
+        else:
+            log.error("dead-lettering %r: %s", msg, exc)
+
+    def _drain(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is _STOP:
+                return
+            self._send_with_retries(item)
+
+    def _send_with_retries(self, msg: Message) -> None:
+        deadline = (None if self.policy.send_deadline_s is None
+                    else time.monotonic() + self.policy.send_deadline_s)
+        last_exc: Optional[Exception] = None
+        deadline_hit = False
+        for attempt in range(self.policy.max_attempts):
+            if self._stopped and attempt > 0:
+                # graceful drain: a message already queued at stop() still
+                # gets its FIRST attempt (a FINISH broadcast precedes the
+                # server's own stop), but no backoff-retry loop may outlive
+                # the transport
+                return
+            try:
+                self.inner.send_message(msg)
+                self.sent_ok += 1
+                return
+            except Exception as exc:  # noqa: BLE001 — any wire error retries
+                if self._stopped:
+                    return  # shutdown drain: one attempt, no backoff
+                last_exc = exc
+                if attempt + 1 >= self.policy.max_attempts:
+                    break  # terminal attempt: no backoff/reconnect to pay
+                pause = self.policy.backoff(attempt, self._rng)
+                if deadline is not None and \
+                        time.monotonic() + pause > deadline:
+                    deadline_hit = True  # budget gone before the next try
+                    break
+                log.warning("send attempt %d/%d failed (%s); retrying in "
+                            "%.3fs", attempt + 1, self.policy.max_attempts,
+                            exc, pause)
+                self.retries += 1
+                time.sleep(pause)
+                reconnect = getattr(self.inner, "reconnect", None)
+                if reconnect is not None:
+                    try:
+                        reconnect()
+                    except Exception as rexc:  # noqa: BLE001
+                        log.warning("reconnect failed: %s", rexc)
+        if deadline_hit and last_exc is not None:
+            last_exc = SendDeadlineExceeded(
+                f"{self.policy.send_deadline_s}s send budget exhausted "
+                f"(last error: {last_exc})")
+        self._dead_letter(msg, last_exc if last_exc is not None
+                          else RuntimeError("send failed"))
+
+    # -- lifecycle -----------------------------------------------------------
+    def run(self) -> None:
+        self.inner.run()
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        self._queue.put(_STOP)
+        self._sender.join(timeout=5)
+        self.inner.stop()

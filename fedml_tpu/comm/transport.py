@@ -37,7 +37,10 @@ class Transport(abc.ABC):
         self._observers.append(observer)
 
     def remove_observer(self, observer: Observer) -> None:
-        self._observers.remove(observer)
+        # idempotent: teardown paths (actor finish + test fixture cleanup)
+        # may both remove; the second call is a no-op, not a ValueError
+        if observer in self._observers:
+            self._observers.remove(observer)
 
     def _notify(self, msg: Message) -> None:
         for obs in self._observers:
@@ -53,4 +56,6 @@ class Transport(abc.ABC):
 
     @abc.abstractmethod
     def stop(self) -> None:
-        """Unblock run() and release resources."""
+        """Unblock run() and release resources.  Implementations MUST be
+        idempotent: overlapping teardown paths (straggler-policy abort,
+        actor ``finish()``, test fixtures) may each call ``stop()``."""

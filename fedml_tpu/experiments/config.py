@@ -143,6 +143,20 @@ class ExperimentConfig:
     #                           are ep-shardable (parallel/expert.py)
     silo_idle_timeout_s: float = 0.0  # grpc silos: exit after this long
     #                                   with no traffic (0 = wait forever)
+    # ---- fault tolerance (comm/resilient.py + cross_silo health) -------
+    heartbeat_s: float = 0.0          # >0: silos send liveness beats at
+    #                                   this interval (threaded/grpc modes)
+    dead_after_s: float = 0.0         # >0: server failure detector — silos
+    #                                   unheard this long are DEAD and
+    #                                   excluded from the round quorum
+    suspect_after_s: float = 0.0      # detector SUSPECT threshold
+    #                                   (0 = dead_after_s / 2)
+    retask_timeout_s: float = 0.0     # async_fl: re-task silos quiet this
+    #                                   long (liveness under upload loss)
+    silo_retries: int = 0             # >0: wrap the wire transport in
+    #                                   ResilientTransport with this many
+    #                                   send attempts (backoff + jitter +
+    #                                   reconnect between attempts)
     wire_compression: str = "none"    # cross_silo uploads: none|topk|int8
     topk_frac: float = 0.1            # topk: fraction of entries kept
     error_feedback: bool = False      # carry the compression residual into

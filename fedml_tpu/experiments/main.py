@@ -569,7 +569,8 @@ def run_async_fl(cfg, data, mesh, sink):
         num_versions=cfg.comm_round, aggregation_goal=goal,
         staleness_exponent=cfg.staleness_exponent,
         server_lr=cfg.async_server_lr, on_version=on_version,
-        seed=cfg.seed)
+        seed=cfg.seed, checkpointer=_make_checkpointer(cfg),
+        retask_timeout_s=cfg.retask_timeout_s or None)
     server.register_handlers()
     silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
                                encode_upload=delta_encoder)
@@ -686,13 +687,21 @@ def run_cross_silo(cfg, data, mesh, sink):
             history.append(stats)
             sink.log(stats, step=r)
 
+    detector = None
+    if cfg.dead_after_s > 0:
+        from fedml_tpu.algorithms.cross_silo import FailureDetector
+        detector = FailureDetector(
+            suspect_after_s=cfg.suspect_after_s or cfg.dead_after_s / 2,
+            dead_after_s=cfg.dead_after_s)
+
     def make_server(transport):
         s = FedAvgServerActor(
             transport, init, data.client_num, n_silos, cfg.comm_round,
             on_round_done=on_round_done,
             straggler_policy=cfg.straggler_policy,
             round_timeout_s=timeout, min_silo_frac=cfg.min_silo_frac,
-            decode_upload=decode)
+            decode_upload=decode, failure_detector=detector,
+            checkpointer=_make_checkpointer(cfg))
         s.register_handlers()
         return s
 
@@ -716,6 +725,14 @@ def run_cross_silo(cfg, data, mesh, sink):
         transport = GrpcTransport(cfg.node_id, table,
                                   base_port=cfg.base_port,
                                   idle_timeout_s=cfg.silo_idle_timeout_s)
+        if cfg.silo_retries > 0:
+            # production posture: retried, backed-off, dead-lettered sends
+            # with channel re-dial between attempts (comm/resilient.py)
+            from fedml_tpu.comm.resilient import (ResilientTransport,
+                                                  RetryPolicy)
+            transport = ResilientTransport(
+                transport, RetryPolicy(max_attempts=cfg.silo_retries),
+                seed=cfg.seed)
         if cfg.node_id == 0:
             server = make_server(transport)
             server.start()
@@ -724,9 +741,10 @@ def run_cross_silo(cfg, data, mesh, sink):
         silo = FedAvgClientActor(cfg.node_id, transport,
                                  make_train_fn(cfg.node_id),
                                  encode_upload=make_encode(cfg.node_id),
-                                 on_accepted=make_on_accepted(cfg.node_id))
-        silo.register_handlers()
-        transport.run()
+                                 on_accepted=make_on_accepted(cfg.node_id),
+                                 heartbeat_interval_s=cfg.heartbeat_s or None)
+        # run() (not bare transport.run()) so the heartbeat thread starts
+        silo.run()
         return {}
     raise ValueError(f"unknown silo_backend {cfg.silo_backend!r}; "
                      f"available: ('local', 'grpc')")

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Full seeded chaos + fault-tolerance matrix (includes the slow cases
+# tier-1 skips): 20-seed drop-policy and async chaos sweeps, the
+# resilient-transport suite (gRPC receiver restart, MQTT reconnect),
+# crash-recovery, and the end-to-end convergence-under-chaos runs.
+#
+# Usage: scripts/run_chaos.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_chaos.py tests/test_resilient.py tests/test_recovery.py \
+    -q -p no:cacheprovider "$@"

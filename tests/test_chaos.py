@@ -1,27 +1,33 @@
-"""Straggler CHAOS: randomized delays and silo deaths against the
-cross-silo drop policy and the async (FedBuff) server — liveness and
-progress must survive every seed (VERDICT r3 item 7).
+"""Straggler CHAOS via the first-class injection layer (comm/chaos.py):
+seeded drops, delays, duplicates, and partitions against the cross-silo
+drop policy and the async (FedBuff) server — liveness and progress must
+survive every seed (VERDICT r3 item 7).
 
 The reference's only straggler story is a barrier that hangs until
 MPI.Abort (FedAvgServerManager.py:51, server_manager.py:64); these tests
-assert the opposite contract: with randomized adversarial timing —
-uniform train delays, silos dying mid-federation at random rounds — the
-server still closes every round (drop policy) or version (async), never
-wedges, and the surviving quorum's updates are the ones aggregated.
+assert the opposite contract: with seeded adversarial networking —
+lossy/delayed/duplicated frames, silos partitioned away mid-federation —
+the server still closes every round (drop policy) or version (async),
+never wedges, and the surviving quorum's updates are the ones
+aggregated.  Faults are injected by wrapping each actor's transport in a
+`ChaosTransport`; the actors themselves are UNMODIFIED production code
+(the original ad-hoc ``_ChaoticClientActor`` subclass is gone).
 
 Determinism note: each case is seeded; 20 seeds per policy.  One silo is
-immortal by construction — with EVERY silo dead no quorum policy can
-terminate (that is the abort policy's job, tested in test_comm.py).
+immortal by construction (its links carry a quiet plan) — with EVERY
+silo dead no quorum policy can terminate (that is the abort policy's
+job, tested in test_comm.py).
 """
 
 import threading
-import time
 
 import numpy as np
 import pytest
 
 from fedml_tpu.algorithms.cross_silo import (
-    FedAvgClientActor, FedAvgServerActor, MsgType)
+    FailureDetector, FedAvgClientActor, FedAvgServerActor, MsgType)
+from fedml_tpu.comm.chaos import (ChaosPlan, ChaosTransport, LinkChaos,
+                                  Partition)
 from fedml_tpu.comm.local import LocalHub
 from fedml_tpu.comm.message import Message
 
@@ -32,24 +38,11 @@ def _params_tree(seed=0):
                       "bias": rng.randn(3).astype(np.float32)}}
 
 
-class _ChaoticClientActor(FedAvgClientActor):
-    """Trains with a random delay; may die (stop answering SYNC) at a
-    pre-drawn round.  Death is silent — exactly a crashed/partitioned
-    silo from the server's viewpoint."""
-
-    def __init__(self, node_id, transport, train_fn, rng,
-                 max_delay_s: float, death_round):
-        super().__init__(node_id, transport, train_fn)
-        self._rng = rng
-        self._max_delay_s = max_delay_s
-        self._death_round = death_round  # None = immortal
-
-    def _on_sync(self, msg):
-        round_idx = msg.get(Message.ARG_ROUND)
-        if self._death_round is not None and round_idx >= self._death_round:
-            return  # dead: swallow the sync, never upload
-        time.sleep(float(self._rng.uniform(0.0, self._max_delay_s)))
-        super()._on_sync(msg)
+def _add_train_fn(delta):
+    def fn(params, client_idx, round_idx):
+        import jax
+        return jax.tree.map(lambda v: v + delta, params), 10
+    return fn
 
 
 def _run_federation(server, actors, timeout_s=30.0):
@@ -72,43 +65,71 @@ def _run_federation(server, actors, timeout_s=30.0):
         th.join(timeout=5)
 
 
-@pytest.mark.parametrize("seed", range(20))
-def test_chaos_drop_policy_survives_delays_and_deaths(seed):
-    """4 silos, uniform 0..0.15 s train delays, up to 2 silos dying at
-    random rounds: every round still closes under the drop policy, the
-    run never aborts, and the aggregate ends exactly at
-    init + sum(per-round survivor-mean deltas)."""
-    rng = np.random.RandomState(1000 + seed)
-    n_silos, n_rounds = 4, 3
-    hub = LocalHub()
-    t_server = hub.transport(0)
-    init = _params_tree(seed)
+def _chaotic_silo_plan(seed, silo, death_round=None, window=None):
+    """Fault schedule for one silo's transport: lossy/delayed/duplicated
+    uplink, plus an optional death partition (everything the silo sends
+    for rounds >= death_round is cut) and an optional wall-clock window
+    partition (the mid-round network split)."""
+    partition = (Partition(after_round=death_round, window_s=window)
+                 if death_round is not None or window is not None else None)
+    uplink = LinkChaos(drop_prob=0.12, delay_prob=0.3, max_delay_s=0.07,
+                       dup_prob=0.1, reorder_prob=0.1, partition=partition)
+    return ChaosPlan(seed=seed * 977 + silo,
+                     links={(silo, 0): uplink},
+                     immune_types=(MsgType.S2C_FINISH,))
 
-    # silo i's upload adds (i+1) to every leaf; sample counts equal so the
-    # weighted mean of survivors is the plain mean of their deltas
-    def train_fn(delta):
-        def fn(params, client_idx, round_idx):
-            import jax
-            return jax.tree.map(lambda v: v + delta, params), 10
-        return fn
+
+def _chaotic_server_plan(seed, faulted_silos):
+    """Downlink faults (sync broadcasts) toward the non-immortal silos.
+    FINISH is immune: shutdown liveness is the transport layer's job
+    (ResilientTransport), not the chaos suite's."""
+    down = LinkChaos(drop_prob=0.08, delay_prob=0.2, max_delay_s=0.05,
+                     dup_prob=0.08)
+    return ChaosPlan(seed=seed * 31 + 7,
+                     links={(0, s): down for s in faulted_silos},
+                     immune_types=(MsgType.S2C_FINISH,))
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_chaos_drop_policy_survives_faulty_network(seed):
+    """4 silos behind chaotic links (drops, delays, duplicates, reorders,
+    a mid-run wall-clock partition, up to 2 death partitions at random
+    rounds): every round still closes under the drop policy, the run
+    never aborts, and the aggregate ends exactly at init + sum(per-round
+    survivor-mean deltas) replayed from the server's own drop log."""
+    rng = np.random.RandomState(1000 + seed)
+    n_silos, n_rounds = 4, 4
+    hub = LocalHub()
+    init = _params_tree(seed)
 
     deaths = {}  # silo id -> death round
     dying = rng.choice(np.arange(2, n_silos + 1), size=2, replace=False)
     for silo in dying:
         if rng.rand() < 0.7:  # not every chosen silo actually dies
             deaths[int(silo)] = int(rng.randint(0, n_rounds))
+    # silo 2 additionally suffers a transient mid-round partition window
+    # (unless it is already dying — then the death partition dominates)
+    windows = {2: (0.18, 0.45)}
 
     completed = []
+    detector = FailureDetector(suspect_after_s=0.3, dead_after_s=0.6)
     server = FedAvgServerActor(
-        t_server, init, client_num_in_total=n_silos,
+        ChaosTransport(hub.transport(0),
+                       _chaotic_server_plan(seed, range(2, n_silos + 1))),
+        init, client_num_in_total=n_silos,
         client_num_per_round=n_silos, num_rounds=n_rounds,
         on_round_done=lambda r, p: completed.append(r),
-        straggler_policy="drop", round_timeout_s=0.4, min_silo_frac=0.2)
+        straggler_policy="drop", round_timeout_s=0.25, min_silo_frac=0.2,
+        failure_detector=detector)
+    transports = {1: hub.transport(1)}  # silo 1 immortal: clean links
+    for i in range(2, n_silos + 1):
+        transports[i] = ChaosTransport(
+            hub.transport(i),
+            _chaotic_silo_plan(seed, i, death_round=deaths.get(i),
+                               window=windows.get(i)))
     actors = [
-        _ChaoticClientActor(
-            i, hub.transport(i), train_fn(float(i)),
-            np.random.RandomState(seed * 100 + i), max_delay_s=0.15,
-            death_round=deaths.get(i))
+        FedAvgClientActor(i, transports[i], _add_train_fn(float(i)),
+                          heartbeat_interval_s=0.04)
         for i in range(1, n_silos + 1)]
 
     _run_federation(server, actors)
@@ -116,6 +137,11 @@ def test_chaos_drop_policy_survives_delays_and_deaths(seed):
     assert not server.aborted
     assert server.round_idx == n_rounds
     assert completed == list(range(n_rounds))
+    # chaos must have actually happened on the faulted links
+    total_faults = sum(sum(t.faults.values())
+                       for t in transports.values()
+                       if isinstance(t, ChaosTransport))
+    assert total_faults > 0, "chaos plan injected nothing"
     # progress check: replay the expected aggregate from the server's own
     # drop log (survivors of round r = all silos minus dropped)
     expected = np.asarray(init["dense"]["kernel"], np.float64)
@@ -124,7 +150,7 @@ def test_chaos_drop_policy_survives_delays_and_deaths(seed):
         survivors = [i for i in range(1, n_silos + 1) if i not in dropped]
         assert survivors, "quorum closed a round with zero uploads"
         expected = expected + np.mean([float(i) for i in survivors])
-        # a dead silo must actually be in the drop log from its death round
+    # a dead silo must actually be in the drop log from its death round
     for silo, death in deaths.items():
         for r in range(death, n_rounds):
             assert silo in server.dropped_silos.get(r, []), \
@@ -135,48 +161,41 @@ def test_chaos_drop_policy_survives_delays_and_deaths(seed):
 
 
 @pytest.mark.parametrize("seed", range(20))
-def test_chaos_async_server_survives_delays_and_deaths(seed):
-    """FedBuff server under chaos: random delays plus up to 1 dead silo
-    (of 3, goal 2) — versions keep closing from whoever is alive, FINISH
-    arrives, staleness stays plausible."""
-    from fedml_tpu.algorithms.async_fl import AsyncFedServerActor
+def test_chaos_async_server_survives_faulty_network(seed):
+    """FedBuff server under injected chaos: lossy/delayed/duplicated
+    uplinks plus up to 1 death partition (of 3 silos, goal 2) — versions
+    keep closing from whoever is alive (the re-task watchdog refills the
+    rotation when uploads are lost), FINISH arrives, staleness stays
+    plausible."""
+    from fedml_tpu.algorithms.async_fl import (AsyncFedServerActor,
+                                               delta_encoder)
 
     rng = np.random.RandomState(2000 + seed)
     n_silos, versions, goal = 3, 4, 2
     hub = LocalHub()
     init = _params_tree(seed)
 
-    def train_fn(delta):
-        def fn(params, client_idx, round_idx):
-            import jax
-            return jax.tree.map(lambda v: v + delta, params), 10
-        return fn
-
     death = ({int(rng.randint(2, n_silos + 1)): int(rng.randint(0, 2))}
              if rng.rand() < 0.5 else {})
     server = AsyncFedServerActor(
         hub.transport(0), init, client_num_in_total=8, n_silos=n_silos,
         num_versions=versions, aggregation_goal=goal,
-        staleness_exponent=0.5, seed=seed)
-    # async clients upload DELTAS (delta_encoder seam); the toy train_fn
-    # returns params+delta so encode subtracts the base back out
-    from fedml_tpu.algorithms.async_fl import delta_encoder
-    actors = [
-        _ChaoticClientActor(
-            i, hub.transport(i), train_fn(float(i)),
-            np.random.RandomState(seed * 77 + i), max_delay_s=0.1,
-            death_round=death.get(i))
-        for i in range(1, n_silos + 1)]
-    for a in actors:
-        a.encode_upload = delta_encoder
+        staleness_exponent=0.5, seed=seed, retask_timeout_s=0.3)
+    transports = {1: hub.transport(1)}  # immortal silo
+    for i in range(2, n_silos + 1):
+        transports[i] = ChaosTransport(
+            hub.transport(i),
+            _chaotic_silo_plan(seed, i, death_round=death.get(i)))
+    actors = [FedAvgClientActor(i, transports[i], _add_train_fn(float(i)),
+                                encode_upload=delta_encoder)
+              for i in range(1, n_silos + 1)]
 
     _run_federation(server, actors)
 
     assert server.version == versions
-    # consumed = versions*goal; up to n_silos - goal more may sit in the
-    # final unconsumed buffer (appended on receipt, before consumption)
-    assert versions * goal <= len(server.staleness_seen) \
-        <= versions * goal + (n_silos - goal)
+    # every consumed version had `goal` distinct uploads; duplicates and
+    # drops change how many uploads were SEEN, not the liveness contract
+    assert len(server.staleness_seen) >= versions * goal
     assert all(s >= 0 for s in server.staleness_seen)
     # the aggregate must have moved off init and stayed finite
     k = np.asarray(server.params["dense"]["kernel"])
@@ -184,11 +203,86 @@ def test_chaos_async_server_survives_delays_and_deaths(seed):
     assert float(np.abs(k - init["dense"]["kernel"]).max()) > 0.1
 
 
+def test_chaos_transport_is_deterministic_per_seed():
+    """Two runs of the same seeded plan over the same message sequence
+    make identical fault decisions (the injection layer's contract)."""
+    def run_once():
+        hub = LocalHub()
+        sink = hub.transport(0)
+        got = []
+
+        class Collect:
+            def receive_message(self, msg_type, msg):
+                got.append(msg.get("n"))
+
+        sink.add_observer(Collect())
+        chaos = ChaosTransport(
+            hub.transport(1),
+            ChaosPlan(seed=7, links={(1, 0): LinkChaos(
+                drop_prob=0.3, dup_prob=0.2)}))
+        for n in range(50):
+            chaos.send_message(Message("m", 1, 0).add("n", n))
+        hub.pump()
+        return got, dict(chaos.faults)
+
+    got_a, faults_a = run_once()
+    got_b, faults_b = run_once()
+    assert got_a == got_b
+    assert faults_a == faults_b
+    assert faults_a["drop"] > 0 and faults_a["dup"] > 0
+
+
+def test_chaos_partition_window_and_immunity():
+    """A wall-clock partition cuts matching traffic; immune types pass."""
+    hub = LocalHub()
+    sink = hub.transport(0)
+    got = []
+
+    class Collect:
+        def receive_message(self, msg_type, msg):
+            got.append(msg_type)
+
+    sink.add_observer(Collect())
+    plan = ChaosPlan(seed=0, links={(1, 0): LinkChaos(
+        partition=Partition(window_s=(0.0, 1e9)))},
+        immune_types=("finish",))
+    chaos = ChaosTransport(hub.transport(1), plan)
+    chaos.send_message(Message("data", 1, 0))
+    chaos.send_message(Message("finish", 1, 0))
+    hub.pump()
+    assert got == ["finish"]
+    assert chaos.faults["partition"] == 1
+
+
+def test_chaos_round_partition_models_silo_death():
+    """after_round cuts only messages tagged with a round >= the death
+    round — the declarative form of the old _ChaoticClientActor."""
+    hub = LocalHub()
+    sink = hub.transport(0)
+    got = []
+
+    class Collect:
+        def receive_message(self, msg_type, msg):
+            got.append(msg.get(Message.ARG_ROUND))
+
+    sink.add_observer(Collect())
+    chaos = ChaosTransport(
+        hub.transport(1),
+        ChaosPlan(links={(1, 0): LinkChaos(
+            partition=Partition(after_round=2))}))
+    for r in range(5):
+        chaos.send_message(
+            Message("up", 1, 0).add(Message.ARG_ROUND, r))
+    hub.pump()
+    assert got == [0, 1]
+    assert chaos.faults["partition"] == 3
+
+
 @pytest.mark.slow
 def test_chaos_real_training_converges_under_drop():
-    """End-to-end: 3-silo LR federation on synthetic data with random
-    delays and one mid-run death still LEARNS (loss decreases) under the
-    drop policy — the convergence half of the chaos contract."""
+    """End-to-end: 3-silo LR federation on synthetic data behind chaotic
+    links (delays + one death partition) still LEARNS (loss decreases)
+    under the drop policy — the convergence half of the chaos contract."""
     import jax
     import jax.numpy as jnp
     from fedml_tpu.data.synthetic import mnist_learnable_twin
@@ -225,18 +319,29 @@ def test_chaos_real_training_converges_under_drop():
         return fn
 
     hub = LocalHub()
+    # 10 rounds (the seed version's 6 left the loss just short of the
+    # 0.7*l0 bar even in the no-chaos limit — the budget was too tight,
+    # not the robustness)
     server = FedAvgServerActor(
         hub.transport(0), init, client_num_in_total=3,
-        client_num_per_round=3, num_rounds=6,
+        client_num_per_round=3, num_rounds=10,
         straggler_policy="drop", round_timeout_s=1.0, min_silo_frac=0.3)
-    actors = [
-        _ChaoticClientActor(i, hub.transport(i), train_fn(i),
-                            np.random.RandomState(i), max_delay_s=0.05,
-                            death_round=3 if i == 3 else None)
-        for i in (1, 2, 3)]
+    transports = {
+        1: hub.transport(1),
+        2: ChaosTransport(hub.transport(2), ChaosPlan(
+            seed=2, links={(2, 0): LinkChaos(delay_prob=0.5,
+                                             max_delay_s=0.05)},
+            immune_types=(MsgType.S2C_FINISH,))),
+        3: ChaosTransport(hub.transport(3), ChaosPlan(
+            seed=3, links={(3, 0): LinkChaos(
+                partition=Partition(after_round=3))},
+            immune_types=(MsgType.S2C_FINISH,))),
+    }
+    actors = [FedAvgClientActor(i, transports[i], train_fn(i))
+              for i in (1, 2, 3)]
     l0 = loss_of(init)
     _run_federation(server, actors, timeout_s=120.0)
 
-    assert not server.aborted and server.round_idx == 6
-    assert all(3 in server.dropped_silos.get(r, []) for r in (3, 4, 5))
+    assert not server.aborted and server.round_idx == 10
+    assert all(3 in server.dropped_silos.get(r, []) for r in range(3, 10))
     assert loss_of(server.params) < 0.7 * l0

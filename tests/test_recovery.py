@@ -1,0 +1,403 @@
+"""Crash-recoverable federation: a server killed mid-federation restarts
+from its RoundCheckpointer and completes (ISSUE 1 acceptance), the
+failure detector shrinks the quorum for dead silos and runs the rejoin
+protocol, and the straggler timer never outlives the federation.
+
+The reference loses the entire federation on any server fault (no
+checkpoint on the FL path, SURVEY.md §5.4; its only exit is MPI.Abort).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.cross_silo import (
+    FailureDetector, FedAvgClientActor, FedAvgServerActor, MsgType)
+from fedml_tpu.comm.chaos import (ChaosPlan, ChaosTransport, LinkChaos,
+                                  Partition)
+from fedml_tpu.comm.local import LocalHub
+from fedml_tpu.comm.message import Message
+from fedml_tpu.utils.checkpoint import RoundCheckpointer
+
+
+def _params_tree(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"dense": {"kernel": rng.randn(4, 3).astype(np.float32),
+                      "bias": rng.randn(3).astype(np.float32)}}
+
+
+def _add_train_fn(delta):
+    def fn(params, client_idx, round_idx):
+        import jax
+        return jax.tree.map(lambda v: v + delta, params), 10
+    return fn
+
+
+class _Crash(Exception):
+    """Stands in for kill -9: raised out of the server's event loop so no
+    FINISH, no cleanup — only what the checkpointer already persisted
+    survives."""
+
+
+def _run_fedavg(init, num_rounds, ck=None, crash_after=None):
+    """One pump-mode federation (3 silos, deterministic +i training).
+    ``crash_after``: raise _Crash out of the round-done hook after that
+    round completes — AFTER the checkpoint save, like a process killed
+    between rounds."""
+    hub = LocalHub()
+    completed = []
+
+    def on_done(r, p):
+        completed.append(r)
+        if crash_after is not None and r >= crash_after:
+            raise _Crash()
+
+    server = FedAvgServerActor(
+        hub.transport(0), init, client_num_in_total=3,
+        client_num_per_round=3, num_rounds=num_rounds,
+        on_round_done=on_done, checkpointer=ck)
+    clients = [FedAvgClientActor(i, hub.transport(i), _add_train_fn(float(i)))
+               for i in (1, 2, 3)]
+    server.register_handlers()
+    for c in clients:
+        c.register_handlers()
+    if crash_after is not None:
+        with pytest.raises(_Crash):
+            server.start()
+            hub.pump()
+    else:
+        server.start()
+        hub.pump()
+    return server, completed
+
+
+def test_fedavg_server_crash_and_resume_completes(tmp_path):
+    """Kill the server after round 2 of 5; a FRESH server restarted on
+    the same checkpoint directory resumes at round 3, completes rounds
+    3-4, and lands on exactly the params of an uninterrupted run."""
+    init = _params_tree(3)
+    straight, comp = _run_fedavg(init, 5)
+    assert comp == [0, 1, 2, 3, 4]
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1)
+    crashed, comp1 = _run_fedavg(init, 5, ck=ck, crash_after=2)
+    assert comp1 == [0, 1, 2]
+    assert ck.latest_round() == 2
+
+    resumed, comp2 = _run_fedavg(init, 5, ck=RoundCheckpointer(
+        str(tmp_path / "ck")))
+    assert comp2 == [3, 4], "resume must continue, not restart"
+    assert resumed.round_idx == 5
+    np.testing.assert_allclose(
+        np.asarray(resumed.params["dense"]["kernel"]),
+        np.asarray(straight.params["dense"]["kernel"]), rtol=1e-6)
+
+
+def test_fedavg_resume_of_completed_run_just_finishes(tmp_path):
+    """Restarting a server whose checkpoint already holds the final round
+    dismisses the silos immediately instead of re-running anything."""
+    init = _params_tree(4)
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1)
+    done, comp = _run_fedavg(init, 3, ck=ck)
+    assert comp == [0, 1, 2]
+
+    again, comp2 = _run_fedavg(init, 3, ck=RoundCheckpointer(
+        str(tmp_path / "ck")))
+    assert comp2 == []
+    assert again.round_idx == 3
+    np.testing.assert_allclose(
+        np.asarray(again.params["dense"]["kernel"]),
+        np.asarray(done.params["dense"]["kernel"]), rtol=1e-6)
+
+
+def test_async_server_crash_and_resume_completes(tmp_path):
+    """FedBuff server killed after version 2 of 5 resumes from its
+    checkpoint and closes the remaining versions."""
+    from fedml_tpu.algorithms.async_fl import (AsyncFedServerActor,
+                                               delta_encoder)
+
+    init = _params_tree(5)
+
+    def run(ck=None, crash_after=None):
+        hub = LocalHub()
+        versions_seen = []
+
+        def on_version(v, p):
+            versions_seen.append(v)
+            if crash_after is not None and v >= crash_after:
+                raise _Crash()
+
+        server = AsyncFedServerActor(
+            hub.transport(0), init, client_num_in_total=6, n_silos=3,
+            num_versions=5, aggregation_goal=3, seed=0,
+            on_version=on_version, checkpointer=ck)
+        clients = [FedAvgClientActor(i, hub.transport(i),
+                                     _add_train_fn(float(i)),
+                                     encode_upload=delta_encoder)
+                   for i in (1, 2, 3)]
+        server.register_handlers()
+        for c in clients:
+            c.register_handlers()
+        if crash_after is not None:
+            with pytest.raises(_Crash):
+                server.start()
+                hub.pump()
+        else:
+            server.start()
+            hub.pump()
+        return server, versions_seen
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1)
+    crashed, seen1 = run(ck=ck, crash_after=2)
+    assert seen1 == [1, 2]
+    assert ck.latest_round() == 1  # step = version - 1
+
+    resumed, seen2 = run(ck=RoundCheckpointer(str(tmp_path / "ck")))
+    assert seen2 == [3, 4, 5], "resume must continue from version 2"
+    assert resumed.version == 5
+    k = np.asarray(resumed.params["dense"]["kernel"])
+    assert np.isfinite(k).all()
+    assert float(np.abs(k - init["dense"]["kernel"]).max()) > 0.1
+
+
+def test_async_duplicate_upload_rejected_even_after_flush():
+    """At-most-once guard: a duplicated frame whose first copy was
+    already aggregated (buffer flushed) must STILL be rejected — the
+    consumed set outlives the buffer."""
+    from fedml_tpu.algorithms.async_fl import AsyncFedServerActor
+
+    hub = LocalHub()
+    init = _params_tree(10)
+    server = AsyncFedServerActor(
+        hub.transport(0), init, client_num_in_total=4, n_silos=2,
+        num_versions=3, aggregation_goal=1, seed=0)
+    hub.transport(1), hub.transport(2)  # endpoints for tasking sends
+    server.register_handlers()
+    server.start()
+    hub.pump()
+
+    def upload():
+        return (Message(MsgType.C2S_MODEL, 1, 0)
+                .add(Message.ARG_MODEL_PARAMS,
+                     {"dense": {"kernel": np.ones((4, 3), np.float32),
+                                "bias": np.ones(3, np.float32)}})
+                .add(Message.ARG_NUM_SAMPLES, 10)
+                .add(Message.ARG_ROUND, 0))
+
+    hub.route(upload())
+    hub.pump()
+    assert server.version == 1  # goal=1: first copy applied immediately
+    hub.route(upload())  # wire duplicate of the SAME (silo, base_version)
+    hub.pump()
+    assert server.version == 1, "duplicate applied twice after flush"
+    assert len(server.staleness_seen) == 1
+
+
+def _route_timeout(hub, round_idx):
+    hub.route(Message(MsgType.ROUND_TIMEOUT, 0, 0)
+              .add(Message.ARG_ROUND, round_idx))
+
+
+def test_failure_detector_shrinks_quorum_and_rejoins():
+    """Deterministic (fake-clock, pump-mode) walk through the detector
+    lifecycle: a silo dies → first dropped by timeout, then declared DEAD
+    and excluded at broadcast (the round closes WITHOUT a timeout), then
+    rejoins via a heartbeat and is re-included the next round."""
+    t = [0.0]
+    detector = FailureDetector(suspect_after_s=0.5, dead_after_s=1.0,
+                               clock=lambda: t[0])
+    hub = LocalHub()
+    init = _params_tree(6)
+    completed = []
+    server = FedAvgServerActor(
+        hub.transport(0), init, client_num_in_total=3,
+        client_num_per_round=3, num_rounds=5,
+        on_round_done=lambda r, p: completed.append(r),
+        straggler_policy="drop", round_timeout_s=30.0, min_silo_frac=0.3,
+        failure_detector=detector)
+
+    # silo 3 "dies" after round 0 (everything it sends for rounds >= 1 is
+    # cut); silo 2 goes quiet from round 3 to keep later rounds open
+    t3 = ChaosTransport(hub.transport(3), ChaosPlan(links={
+        (3, 0): LinkChaos(partition=Partition(after_round=1))}))
+    t2 = ChaosTransport(hub.transport(2), ChaosPlan(links={
+        (2, 0): LinkChaos(partition=Partition(after_round=3))}))
+    trained_rounds = {1: [], 2: [], 3: []}
+
+    def spy_train(silo):
+        inner = _add_train_fn(float(silo))
+
+        def fn(params, client_idx, round_idx):
+            trained_rounds[silo].append(round_idx)
+            return inner(params, client_idx, round_idx)
+        return fn
+
+    clients = [FedAvgClientActor(1, hub.transport(1), spy_train(1)),
+               FedAvgClientActor(2, t2, spy_train(2)),
+               FedAvgClientActor(3, t3, spy_train(3))]
+    server.register_handlers()
+    for c in clients:
+        c.register_handlers()
+
+    server.start()
+    hub.pump()
+    # round 0 closed with everyone; round 1 is open: silo 3's upload was cut
+    assert completed == [0]
+    assert sorted(server._received) == [1, 2]
+
+    # silos 1 and 2 keep beating; silo 3 has been silent past dead_after_s
+    t[0] = 1.5
+    hub.route(Message(MsgType.C2S_HEARTBEAT, 1, 0))
+    hub.route(Message(MsgType.C2S_HEARTBEAT, 2, 0))
+    _route_timeout(hub, 1)
+    hub.pump()
+    # the timeout dropped silo 3 from round 1; at the round-2 broadcast
+    # the detector declared it DEAD and EXCLUDED it, so round 2 closed on
+    # silos {1,2} alone — no timeout injection was needed (the quorum
+    # shrank instead of re-paying the timeout).  Round 3 is open because
+    # silo 2 went quiet.
+    assert completed == [0, 1, 2]
+    assert server.dropped_silos[1] == [3]
+    assert server.dropped_silos[2] == [3]
+    assert detector.state(3) == FailureDetector.DEAD
+    assert server.round_idx == 3
+
+    # silo 3 comes back: its heartbeat is a REJOIN — the server must ship
+    # it the current global + round index immediately
+    t[0] = 2.0
+    hub.route(Message(MsgType.C2S_HEARTBEAT, 3, 0))
+    hub.pump()
+    assert detector.state(3) == FailureDetector.ALIVE
+    assert trained_rounds[3][-1] == 3, \
+        "rejoined silo never received the current round's sync"
+    # its round-3 upload was cut by the partition anyway; close round 3 by
+    # timeout (drops silo 2, whose uploads are now cut too)
+    _route_timeout(hub, 3)
+    hub.pump()
+    assert completed == [0, 1, 2, 3]
+    assert server.dropped_silos[3] == [2, 3]
+    # round 4: the rejoined silo is back in the EXPECTED set
+    assert 3 in server._expected
+    _route_timeout(hub, 4)
+    hub.pump()
+    assert completed == [0, 1, 2, 3, 4]
+    assert server.round_idx == 5
+
+
+def test_straggler_timer_never_outlives_federation():
+    """Satellite: finish()/abort joins the straggler timer thread — after
+    the federation ends no Timer may still be pending (leaked-thread
+    warning under -W error)."""
+    hub = LocalHub()
+    init = _params_tree(7)
+    server = FedAvgServerActor(
+        hub.transport(0), init, client_num_in_total=2,
+        client_num_per_round=2, num_rounds=2,
+        straggler_policy="drop", round_timeout_s=30.0, min_silo_frac=0.5)
+    clients = [FedAvgClientActor(i, hub.transport(i), _add_train_fn(1.0))
+               for i in (1, 2)]
+    server.register_handlers()
+    for c in clients:
+        c.register_handlers()
+    server.start()
+    assert server._timer.pending  # armed during the open round
+    hub.pump()
+    assert server.round_idx == 2
+    assert not server._timer.pending
+    live_timers = [th for th in threading.enumerate()
+                   if isinstance(th, threading.Timer)]
+    assert not live_timers, f"leaked straggler timers: {live_timers}"
+
+
+def test_abort_path_cancels_timer_and_stops_transport():
+    hub = LocalHub()
+    server = FedAvgServerActor(
+        hub.transport(0), _params_tree(8), client_num_in_total=2,
+        client_num_per_round=2, num_rounds=3,
+        straggler_policy="abort", round_timeout_s=30.0)
+    hub.transport(1), hub.transport(2)  # endpoints exist, nobody listens
+    server.register_handlers()
+    server.start()
+    # nobody answers; fire the timeout by hand (pump mode)
+    _route_timeout(hub, 0)
+    hub.pump()
+    assert server.aborted
+    assert not server._timer.pending and server._finished
+    assert not [th for th in threading.enumerate()
+                if isinstance(th, threading.Timer)]
+    server.finish()  # double-finish tolerated (stop() is idempotent)
+
+
+@pytest.mark.slow
+def test_threaded_chaos_crash_recovery_end_to_end(tmp_path):
+    """The full acceptance story in one run: threaded federation behind
+    chaotic links (drops/delays/dups + one death partition), drop-policy
+    server with checkpointing crashes after round 2, a restarted server
+    resumes from the checkpoint and the federation completes."""
+    init = _params_tree(9)
+    n_silos, n_rounds = 3, 6
+    ck_dir = str(tmp_path / "ck")
+
+    def build(hub, ck, crash_after=None):
+        completed = []
+
+        def on_done(r, p):
+            completed.append(r)
+            if crash_after is not None and r >= crash_after:
+                raise _Crash()
+
+        server = FedAvgServerActor(
+            hub.transport(0), init, client_num_in_total=n_silos,
+            client_num_per_round=n_silos, num_rounds=n_rounds,
+            on_round_done=on_done, straggler_policy="drop",
+            round_timeout_s=0.4, min_silo_frac=0.3, checkpointer=ck)
+        transports = {1: hub.transport(1)}
+        for i in (2, 3):
+            transports[i] = ChaosTransport(hub.transport(i), ChaosPlan(
+                seed=i, links={(i, 0): LinkChaos(
+                    drop_prob=0.1, delay_prob=0.3, max_delay_s=0.1,
+                    dup_prob=0.1,
+                    partition=(Partition(after_round=4) if i == 3
+                               else None))},
+                immune_types=(MsgType.S2C_FINISH,)))
+        actors = [FedAvgClientActor(i, transports[i],
+                                    _add_train_fn(float(i)))
+                  for i in range(1, n_silos + 1)]
+        return server, actors, completed
+
+    def run_threaded(server, actors, expect_crash):
+        threads = [threading.Thread(target=a.run, daemon=True)
+                   for a in actors]
+        for th in threads:
+            th.start()
+        server.register_handlers()
+        outcome = {}
+
+        def _serve():
+            try:
+                server.start()
+                server.transport.run()
+                outcome["done"] = True
+            except _Crash:
+                outcome["crashed"] = True
+
+        st = threading.Thread(target=_serve, daemon=True)
+        st.start()
+        st.join(timeout=60)
+        assert not st.is_alive(), "server wedged"
+        if expect_crash:
+            assert outcome.get("crashed"), "crash hook never fired"
+
+    ck = RoundCheckpointer(ck_dir, save_every=1)
+    server1, actors1, completed1 = build(LocalHub(), ck, crash_after=2)
+    run_threaded(server1, actors1, expect_crash=True)
+    assert completed1[-1] >= 2 and ck.latest_round() >= 2
+
+    hub2 = LocalHub()
+    server2, actors2, completed2 = build(hub2, RoundCheckpointer(ck_dir))
+    run_threaded(server2, actors2, expect_crash=False)
+    assert server2.round_idx == n_rounds
+    assert completed2[0] == ck.latest_round() + 1 or not completed2
+    assert np.isfinite(
+        np.asarray(server2.params["dense"]["kernel"])).all()
