@@ -20,6 +20,9 @@ Layer map (mirrors SURVEY.md §1 of the reference):
     fedml_tpu.parallel      mesh / shard_map cohort engine (replaces MPI runtime)
     fedml_tpu.comm          cross-silo transports: Message protocol, local fake,
                             gRPC, MQTT (fedml_core/distributed/communication/*)
+    fedml_tpu.obs           observability: distributed round tracing,
+                            telemetry registry (Prometheus/JSON), run reports
+                            (beyond the reference's rank-0 wandb logging)
 """
 
 __version__ = "0.1.0"
@@ -39,6 +42,9 @@ _API = {
     "make_client_optimizer": "fedml_tpu.trainer.workload",
     "make_local_trainer": "fedml_tpu.trainer.local_sgd",
     "RoundCheckpointer": "fedml_tpu.utils.checkpoint",
+    "MetricsSink": "fedml_tpu.utils.metrics",
+    "SpanTracer": "fedml_tpu.obs.trace",
+    "TelemetryRegistry": "fedml_tpu.obs.telemetry",
 }
 
 __all__ = sorted(_API) + ["__version__"]

@@ -43,6 +43,7 @@ from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
 from fedml_tpu.algorithms.cross_silo import MsgType
 from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.obs import telemetry
 
 log = logging.getLogger(__name__)
 
@@ -111,6 +112,14 @@ class AsyncFedServerActor(ServerManager):
         # guard must survive buffer flushes, not just scan the live buffer
         self._consumed: set = set()
         self._finished = False
+        # version observability: inter-aggregation gap + per-upload
+        # staleness (null no-ops when telemetry is disabled)
+        reg = telemetry.get_registry()
+        self._h_version = reg.histogram(
+            "fedml_async_version_duration_seconds")
+        self._h_staleness = reg.histogram(
+            "fedml_async_staleness_total", buckets=(0, 1, 2, 4, 8, 16, 32))
+        self._version_t0: Optional[float] = None
 
     def register_handlers(self) -> None:
         self.register_handler(MsgType.C2S_MODEL, self._on_model)
@@ -139,9 +148,15 @@ class AsyncFedServerActor(ServerManager):
             return
         ids = sample_clients(0, self.client_num_in_total, self.n_silos)
         now = time.monotonic()
-        for silo, client_idx in enumerate(ids, start=1):
-            self._last_heard[silo] = now
-            self._task(silo, int(client_idx), MsgType.S2C_INIT)
+        self._version_t0 = now
+        # one root span for the initial tasking wave, so version-0 silo
+        # train/upload spans stitch into a single trace instead of N
+        # disconnected fragments
+        with self._root_span("tasking", f"version{self.version}",
+                             version=self.version):
+            for silo, client_idx in enumerate(ids, start=1):
+                self._last_heard[silo] = now
+                self._task(silo, int(client_idx), MsgType.S2C_INIT)
         self._arm_retask_timer()
 
     # -- liveness watchdog --------------------------------------------------
@@ -172,7 +187,12 @@ class AsyncFedServerActor(ServerManager):
                 log.warning("silo %d quiet for %.1fs; re-tasking against "
                             "version %d", silo, quiet, self.version)
                 self._last_heard[silo] = now  # one nudge per timeout window
-                self._task(silo, self._next_client())
+                # watchdog ticks are self-messages with no inbound trace
+                # context — root each nudge so its train/upload stitch
+                with self._root_span("retask",
+                                     f"retask-v{self.version}-s{silo}",
+                                     silo=silo, version=self.version):
+                    self._task(silo, self._next_client())
         self._arm_retask_timer()
 
     def _task(self, silo: int, client_idx: int, msg_type=MsgType.S2C_SYNC):
@@ -212,12 +232,17 @@ class AsyncFedServerActor(ServerManager):
         staleness = self.version - base_version
         discount = float(1.0 + staleness) ** (-self.alpha)
         self.staleness_seen.append(staleness)
+        self._h_staleness.observe(staleness)
         self._buffer.append(
             (delta, num_samples, discount, msg.sender_id, base_version))
         if len(self._buffer) >= self.goal:
             self._apply_buffer()
 
     def _apply_buffer(self) -> None:
+        now = time.monotonic()
+        if self._version_t0 is not None:
+            self._h_version.observe(now - self._version_t0)
+        self._version_t0 = now
         deltas = [d for d, _, _, _, _ in self._buffer]
         samples = np.asarray([n for _, n, _, _, _ in self._buffer],
                              np.float64)
@@ -226,14 +251,19 @@ class AsyncFedServerActor(ServerManager):
         # Sample ratios sum to 1; the staleness discount multiplies each
         # term afterwards so stale buffers shrink the applied step itself.
         coeffs = discounts * samples / max(samples.sum(), 1e-12)
-        mean = jax.tree.map(
-            lambda *leaves: sum(c * np.asarray(l, np.float64)
-                                for c, l in zip(coeffs, leaves)),
-            *deltas)
-        self.params = jax.tree.map(
-            lambda p, d: (np.asarray(p, np.float64)
-                          + self.server_lr * d).astype(np.asarray(p).dtype),
-            self.params, mean)
+        # traced as a child of whichever upload's handling tripped the
+        # goal, so the async trace shows which silo closed each version
+        with self._span("aggregate", version=self.version,
+                        buffered=len(deltas)):
+            mean = jax.tree.map(
+                lambda *leaves: sum(c * np.asarray(l, np.float64)
+                                    for c, l in zip(coeffs, leaves)),
+                *deltas)
+            self.params = jax.tree.map(
+                lambda p, d: (np.asarray(p, np.float64)
+                              + self.server_lr * d).astype(
+                                  np.asarray(p).dtype),
+                self.params, mean)
         silos = [s for _, _, _, s, _ in self._buffer]
         self._consumed.update((s, b) for _, _, _, s, b in self._buffer)
         self._buffer.clear()

@@ -35,6 +35,7 @@ from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
 from fedml_tpu.core.pytree import tree_weighted_mean
 from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.obs import telemetry
 
 log = logging.getLogger(__name__)
 
@@ -86,6 +87,13 @@ class FailureDetector:
         self._clock = clock
         self._last_heard: Dict[int, float] = {}
         self._declared_dead: Set[int] = set()
+        # health gauges refresh on every full states() sweep (each round's
+        # broadcast and every straggler-timeout log both sweep)
+        reg = telemetry.get_registry()
+        self._gauges = {
+            self.ALIVE: reg.gauge("fedml_failure_detector_alive_total"),
+            self.SUSPECT: reg.gauge("fedml_failure_detector_suspect_total"),
+            self.DEAD: reg.gauge("fedml_failure_detector_dead_total")}
 
     def register(self, silo: int) -> None:
         """Start the clock for a silo without marking a real beat (called
@@ -115,11 +123,14 @@ class FailureDetector:
         return self.ALIVE
 
     def states(self) -> Dict[int, str]:
-        return {silo: self.state(silo) for silo in sorted(self._last_heard)}
+        out = {silo: self.state(silo) for silo in sorted(self._last_heard)}
+        for health, gauge in self._gauges.items():
+            gauge.set(sum(1 for s in out.values() if s == health))
+        return out
 
     def dead_silos(self) -> Set[int]:
-        return {silo for silo in self._last_heard
-                if self.state(silo) == self.DEAD}
+        return {silo for silo, health in self.states().items()
+                if health == self.DEAD}
 
 
 # a silo-local trainer: (global_params, client_idx, round_idx) ->
@@ -199,6 +210,19 @@ class FedAvgServerActor(ServerManager):
         # next sync so silos can settle deferred error-feedback residuals
         # (a dropped upload must carry its FULL delta forward)
         self._last_accepted: Optional[np.ndarray] = None
+        # round observability: duration / tail-wait / quorum histograms
+        # (null no-ops when telemetry is disabled) + the per-round trace
+        # span broadcast→aggregate child spans hang off
+        reg = telemetry.get_registry()
+        self._h_round = reg.histogram("fedml_round_duration_seconds")
+        self._h_straggler = reg.histogram(
+            "fedml_round_straggler_wait_seconds")
+        self._h_quorum = reg.histogram(
+            "fedml_round_quorum_size_total",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+        self._round_t0: Optional[float] = None
+        self._first_upload_t: Optional[float] = None
+        self._round_span = None
 
     def register_handlers(self) -> None:
         self.register_handler(MsgType.C2S_MODEL, self._on_model)
@@ -281,16 +305,28 @@ class FedAvgServerActor(ServerManager):
                      self.round_idx, sorted(dead))
             self.dropped_silos.setdefault(self.round_idx, []).extend(
                 sorted(dead))
+        self._round_t0 = time.monotonic()
+        self._first_upload_t = None
+        if self._tracer is not None:
+            # one trace per round, rooted here: broadcast/recv/train/
+            # upload/aggregate all stitch under this trace id
+            self._round_span = self._tracer.start_span(
+                "round", parent=None, node=self.node_id,
+                trace_id=self._tracer.new_trace_id(
+                    f"round{self.round_idx}"),
+                round=self.round_idx)
         host_params = jax.tree.map(np.asarray, self.params)
         extra = ({} if self._last_accepted is None
                  else {Message.ARG_ACCEPTED: self._last_accepted})
-        for silo, client_idx in enumerate(ids, start=1):
-            if silo in dead:
-                continue
-            self.send(msg_type, silo,
-                      **{Message.ARG_MODEL_PARAMS: host_params,
-                         Message.ARG_CLIENT_INDEX: int(client_idx),
-                         Message.ARG_ROUND: self.round_idx, **extra})
+        with self._span("broadcast", parent=self._round_span,
+                        round=self.round_idx):
+            for silo, client_idx in enumerate(ids, start=1):
+                if silo in dead:
+                    continue
+                self.send(msg_type, silo,
+                          **{Message.ARG_MODEL_PARAMS: host_params,
+                             Message.ARG_CLIENT_INDEX: int(client_idx),
+                             Message.ARG_ROUND: self.round_idx, **extra})
         self._arm_timer()
 
     # -- straggler timer ----------------------------------------------------
@@ -399,6 +435,8 @@ class FedAvgServerActor(ServerManager):
                     f"{msg.sender_id} sent plain parameters; launch silos "
                     f"with the same --wire_compression")
             upload = self.decode_upload(upload, self.params)
+        if self._first_upload_t is None:
+            self._first_upload_t = time.monotonic()
         self._received[msg.sender_id] = (
             upload, msg.get(Message.ARG_NUM_SAMPLES))
         if self._expected:
@@ -410,6 +448,14 @@ class FedAvgServerActor(ServerManager):
 
     def _complete_round(self) -> None:
         self._cancel_timer()
+        now = time.monotonic()
+        self._h_quorum.observe(len(self._received))
+        if self._round_t0 is not None:
+            self._h_round.observe(now - self._round_t0)
+        if self._first_upload_t is not None:
+            # tail wait: how long the round's LAST accepted upload (or the
+            # drop-policy timeout) trailed the first one
+            self._h_straggler.observe(now - self._first_upload_t)
         if self.round_idx in self.dropped_silos:  # normalize the drop log
             self.dropped_silos[self.round_idx] = sorted(
                 set(self.dropped_silos[self.round_idx]))
@@ -418,7 +464,12 @@ class FedAvgServerActor(ServerManager):
                            dtype=np.float32)
         self._last_accepted = np.asarray(sorted(self._received), np.int32)
         self._received.clear()
-        self.params = tree_weighted_mean(trees, weights)
+        with self._span("aggregate", parent=self._round_span,
+                        round=self.round_idx, quorum=len(trees)):
+            self.params = tree_weighted_mean(trees, weights)
+        if self._round_span is not None:
+            self._round_span.end()
+            self._round_span = None
         if self.checkpointer is not None:
             self.checkpointer.maybe_save(
                 self.round_idx, self._checkpoint_state(self.round_idx),
@@ -504,11 +555,17 @@ class FedAvgClientActor(ClientManager):
         self._round = round_idx
         if self.on_accepted is not None:
             self.on_accepted(msg.get(Message.ARG_ACCEPTED))
-        new_params, num_samples = self.train_fn(params, client_idx, round_idx)
+        # deterministic span ids: a chaos-duplicated sync re-trains, but
+        # its train/upload spans collapse onto the first delivery's
+        with self._span("train", deterministic=True, round=round_idx,
+                        client=client_idx):
+            new_params, num_samples = self.train_fn(params, client_idx,
+                                                    round_idx)
         upload = jax.tree.map(np.asarray, new_params)
         if self.encode_upload is not None:
             upload = self.encode_upload(upload, params)
-        self.send(MsgType.C2S_MODEL, 0,
-                  **{Message.ARG_MODEL_PARAMS: upload,
-                     Message.ARG_NUM_SAMPLES: int(num_samples),
-                     Message.ARG_ROUND: round_idx})
+        with self._span("upload", deterministic=True, round=round_idx):
+            self.send(MsgType.C2S_MODEL, 0,
+                      **{Message.ARG_MODEL_PARAMS: upload,
+                         Message.ARG_NUM_SAMPLES: int(num_samples),
+                         Message.ARG_ROUND: round_idx})

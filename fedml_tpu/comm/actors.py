@@ -15,12 +15,14 @@ actors exist only for host-edge (cross-silo gRPC / device) deployments.
 from __future__ import annotations
 
 import abc
+import contextlib
 import logging
 import threading
 from typing import Callable, Dict
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
+from fedml_tpu.obs import trace
 
 log = logging.getLogger(__name__)
 
@@ -87,13 +89,39 @@ class SelfMessageTimer:
 
 
 class NodeManager(abc.ABC):
-    """Event-loop node with a message-type → handler registry."""
+    """Event-loop node with a message-type → handler registry.
+
+    Tracing: when the process tracer is enabled (obs/trace.py), every
+    ``send()`` inside an active span stamps the span's context onto the
+    message, and every inbound message CARRYING a context is handled
+    under a ``recv:<type>`` child span — so one federated round stitches
+    into a single cross-node trace with no per-algorithm code.  Handler
+    spans use deterministic ids, so a chaotic wire delivering a frame
+    twice collapses to one span.  Disabled (``_tracer is None``) both
+    paths are a single branch."""
 
     def __init__(self, node_id: int, transport: Transport):
         self.node_id = node_id
         self.transport = transport
         self.transport.add_observer(self)
         self._handlers: Dict[object, Callable[[Message], None]] = {}
+        self._tracer = trace.get_tracer()
+
+    def _span(self, name: str, **kw):
+        """A span context-manager on this node's track, or a null context
+        when tracing is disabled — call sites stay single-path."""
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(name, node=self.node_id, **kw)
+
+    def _root_span(self, name: str, hint: str = "", **kw):
+        """Like `_span` but starts a NEW trace (ignores any active span)
+        — for the spans that root a round/version/re-task tree."""
+        if self._tracer is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(
+            name, parent=None, node=self.node_id,
+            trace_id=self._tracer.new_trace_id(hint or name), **kw)
 
     # -- registry (reference client_manager.py:58-62) ------------------------
     def register_handler(self, msg_type, fn: Callable[[Message], None]) -> None:
@@ -110,6 +138,16 @@ class NodeManager(abc.ABC):
             log.warning("node %d: no handler for message type %r",
                         self.node_id, msg_type)
             return
+        if self._tracer is not None:
+            ctx = trace.extract(msg)
+            if ctx is not None:
+                # deterministic id: a duplicated delivery of the same frame
+                # re-runs the handler but records only one span
+                with self._tracer.span(f"recv:{msg_type}", parent=ctx,
+                                       node=self.node_id,
+                                       deterministic=True):
+                    handler(msg)
+                return
         handler(msg)
 
     # -- lifecycle (reference client_manager.py:34-36) -----------------------
@@ -121,6 +159,10 @@ class NodeManager(abc.ABC):
         msg = Message(msg_type, self.node_id, receiver_id)
         for k, v in params.items():
             msg.add(k, v)
+        if self._tracer is not None:
+            ctx = self._tracer.current_context()
+            if ctx is not None:
+                trace.inject(msg, ctx)
         self.transport.send_message(msg)
 
     def finish(self) -> None:

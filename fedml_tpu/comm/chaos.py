@@ -44,6 +44,7 @@ from typing import Dict, Optional, Tuple
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
+from fedml_tpu.obs import telemetry
 
 
 @dataclasses.dataclass
@@ -138,6 +139,16 @@ class ChaosTransport(Transport):
         # fault kind -> count, for assertions ("chaos actually happened")
         self.faults: Dict[str, int] = {
             "drop": 0, "delay": 0, "dup": 0, "reorder": 0, "partition": 0}
+        # telemetry mirror, one labeled counter per kind (null no-ops when
+        # telemetry is disabled); handles are pre-built so the fault path
+        # never allocates
+        reg = telemetry.get_registry()
+        self._m_faults = {k: reg.counter("fedml_chaos_faults_total", kind=k)
+                          for k in self.faults}
+
+    def _fault(self, kind: str) -> None:
+        self.faults[kind] += 1
+        self._m_faults[kind].inc()
 
     # -- observer passthrough ------------------------------------------------
     def add_observer(self, observer) -> None:
@@ -182,7 +193,7 @@ class ChaosTransport(Transport):
             return
         elapsed = time.monotonic() - self._t0
         if link.partition is not None and link.partition.cuts(msg, elapsed):
-            self.faults["partition"] += 1
+            self._fault("partition")
             return
         # one fixed-size draw per message keeps the per-link stream
         # deterministic even when probabilities differ between links; the
@@ -193,25 +204,25 @@ class ChaosTransport(Transport):
             u_drop, u_delay, u_dup, u_reorder, u_t = \
                 self._rng(src, dst).uniform(size=5)
         if u_drop < link.drop_prob:
-            self.faults["drop"] += 1
+            self._fault("drop")
             return
         with self._lock:
             held = self._held.pop((src, dst), None)
         if u_reorder < link.reorder_prob:
             # hold this message; it rides AFTER the next send on the link
             # (or after a flush timeout so it cannot be held forever)
-            self.faults["reorder"] += 1
+            self._fault("reorder")
             with self._lock:
                 self._held[(src, dst)] = msg
             self._after(max(link.max_delay_s, 0.05),
                         self._flush_held, (src, dst))
         elif u_delay < link.delay_prob:
-            self.faults["delay"] += 1
+            self._fault("delay")
             self._after(float(u_t) * link.max_delay_s, self._deliver, msg)
         else:
             self._deliver(msg)
         if u_dup < link.dup_prob:
-            self.faults["dup"] += 1
+            self._fault("dup")
             self._deliver(msg)
         if held is not None:  # release the previously held message last
             self._deliver(held)

@@ -109,8 +109,10 @@ class GrpcTransport(Transport):
             return self._channels[receiver_id][1]
 
     def send_message(self, msg: Message) -> None:
+        data = msg.to_bytes()
+        self._obs_send(msg, len(data))
         self._stub(msg.receiver_id)(
-            msg.to_bytes(), wait_for_ready=True,
+            data, wait_for_ready=True,
             timeout=self._send_timeout_s or None)
 
     def reconnect(self) -> None:

@@ -24,6 +24,7 @@ from typing import Dict
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
+from fedml_tpu.obs import telemetry
 
 _STOP = object()
 
@@ -37,6 +38,8 @@ class LocalHub:
         # would
         self.codec_roundtrip = codec_roundtrip
         self._endpoints: Dict[int, "LocalTransport"] = {}
+        self._reg = telemetry.get_registry()
+        self._link_bytes: Dict[tuple, object] = {}
 
     def transport(self, node_id: int) -> "LocalTransport":
         t = LocalTransport(self, node_id)
@@ -45,7 +48,15 @@ class LocalHub:
 
     def route(self, msg: Message) -> None:
         if self.codec_roundtrip:
-            msg = Message.from_bytes(msg.to_bytes())
+            data = msg.to_bytes()
+            if self._reg.enabled:
+                # the codec roundtrip IS this hub's wire: report its frame
+                # size like a real transport reports socket bytes
+                telemetry.link_counter(
+                    self._reg, self._link_bytes,
+                    "fedml_comm_wire_bytes_total",
+                    msg.sender_id, msg.receiver_id).inc(len(data))
+            msg = Message.from_bytes(data)
         target = self._endpoints.get(msg.receiver_id)
         if target is None:
             raise KeyError(f"no endpoint for receiver {msg.receiver_id}")
@@ -87,6 +98,7 @@ class LocalTransport(Transport):
         self._stopped = False
 
     def send_message(self, msg: Message) -> None:
+        self._obs_send(msg)
         self.hub.route(msg)
 
     def run(self) -> None:

@@ -41,6 +41,10 @@ class Message:
     ARG_CLIENT_INDEX = "client_idx"
     ARG_ROUND = "round_idx"
     ARG_ACCEPTED = "accepted_silos"  # silo ids aggregated last round (EF ack)
+    # span context (obs/trace.py CTX_KEY): a {"t","s"} dict riding the
+    # plain JSON header, so one federated round stitches into a single
+    # cross-process trace
+    ARG_TRACE = "_trace"
 
     def __init__(self, msg_type: int | str = 0, sender_id: int = 0,
                  receiver_id: int = 0):

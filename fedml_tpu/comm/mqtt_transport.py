@@ -76,8 +76,9 @@ class MqttTransport(Transport):
         self._inbox.put(Message.from_bytes(mqtt_msg.payload))
 
     def send_message(self, msg: Message) -> None:
-        self._client.publish(self._topic(msg.receiver_id), msg.to_bytes(),
-                             qos=1)
+        data = msg.to_bytes()
+        self._obs_send(msg, len(data))
+        self._client.publish(self._topic(msg.receiver_id), data, qos=1)
 
     def reconnect(self) -> None:
         """Tear down and re-run the CONNECT/SUBSCRIBE handshake against the

@@ -47,6 +47,7 @@ import numpy as np
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
+from fedml_tpu.obs import telemetry
 
 log = logging.getLogger(__name__)
 
@@ -100,6 +101,13 @@ class ResilientTransport(Transport):
         self.sent_ok = 0
         self.retries = 0
         self.dead_letters = 0
+        # telemetry mirrors of the attribute counters above (null no-ops
+        # when telemetry is disabled); _m_retry increments exactly once
+        # per retried attempt, in lockstep with self.retries
+        reg = telemetry.get_registry()
+        self._m_ok = reg.counter("fedml_comm_send_ok_total")
+        self._m_retry = reg.counter("fedml_comm_send_retries_total")
+        self._m_dead = reg.counter("fedml_comm_dead_letter_total")
         self._sender = threading.Thread(target=self._drain, daemon=True,
                                         name="resilient-sender")
         self._sender.start()
@@ -128,6 +136,7 @@ class ResilientTransport(Transport):
 
     def _dead_letter(self, msg: Message, exc: Exception) -> None:
         self.dead_letters += 1
+        self._m_dead.inc()
         if self.on_dead_letter is not None:
             self.on_dead_letter(msg, exc)
         else:
@@ -155,6 +164,7 @@ class ResilientTransport(Transport):
             try:
                 self.inner.send_message(msg)
                 self.sent_ok += 1
+                self._m_ok.inc()
                 return
             except Exception as exc:  # noqa: BLE001 — any wire error retries
                 if self._stopped:
@@ -171,6 +181,7 @@ class ResilientTransport(Transport):
                             "%.3fs", attempt + 1, self.policy.max_attempts,
                             exc, pause)
                 self.retries += 1
+                self._m_retry.inc()
                 time.sleep(pause)
                 reconnect = getattr(self.inner, "reconnect", None)
                 if reconnect is not None:

@@ -18,6 +18,7 @@ import abc
 from typing import Protocol, runtime_checkable
 
 from fedml_tpu.comm.message import Message
+from fedml_tpu.obs import telemetry
 
 
 @runtime_checkable
@@ -26,12 +27,35 @@ class Observer(Protocol):
 
 
 class Transport(abc.ABC):
-    """Abstract p2p transport: deliver Messages between numbered nodes."""
+    """Abstract p2p transport: deliver Messages between numbered nodes.
+
+    Telemetry: every concrete transport inherits per-link send/recv
+    counters (``fedml_comm_{send,recv,send_bytes}_total``, labeled
+    ``link="src->dst"``).  Handles come from the process registry at
+    construction; with telemetry disabled the registry is the null
+    object and each hot-path site pays one branch (``_reg.enabled``),
+    no allocations.  Subclasses call ``_obs_send(msg[, nbytes])`` where
+    they serialize/send; recv is counted centrally in ``_notify``.
+    """
 
     flavor = "p2p"
 
     def __init__(self):
         self._observers: list[Observer] = []
+        self._reg = telemetry.get_registry()
+        self._link_cache: dict = {}  # (name, src, dst) -> counter
+
+    def _obs_send(self, msg: Message, nbytes: int = 0) -> None:
+        if not self._reg.enabled:
+            return
+        telemetry.link_counter(self._reg, self._link_cache,
+                               "fedml_comm_send_total",
+                               msg.sender_id, msg.receiver_id).inc()
+        if nbytes:
+            telemetry.link_counter(self._reg, self._link_cache,
+                                   "fedml_comm_send_bytes_total",
+                                   msg.sender_id, msg.receiver_id
+                                   ).inc(nbytes)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -43,6 +67,10 @@ class Transport(abc.ABC):
             self._observers.remove(observer)
 
     def _notify(self, msg: Message) -> None:
+        if self._reg.enabled:
+            telemetry.link_counter(self._reg, self._link_cache,
+                                   "fedml_comm_recv_total",
+                                   msg.sender_id, msg.receiver_id).inc()
         for obs in self._observers:
             obs.receive_message(msg.type, msg)
 

@@ -176,10 +176,32 @@ class ExperimentConfig:
     num_processes: int = 1
     process_id: int = 0
 
-    # ---- observability --------------------------------------------------
+    # ---- observability (obs/ subsystem) --------------------------------
     run_dir: Optional[str] = None        # metrics.jsonl + summary.json here
-    profile_dir: Optional[str] = None    # jax.profiler trace dir
+    metrics_dir: Optional[str] = None    # alias for --run_dir (obs naming;
+    #                                      wins when both are given)
+    profile_dir: Optional[str] = None    # jax.profiler trace dir (XLA)
+    trace_dir: Optional[str] = None      # distributed round spans land here
+    #                                      (Perfetto trace_event JSON, one
+    #                                      file per process; stitch with
+    #                                      scripts/obs_report.py)
+    telemetry: bool = False              # enable the counter/gauge/histogram
+    #                                      registry; snapshot written to
+    #                                      run_dir/telemetry.{json,prom}
+    prom_port: int = 0                   # >0: serve live Prometheus text at
+    #                                      :port/metrics (implies telemetry)
     log_stdout: bool = True
+    # ---- chaos injection (comm/chaos.py over the local silo backend) ---
+    # seeded per-message fault probabilities for --algo cross_silo
+    # --silo_backend local; any non-zero value switches the local hub to
+    # the threaded drive (delayed frames arrive on wall-clock timers)
+    chaos_drop: float = 0.0              # drop prob (needs --straggler_policy
+    #                                      drop + --round_timeout_s)
+    chaos_delay: float = 0.0             # delay prob
+    chaos_max_delay_s: float = 0.05      # delay bound (also reorder flush)
+    chaos_dup: float = 0.0               # duplicate prob
+    chaos_reorder: float = 0.0           # reorder (hold-back) prob
+    chaos_seed: int = 0                  # fault-schedule seed
 
     # ---- checkpoint / resume (orbax round-level, SURVEY §5.4) ----------
     checkpoint_dir: Optional[str] = None

@@ -486,25 +486,34 @@ def _silo_training_setup(cfg, data, wl):
     backwards query (never happens in a normal run) restarts it."""
     import jax
     import jax.numpy as jnp
-    from fedml_tpu.trainer.local_sgd import make_local_trainer
+    from fedml_tpu.trainer.local_sgd import (instrument_train_fn,
+                                             make_local_trainer)
     from fedml_tpu.trainer.workload import make_client_optimizer
 
-    local = jax.jit(make_local_trainer(
+    # instrument_train_fn is the identity when telemetry is disabled
+    local = instrument_train_fn(jax.jit(make_local_trainer(
         wl, make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd),
-        cfg.epochs))
+        cfg.epochs)), epochs=cfg.epochs)
+    import threading
     _chain = {"next_round": 0,
               "rng": jax.random.split(jax.random.key(cfg.seed))[0]}
+    # the chaos CLI mode drives silos on separate THREADS sharing this
+    # chain; an unlocked advance would over-step next_round and silently
+    # break the (seed, round) determinism contract
+    _chain_lock = threading.Lock()
 
     def _round_rng(round_idx):
-        if round_idx < _chain["next_round"] - 1:
-            _chain["next_round"] = 0
-            _chain["rng"] = jax.random.split(jax.random.key(cfg.seed))[0]
-        if round_idx == _chain["next_round"] - 1:
+        with _chain_lock:
+            if round_idx < _chain["next_round"] - 1:
+                _chain["next_round"] = 0
+                _chain["rng"] = jax.random.split(jax.random.key(cfg.seed))[0]
+            if round_idx == _chain["next_round"] - 1:
+                return _chain["last"]
+            while _chain["next_round"] <= round_idx:
+                _chain["rng"], _chain["last"] = \
+                    jax.random.split(_chain["rng"])
+                _chain["next_round"] += 1
             return _chain["last"]
-        while _chain["next_round"] <= round_idx:
-            _chain["rng"], _chain["last"] = jax.random.split(_chain["rng"])
-            _chain["next_round"] += 1
-        return _chain["last"]
 
     def make_train_fn(silo_id):
         def train_fn(params, client_idx, round_idx):
@@ -705,18 +714,66 @@ def run_cross_silo(cfg, data, mesh, sink):
         s.register_handlers()
         return s
 
+    chaos_on = any((cfg.chaos_drop, cfg.chaos_delay, cfg.chaos_dup,
+                    cfg.chaos_reorder))
+    if chaos_on and cfg.silo_backend != "local":
+        raise ValueError("--chaos_* injection wraps the local hub only; "
+                         "for real wires compose ChaosTransport in code")
     if cfg.silo_backend == "local":
+        import threading
         from fedml_tpu.comm.local import LocalHub
         hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
-        server = make_server(hub.transport(0))
-        silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
-                                   encode_upload=make_encode(i),
-                                   on_accepted=make_on_accepted(i))
+        wrap = lambda t: t  # noqa: E731
+        if chaos_on:
+            from fedml_tpu.algorithms.cross_silo import MsgType
+            from fedml_tpu.comm.chaos import (ChaosPlan, ChaosTransport,
+                                              LinkChaos)
+            if cfg.chaos_drop > 0 and (cfg.straggler_policy == "wait"
+                                       or not timeout):
+                raise ValueError(
+                    "--chaos_drop with the strict 'wait' barrier (or no "
+                    "--round_timeout_s) would wedge the federation on the "
+                    "first lost upload; use --straggler_policy drop "
+                    "--round_timeout_s T")
+            plan = ChaosPlan(
+                seed=cfg.chaos_seed,
+                default=LinkChaos(drop_prob=cfg.chaos_drop,
+                                  delay_prob=cfg.chaos_delay,
+                                  max_delay_s=cfg.chaos_max_delay_s,
+                                  dup_prob=cfg.chaos_dup,
+                                  reorder_prob=cfg.chaos_reorder),
+                # FINISH: shutdown liveness.  ROUND_TIMEOUT: the straggler
+                # timer's SELF-message rides the server's own chaotic
+                # transport on link (0,0) — dropping it disarms the only
+                # re-arm path and wedges the round
+                immune_types=(MsgType.S2C_FINISH, MsgType.ROUND_TIMEOUT))
+            wrap = lambda t: ChaosTransport(t, plan)  # noqa: E731
+        server = make_server(wrap(hub.transport(0)))
+        silos = [FedAvgClientActor(
+                     i, wrap(hub.transport(i)), make_train_fn(i),
+                     encode_upload=make_encode(i),
+                     on_accepted=make_on_accepted(i),
+                     heartbeat_interval_s=(cfg.heartbeat_s or None)
+                     if chaos_on else None)
                  for i in range(1, n_silos + 1)]
-        for s in silos:
-            s.register_handlers()
+        if not chaos_on:
+            for s in silos:
+                s.register_handlers()
+            server.start()
+            hub.pump()
+            return history[-1] if history else {}
+        # chaos delivers delayed/reordered frames on wall-clock timers,
+        # which the synchronous pump cannot wait for — drive each actor
+        # on its own thread like a real deployment
+        threads = [threading.Thread(target=s.run, daemon=True,
+                                    name=f"silo-{s.node_id}")
+                   for s in silos]
+        for th in threads:
+            th.start()
         server.start()
-        hub.pump()
+        server.transport.run()  # blocks until the final round's FINISH
+        for th in threads:
+            th.join(timeout=10)
         return history[-1] if history else {}
     if cfg.silo_backend == "grpc":
         from fedml_tpu.comm.grpc_transport import GrpcTransport, load_ip_table
@@ -1054,6 +1111,12 @@ def main(argv=None) -> Dict[str, Any]:
     if cfg.wire_compression != "none" and cfg.algo != "cross_silo":
         raise ValueError("--wire_compression only applies to "
                          "--algo cross_silo (the host-edge wire)")
+    if any((cfg.chaos_drop, cfg.chaos_delay, cfg.chaos_dup,
+            cfg.chaos_reorder)) and cfg.algo != "cross_silo":
+        raise ValueError(
+            f"--chaos_* injection is wired into --algo cross_silo only; "
+            f"--algo {cfg.algo} would silently run a CLEAN network and "
+            f"label the results as chaos results")
     if cfg.error_feedback and cfg.wire_compression == "none":
         raise ValueError("--error_feedback requires --wire_compression "
                          "topk or int8")
@@ -1070,15 +1133,61 @@ def main(argv=None) -> Dict[str, Any]:
     # multi-host: only process 0 writes run artifacts / prints the summary
     # (the reference's rank-0-only wandb, main_fedavg.py:288-296); other
     # processes keep an in-memory sink so runner code is rank-agnostic
+    import os
+
     import jax
     is_main = jax.process_index() == 0
-    with MetricsSink(cfg.run_dir if is_main else None,
-                     stdout=cfg.log_stdout and is_main,
-                     name=cfg.algo) as sink:
-        sink.log({"config": dataclasses.asdict(cfg)})
-        with profiler_trace(cfg.profile_dir if is_main else None):
-            summary = RUNNERS[cfg.algo](cfg, data, mesh, sink)
-        sink.log({"final": summary})
+    run_dir = cfg.metrics_dir or cfg.run_dir
+
+    # observability opt-ins, enabled BEFORE the runner constructs any
+    # transport/actor (instrumented constructors cache metric handles);
+    # exports happen in the finally so a crashed run still leaves its
+    # telemetry snapshot and whatever spans were recorded
+    from fedml_tpu.obs import telemetry as _telemetry, trace as _trace
+    registry = prom_server = tracer = None
+    if cfg.telemetry or cfg.prom_port > 0:
+        registry = _telemetry.enable()
+        if cfg.prom_port > 0:
+            prom_server = _telemetry.start_http_server(cfg.prom_port,
+                                                       registry)
+            logger.info("telemetry: serving /metrics on :%d", cfg.prom_port)
+    if cfg.trace_dir:
+        tracer = _trace.enable(node=f"node{cfg.node_id}")
+
+    try:
+        with MetricsSink(run_dir if is_main else None,
+                         stdout=cfg.log_stdout and is_main,
+                         name=cfg.algo) as sink:
+            sink.log({"config": dataclasses.asdict(cfg)})
+            with profiler_trace(cfg.profile_dir if is_main else None):
+                summary = RUNNERS[cfg.algo](cfg, data, mesh, sink)
+            sink.log({"final": summary})
+    finally:
+        # each teardown step independently: a failing export must not
+        # skip the remaining saves, leak the /metrics port, leave the
+        # process-global tracer/registry enabled for the next main()
+        # call, or mask the run's own exception
+        if tracer is not None:
+            try:
+                tracer.export(os.path.join(
+                    cfg.trace_dir,
+                    f"trace-node{cfg.node_id}-{os.getpid()}.json"))
+            except OSError:
+                logger.exception("trace export failed")
+            _trace.disable()
+        if registry is not None:
+            if run_dir is not None and is_main:
+                try:
+                    registry.save(os.path.join(run_dir, "telemetry.json"))
+                    with open(os.path.join(run_dir, "telemetry.prom"),
+                              "w") as f:
+                        f.write(registry.render_prometheus())
+                except OSError:
+                    logger.exception("telemetry export failed")
+            if prom_server is not None:
+                prom_server.shutdown()
+                prom_server.server_close()  # release the port now
+            _telemetry.disable()
     if is_main:
         line = json.dumps({"algo": cfg.algo, "dataset": cfg.dataset,
                            "model": cfg.model,

@@ -1,0 +1,25 @@
+"""Observability subsystem: distributed round tracing, a telemetry
+registry, and the run-report merger.
+
+Three pillars, all stdlib-only (the `MetricsSink` dependency posture):
+
+    fedml_tpu.obs.trace      span tracer; context propagates through
+                             Message headers; Perfetto trace_event export
+    fedml_tpu.obs.telemetry  thread-safe counter/gauge/histogram registry;
+                             Prometheus text exposition + JSON snapshots
+    fedml_tpu.obs.report     merges metrics.jsonl + telemetry snapshot +
+                             trace into a per-round timeline report
+                             (CLI: scripts/obs_report.py)
+
+Both trace and telemetry are process-global opt-ins (``enable()``);
+disabled they are a null tracer / null registry and instrumented hot
+paths pay a single branch per event.  Enable BEFORE constructing
+transports/actors — instrumented constructors cache their metric handles.
+"""
+
+from fedml_tpu.obs.telemetry import (NullRegistry, TelemetryRegistry,
+                                     start_http_server)
+from fedml_tpu.obs.trace import Span, SpanContext, SpanTracer
+
+__all__ = ["NullRegistry", "TelemetryRegistry", "start_http_server",
+           "Span", "SpanContext", "SpanTracer"]

@@ -1,0 +1,263 @@
+"""Run-report merger: metrics.jsonl + telemetry snapshot + round traces
+→ one human-readable per-round timeline (CLI: ``scripts/obs_report.py``).
+
+The three observability streams land in different files with different
+shapes (wandb-style events, Prometheus-style series, Perfetto-style
+spans).  Debugging a slow or faulty federation needs them TOGETHER:
+"round 3 took 9s" (trace) next to "silo 2 retried 14 sends" (telemetry)
+next to "test_acc dropped" (metrics).  This module reads whatever subset
+exists and renders it; every section degrades to absence, so the report
+works on a crashed run (atomic summary.json + whatever trace files were
+exported) as well as a finished one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+from typing import Dict, List, Optional
+
+# -- loaders (each tolerates absence) ----------------------------------------
+
+
+def load_jsonl(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn final line of a crashed run
+    return out
+
+
+def load_json(path: str) -> Optional[dict]:
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_trace_events(trace_dir: Optional[str],
+                      include_meta: bool = False) -> List[dict]:
+    """Merge every process's exported span file in ``trace_dir`` (the
+    multi-process stitch: each gRPC silo exports its own).  Span ("X")
+    events only by default; ``include_meta`` keeps the ``process_name``
+    metadata Perfetto uses to label node tracks."""
+    if not trace_dir:
+        return []
+    events: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "*.json"))):
+        try:
+            data = load_json(path)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(data, dict):
+            data = data.get("traceEvents", [])
+        if isinstance(data, list):
+            events.extend(e for e in data if isinstance(e, dict))
+    keep = ("X", "M") if include_meta else ("X",)
+    # dedupe across files — the same invariant trace.py enforces
+    # in-process: one event per span id.  This also makes the loader
+    # idempotent when a --merge_trace output was written INTO trace_dir
+    # (it would otherwise re-glob and double every span), and collapses
+    # duplicate process_name metadata from multiple exporters.
+    seen, uniq = set(), []
+    for e in events:
+        if e.get("ph") not in keep:
+            continue
+        if e["ph"] == "M":
+            key = ("M", e.get("pid"), e.get("name"),
+                   json.dumps(e.get("args"), sort_keys=True))
+        else:
+            span_id = (e.get("args") or {}).get("span_id")
+            key = ("X", span_id) if span_id is not None else ("X", id(e))
+        if key in seen:
+            continue
+        seen.add(key)
+        uniq.append(e)
+    return uniq
+
+
+def merge_traces(trace_dir: str, out_path: str) -> int:
+    """Write one combined Perfetto file from all per-process exports;
+    returns the span count (load it at ui.perfetto.dev)."""
+    events = load_trace_events(trace_dir, include_meta=True)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return sum(1 for e in events if e["ph"] == "X")
+
+
+# -- round timeline ----------------------------------------------------------
+
+
+def group_round_traces(events: List[dict]) -> List[dict]:
+    """Group span events by trace id; one entry per federated round (or
+    async version), ordered by start time."""
+    by_trace: Dict[str, List[dict]] = {}
+    for e in events:
+        tid = (e.get("args") or {}).get("trace_id")
+        if tid is not None:
+            by_trace.setdefault(tid, []).append(e)
+    rounds = []
+    for tid, evs in by_trace.items():
+        evs.sort(key=lambda e: e.get("ts", 0))
+        t0 = min(e["ts"] for e in evs)
+        t1 = max(e["ts"] + e.get("dur", 0) for e in evs)
+        root = next((e for e in evs
+                     if not (e.get("args") or {}).get("parent_id")), evs[0])
+        rounds.append({"trace_id": tid, "t0": t0, "total_s": (t1 - t0) / 1e6,
+                       "root": root, "events": evs})
+    rounds.sort(key=lambda r: r["t0"])
+    return rounds
+
+
+def _timeline_lines(trace: dict) -> List[str]:
+    """Indented span tree for one round: depth from the parent chain,
+    siblings ordered by start time."""
+    evs = trace["events"]
+    by_id = {(e.get("args") or {}).get("span_id"): e for e in evs}
+    children: Dict[Optional[str], List[dict]] = {}
+    for e in evs:
+        args = e.get("args") or {}
+        parent = args.get("parent_id")
+        if parent not in by_id:
+            parent = None  # orphan (e.g. exporter missing one process)
+        children.setdefault(parent, []).append(e)
+    lines: List[str] = []
+
+    def walk(parent_id: Optional[str], depth: int) -> None:
+        for e in sorted(children.get(parent_id, []),
+                        key=lambda x: x.get("ts", 0)):
+            args = e.get("args") or {}
+            rel_ms = (e["ts"] - trace["t0"]) / 1e3
+            lines.append(f"  {'  ' * depth}{e['name']:<12s} "
+                        f"node={args.get('node', '?'):<4} "
+                        f"+{rel_ms:8.1f}ms  {e.get('dur', 0) / 1e6:8.4f}s")
+            walk(args.get("span_id"), depth + 1)
+
+    walk(None, 0)
+    return lines
+
+
+# -- renderer ----------------------------------------------------------------
+
+_ROUND_KEYS = ("round", "version", "step")
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render_report(run_dir: Optional[str] = None,
+                  trace_dir: Optional[str] = None) -> str:
+    out: List[str] = ["=" * 64, "fedml_tpu run report", "=" * 64]
+    summary = load_json(os.path.join(run_dir, "summary.json")) \
+        if run_dir else None
+    events = load_jsonl(os.path.join(run_dir, "metrics.jsonl")) \
+        if run_dir else []
+    telemetry = load_json(os.path.join(run_dir, "telemetry.json")) \
+        if run_dir else None
+
+    if summary:
+        cfg = summary.get("config") or {}
+        head = " ".join(f"{k}={cfg[k]}" for k in
+                        ("algo", "model", "dataset", "client_num_per_round",
+                         "comm_round") if k in cfg)
+        if head:
+            out += ["", f"run: {head}"]
+        final = summary.get("final")
+        if isinstance(final, dict) and final:
+            out += ["final: " + "  ".join(f"{k}={_fmt(v)}"
+                                          for k, v in sorted(final.items())
+                                          if isinstance(v, (int, float)))]
+
+    round_rows = [e for e in events
+                  if any(k in e for k in _ROUND_KEYS)
+                  and any(isinstance(v, (int, float))
+                          for k, v in e.items() if not k.startswith("_"))]
+    if round_rows:
+        out += ["", "-- rounds (metrics.jsonl) " + "-" * 37]
+        cols = sorted({k for e in round_rows for k, v in e.items()
+                       if isinstance(v, (int, float))
+                       and not k.startswith("_")},
+                      key=lambda k: (k not in _ROUND_KEYS, k))
+        out.append("  " + "  ".join(f"{c:>12s}" for c in cols))
+        for e in round_rows:
+            out.append("  " + "  ".join(
+                f"{_fmt(e[c]) if c in e else '-':>12s}" for c in cols))
+
+    traces = group_round_traces(load_trace_events(trace_dir))
+    if traces:
+        out += ["", "-- round timelines (trace) " + "-" * 36]
+        for tr in traces:
+            label = tr["root"]["name"]
+            args = tr["root"].get("args") or {}
+            for key in _ROUND_KEYS:
+                if key in args:
+                    label = f"{label} {key}={args[key]}"
+                    break
+            out.append(f"{label}  [trace {tr['trace_id']}]  "
+                       f"total {tr['total_s']:.4f}s")
+            out += _timeline_lines(tr)
+
+    if telemetry:
+        out += ["", "-- telemetry " + "-" * 50]
+        for kind in ("counters", "gauges"):
+            for series, value in sorted((telemetry.get(kind) or {}).items()):
+                out.append(f"  {series:<56s} {_fmt(value)}")
+        for series, h in sorted((telemetry.get("histograms") or {}).items()):
+            if not h.get("count"):
+                continue
+            out.append(f"  {series:<56s} count={h['count']} "
+                       f"mean={_fmt(h['mean'])} min={_fmt(h['min'])} "
+                       f"max={_fmt(h['max'])}")
+        counters = telemetry.get("counters") or {}
+        hists = telemetry.get("histograms") or {}
+        examples = counters.get("fedml_trainer_examples_total")
+        train_s = sum(h["sum"] for name, h in hists.items()
+                      if name.startswith(("fedml_trainer_train_seconds",
+                                          "fedml_trainer_compile_seconds")))
+        if examples and train_s:
+            out += ["", f"  derived: examples/sec ≈ "
+                        f"{examples / train_s:,.1f} "
+                        f"({_fmt(examples)} examples / "
+                        f"{train_s:.3f}s in-trainer)"]
+
+    if len(out) == 3:
+        out.append("(no artifacts found — pass --run_dir and/or "
+                   "--trace_dir of an instrumented run)")
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="obs_report",
+        description="Merge metrics.jsonl + telemetry + round traces into "
+                    "a per-round timeline report")
+    p.add_argument("--run_dir", "--metrics_dir", dest="run_dir", default=None,
+                   help="directory holding metrics.jsonl / summary.json / "
+                        "telemetry.json")
+    p.add_argument("--trace_dir", default=None,
+                   help="directory holding per-process *.json span exports")
+    p.add_argument("--merge_trace", default=None, metavar="OUT",
+                   help="also write one combined Perfetto JSON here")
+    args = p.parse_args(argv)
+    if args.merge_trace and args.trace_dir:
+        n = merge_traces(args.trace_dir, args.merge_trace)
+        print(f"merged {n} span events -> {args.merge_trace}")
+    print(render_report(args.run_dir, args.trace_dir), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,15 +17,65 @@ Parity details preserved:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
 
+from fedml_tpu.obs import telemetry
 from fedml_tpu.trainer.workload import Workload
 
 Pytree = Any
+
+
+def instrument_train_fn(train_fn, epochs: int = 1, registry=None):
+    """Wrap a (typically jit'd) ``train(params, data, rng)`` callable with
+    trainer telemetry:
+
+    * ``fedml_trainer_compile_seconds`` — the FIRST call's wall time (jit
+      trace + XLA compile + run; the "why is round 0 slow" histogram);
+    * ``fedml_trainer_train_seconds`` — every later call's wall time
+      (blocked until ready, so async dispatch doesn't hide the work);
+    * ``fedml_trainer_examples_total`` — valid (mask=1) examples consumed,
+      so examples/sec falls out of the snapshot as
+      ``examples_total / train_seconds_sum``.  Pass the trainer's
+      ``epochs``: the scan revisits every batch each epoch, so one call
+      consumes ``epochs * mask.sum()`` examples.
+
+    With telemetry disabled this returns ``train_fn`` unchanged — zero
+    wrapper, zero cost."""
+    reg = registry if registry is not None else telemetry.get_registry()
+    if not reg.enabled:
+        return train_fn
+    import threading
+
+    h_compile = reg.histogram("fedml_trainer_compile_seconds")
+    h_train = reg.histogram("fedml_trainer_train_seconds")
+    c_examples = reg.counter("fedml_trainer_examples_total")
+    # claimed under a lock: concurrent silo threads (the chaos CLI's
+    # threaded drive) may both make their first call during the one jit
+    # compile — exactly one sample may land in the compile histogram
+    state = {"first": True}
+    state_lock = threading.Lock()
+    epochs = max(int(epochs), 1)
+
+    def instrumented(params, data, rng):
+        t0 = time.perf_counter()
+        out = train_fn(params, data, rng)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        with state_lock:
+            first, state["first"] = state["first"], False
+        (h_compile if first else h_train).observe(dt)
+        mask = data.get("mask") if isinstance(data, dict) else None
+        if mask is not None:
+            import numpy as np
+            c_examples.inc(epochs * float(np.asarray(mask).sum()))
+        return out
+
+    return instrumented
 
 
 def make_local_trainer(workload: Workload,

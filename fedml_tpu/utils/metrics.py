@@ -26,14 +26,21 @@ import os
 import time
 from typing import Any, Dict, Optional
 
+# module-level with a guarded fallback: _jsonable runs on EVERY logged
+# event, and a per-call ``import numpy`` pays the sys.modules lookup on
+# each scalar coerced
+try:
+    import numpy as _np
+except ImportError:  # pragma: no cover — numpy is a hard dep in practice
+    _np = None
+
 logger = logging.getLogger(__name__)
 
 
 def _jsonable(v: Any) -> Any:
     """Best-effort scalar coercion (jax/numpy scalars -> python floats)."""
     try:
-        import numpy as np
-        if isinstance(v, np.generic):
+        if _np is not None and isinstance(v, _np.generic):
             return v.item()
         if hasattr(v, "item") and getattr(v, "ndim", None) == 0:
             return v.item()
@@ -55,17 +62,26 @@ class MetricsSink:
 
     ``run_dir=None`` keeps everything in memory (hermetic tests); the event
     stream is then available as ``sink.events``.
+
+    ``summary.json`` is written ATOMICALLY (tmp + ``os.replace``) and
+    flushed every ``flush_summary_every`` ``log()`` calls, not only on
+    ``close()`` — a run that crashes mid-federation (the crash-recovery
+    path resumes it) leaves a readable recent summary beside the jsonl
+    stream instead of nothing, and a crash mid-write can never leave a
+    torn file.
     """
 
     def __init__(self, run_dir: Optional[str] = None, stdout: bool = False,
-                 name: str = "run"):
+                 name: str = "run", flush_summary_every: int = 25):
         self.run_dir = run_dir
         self.stdout = stdout
         self.name = name
+        self.flush_summary_every = max(int(flush_summary_every), 1)
         self.summary: Dict[str, Any] = {}
         self.events = []
         self._t0 = time.time()
         self._fh = None
+        self._since_flush = 0
         if run_dir is not None:
             os.makedirs(run_dir, exist_ok=True)
             self._fh = open(os.path.join(run_dir, "metrics.jsonl"), "a",
@@ -81,13 +97,24 @@ class MetricsSink:
         self.events.append(event)
         if self._fh is not None:
             self._fh.write(json.dumps(event) + "\n")
+            self._since_flush += 1
+            if self._since_flush >= self.flush_summary_every:
+                self._write_summary()
         if self.stdout:
             logger.info("[%s] %s", self.name, event)
 
+    def _write_summary(self) -> None:
+        if self.run_dir is None:
+            return
+        path = os.path.join(self.run_dir, "summary.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.summary, f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        self._since_flush = 0
+
     def close(self) -> None:
-        if self.run_dir is not None:
-            with open(os.path.join(self.run_dir, "summary.json"), "w") as f:
-                json.dump(self.summary, f, indent=2, sort_keys=True)
+        self._write_summary()
         if self._fh is not None:
             self._fh.close()
             self._fh = None

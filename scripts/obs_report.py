@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Render a per-round observability report for a fedml_tpu run.
+
+Merges ``--run_dir`` artifacts (metrics.jsonl, summary.json,
+telemetry.json) with ``--trace_dir`` span exports into one timeline:
+
+    python scripts/obs_report.py --run_dir /tmp/run --trace_dir /tmp/trace
+
+Optionally ``--merge_trace out.json`` writes a single combined Perfetto
+file for ui.perfetto.dev.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedml_tpu.obs.report import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

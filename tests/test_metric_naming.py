@@ -1,0 +1,88 @@
+"""Metric-naming lint: every telemetry name registered anywhere in the
+source tree obeys the contract ``fedml_[a-z0-9_]+`` with a
+``_total``/``_seconds``/``_bytes`` unit suffix — so dashboards and
+alert rules never chase a renamed series.
+
+This lints the SOURCE (every ``reg.counter("...")``-style literal under
+fedml_tpu/), not a live registry, so a metric behind an untested branch
+still gets caught.  The registry enforces the same regex at runtime
+(tests/test_obs.py::test_registry_rejects_bad_names)."""
+
+import pathlib
+import re
+
+import pytest
+
+from fedml_tpu.obs.telemetry import NAME_RE
+
+_PKG = pathlib.Path(__file__).resolve().parent.parent / "fedml_tpu"
+
+# .counter("name" / .gauge("name" / .histogram("name"  — first positional
+# string literal of a registration call — plus the shared per-link
+# helper, whose name is the third argument:
+# link_counter(reg, cache, "name", src, dst)
+_REG_CALL = re.compile(
+    r"\.(?:counter|gauge|histogram)\(\s*[\"']([^\"']+)[\"']")
+_LINK_CALL = re.compile(
+    r"link_counter\(\s*[^,]+,[^,]+,\s*[\"']([^\"']+)[\"']", re.DOTALL)
+
+# the canonical instrumentation this PR wires in; removing one of these
+# names (or renaming it) is a dashboard-breaking change — update the
+# README metric table in the same commit as this list
+EXPECTED = {
+    "fedml_comm_send_total", "fedml_comm_send_bytes_total",
+    "fedml_comm_recv_total", "fedml_comm_wire_bytes_total",
+    "fedml_comm_send_ok_total", "fedml_comm_send_retries_total",
+    "fedml_comm_dead_letter_total",
+    "fedml_chaos_faults_total",
+    "fedml_failure_detector_alive_total",
+    "fedml_failure_detector_suspect_total",
+    "fedml_failure_detector_dead_total",
+    "fedml_round_duration_seconds", "fedml_round_straggler_wait_seconds",
+    "fedml_round_quorum_size_total",
+    "fedml_async_version_duration_seconds", "fedml_async_staleness_total",
+    "fedml_trainer_compile_seconds", "fedml_trainer_train_seconds",
+    "fedml_trainer_examples_total",
+}
+
+
+def _registered_names():
+    names = {}
+    for path in sorted(_PKG.rglob("*.py")):
+        src = path.read_text()
+        for rx in (_REG_CALL, _LINK_CALL):
+            for m in rx.finditer(src):
+                if m.group(1) == "name":  # link_counter's own body
+                    continue
+                names.setdefault(m.group(1), []).append(str(path))
+    return names
+
+
+def test_all_registered_metric_names_obey_contract():
+    names = _registered_names()
+    assert names, "source scan found no telemetry registrations"
+    bad = {n: ws for n, ws in names.items() if not NAME_RE.match(n)}
+    assert not bad, (
+        f"telemetry names violating fedml_[a-z0-9_]+ + "
+        f"_total/_seconds/_bytes suffix: {bad}")
+
+
+def test_canonical_instrumentation_still_registered():
+    names = set(_registered_names())
+    missing = EXPECTED - names
+    assert not missing, (
+        f"instrumentation removed/renamed (update dashboards + README "
+        f"metric table deliberately, then this list): {sorted(missing)}")
+
+
+@pytest.mark.parametrize("name,ok", [
+    ("fedml_comm_send_total", True),
+    ("fedml_round_duration_seconds", True),
+    ("fedml_comm_send_bytes", True),
+    ("comm_send_total", False),       # missing prefix
+    ("fedml_comm_send", False),       # missing unit suffix
+    ("fedml_Comm_send_total", False),  # uppercase
+    ("fedml_comm-send_total", False),  # dash
+])
+def test_name_regex_cases(name, ok):
+    assert bool(NAME_RE.match(name)) == ok
