@@ -151,7 +151,9 @@ class FedAvgServerActor(ServerManager):
                  min_silo_frac: float = 0.5,
                  decode_upload: Optional[Callable] = None,
                  failure_detector: Optional[FailureDetector] = None,
-                 checkpointer=None):
+                 checkpointer=None,
+                 publish: Optional[Callable] = None,
+                 extra_state: Optional[tuple] = None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -180,6 +182,20 @@ class FedAvgServerActor(ServerManager):
         resumes from the latest checkpoint if one exists — a crashed and
         restarted server continues the federation instead of restarting
         it from round 0.
+
+        ``publish``: serve-while-train hook — ``publish(host_params,
+        round_idx)`` fires after every aggregation (and once on resume),
+        so a `serve.registry.ModelRegistry` can hot-swap the federation's
+        own global model live while rounds keep running.
+
+        ``extra_state``: a ``(get_fn, set_fn)`` pair folding extra
+        cross-round state into every round checkpoint: ``get_fn()``
+        returns a FIXED-SHAPE host pytree saved beside params, and
+        ``set_fn(tree)`` restores it on resume.  The cross-silo runner
+        uses it to persist silo-side `ErrorFeedback` residuals, which
+        are cross-round state the (params, round, rng) tuple silently
+        dropped — a resumed --error_feedback run used to diverge from an
+        uninterrupted one (tests/test_recovery.py pins bit-identity).
         """
         super().__init__(0, transport)
         if straggler_policy not in ("wait", "drop", "abort"):
@@ -200,6 +216,8 @@ class FedAvgServerActor(ServerManager):
         self.decode_upload = decode_upload
         self.failure_detector = failure_detector
         self.checkpointer = checkpointer
+        self.publish = publish
+        self.extra_state = extra_state
         self.dropped_silos: Dict[int, list] = {}  # round -> missing silo ids
         self._received: Dict[int, tuple] = {}
         self._num_silos = 0  # silos contacted this round (= sampled cohort)
@@ -240,14 +258,31 @@ class FedAvgServerActor(ServerManager):
         if self.checkpointer is not None:
             step = self.checkpointer.latest_round()
             if step is not None:
-                state = self.checkpointer.restore(
-                    step, like=self._checkpoint_state(step))
+                try:
+                    state = self.checkpointer.restore(
+                        step, like=self._checkpoint_state(step))
+                except ValueError:
+                    # schema drift: the on-disk checkpoint and the current
+                    # config disagree about the "extra" leaf (a pre-EF
+                    # checkpoint resumed with --error_feedback on, or the
+                    # reverse).  Restore untemplated and take what's
+                    # there — resuming beats crashing, and the extra
+                    # guard below only applies state that exists.
+                    log.warning("checkpoint %d does not match the current "
+                                "state schema; restoring untemplated",
+                                step)
+                    state = self.checkpointer.restore(step)
                 self.params = state["params"]
                 self.round_idx = int(np.asarray(state["round_idx"])) + 1
                 mask = np.asarray(state["accepted_mask"])
                 self._last_accepted = (
                     (np.flatnonzero(mask) + 1).astype(np.int32)
                     if mask.any() else None)
+                if self.extra_state is not None and "extra" in state:
+                    self.extra_state[1](state["extra"])
+                if self.publish is not None:
+                    self.publish(jax.tree.map(np.asarray, self.params),
+                                 self.round_idx - 1)
                 log.info("resumed from checkpoint: continuing at round %d "
                          "of %d", self.round_idx, self.num_rounds)
         if self.round_idx >= self.num_rounds:
@@ -266,19 +301,28 @@ class FedAvgServerActor(ServerManager):
         return sample_clients(self.round_idx, self.client_num_in_total,
                               self.client_num_per_round)
 
-    def _checkpoint_state(self, round_idx: int) -> Dict[str, object]:
+    def _checkpoint_state(self, round_idx: int,
+                          host_params=None) -> Dict[str, object]:
         """Round-state pytree saved after round ``round_idx`` completes.
         Every leaf has a restart-independent shape (the accepted-silo set
         rides as a fixed-length mask, not a variable-length id list) so
-        the same structure doubles as the orbax restore template."""
+        the same structure doubles as the orbax restore template.
+        ``host_params``: an already-materialized host copy of the globals
+        (``_complete_round`` shares one copy between checkpoint and
+        publish instead of device→host transferring twice)."""
         cohort = len(sample_clients(0, self.client_num_in_total,
                                     self.client_num_per_round))
         mask = np.zeros(cohort, np.int8)
         if self._last_accepted is not None:
             mask[np.asarray(self._last_accepted) - 1] = 1
-        return {"params": jax.tree.map(np.asarray, self.params),
-                "round_idx": np.asarray(round_idx, np.int64),
-                "accepted_mask": mask}
+        if host_params is None:
+            host_params = jax.tree.map(np.asarray, self.params)
+        out = {"params": host_params,
+               "round_idx": np.asarray(round_idx, np.int64),
+               "accepted_mask": mask}
+        if self.extra_state is not None:
+            out["extra"] = self.extra_state[0]()
+        return out
 
     def _broadcast(self, msg_type) -> None:
         ids = self._sampled()
@@ -470,10 +514,27 @@ class FedAvgServerActor(ServerManager):
         if self._round_span is not None:
             self._round_span.end()
             self._round_span = None
+        host_params = None  # one host copy shared by checkpoint + publish
+
+        def _host():
+            nonlocal host_params
+            if host_params is None:
+                host_params = jax.tree.map(np.asarray, self.params)
+            return host_params
+
         if self.checkpointer is not None:
+            # thunk: rounds the save_every gate skips pay no device→host
+            # copy and no EF serialization
             self.checkpointer.maybe_save(
-                self.round_idx, self._checkpoint_state(self.round_idx),
+                self.round_idx,
+                lambda: self._checkpoint_state(self.round_idx,
+                                               host_params=_host()),
                 last_round=self.round_idx + 1 >= self.num_rounds)
+        if self.publish is not None:
+            # serve-while-train: hand the registry a HOST copy so the
+            # serving path never holds references into device buffers the
+            # next round's aggregation will donate/overwrite
+            self.publish(_host(), self.round_idx)
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.params)
         self.round_idx += 1

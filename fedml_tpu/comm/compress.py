@@ -159,6 +159,46 @@ class ErrorFeedback:
         else:
             self._residual[silo] = delta
 
+    # -- checkpoint surface --------------------------------------------------
+    # EF residuals are silo-side CROSS-ROUND state: a checkpoint that
+    # saves only (params, round, rng) silently drops them and a resumed
+    # --error_feedback run diverges from an uninterrupted one (the lost
+    # residual re-loses every coordinate topk dropped).  Both the settled
+    # residual AND the parked (delta, sent) pending entry must survive —
+    # the pending entry settles on the FIRST post-resume sync's ack.
+
+    def state_dict(self, silos, like: Pytree) -> Dict[str, Any]:
+        """Fixed-shape host pytree of the full EF state for ``silos``.
+        ``like``: a delta-tree template (the params skeleton); absent
+        entries serialize as zeros + a 0 flag, so the same structure
+        doubles as the orbax restore template regardless of which silos
+        happened to hold state at save time."""
+        import jax
+        zeros = jax.tree.map(lambda v: np.zeros_like(np.asarray(v)), like)
+        host = lambda t: jax.tree.map(np.asarray, t)  # noqa: E731
+        out = {}
+        for silo in silos:
+            r = self._residual.get(silo)
+            pend = self._pending.get(silo)
+            out[f"s{int(silo)}"] = {
+                "residual": host(r) if r is not None else zeros,
+                "has_residual": np.asarray(r is not None, np.int8),
+                "pending_delta": host(pend[0]) if pend else zeros,
+                "pending_sent": host(pend[1]) if pend else zeros,
+                "has_pending": np.asarray(pend is not None, np.int8)}
+        return out
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        """Inverse of ``state_dict`` (silo keys restore as ints — the
+        runner keys apply/record/resolve by int silo id)."""
+        for key, d in state.items():
+            silo = int(key[1:])
+            if int(np.asarray(d["has_residual"])):
+                self._residual[silo] = d["residual"]
+            if int(np.asarray(d["has_pending"])):
+                self._pending[silo] = (d["pending_delta"],
+                                       d["pending_sent"])
+
 
 def _treedef_token(treedef, tree) -> str:
     """A cheap structural fingerprint carried on the wire so a mismatched

@@ -209,6 +209,27 @@ class ExperimentConfig:
     checkpoint_async: bool = False  # background orbax saves (training
     #                                 never blocks on I/O; durable at the
     #                                 next save/flush/close/read)
+    checkpoint_keep_last_n: int = 0  # >0: retention GC — only the newest
+    #                                  N round dirs survive (serve-while-
+    #                                  train runs must not fill the disk
+    #                                  the serving registry watches);
+    #                                  0 = the checkpointer default (3)
+
+    # ---- serving (fedml_tpu/serve: registry + batcher + HTTP frontend) -
+    serve_port: int = 0             # >0 (cross_silo): serve the global
+    #                                 model over HTTP while training —
+    #                                 /predict /healthz /version /metrics
+    serve_buckets: str = "1,2,4,8,16,32"  # micro-batch shape buckets
+    #                                 (comma ints, strictly increasing;
+    #                                 one jit compile per bucket)
+    serve_deadline_ms: float = 50.0  # default per-request deadline; a
+    #                                 request that waits this out in the
+    #                                 queue is shed (429), not served late
+    serve_queue_depth: int = 256    # admission control: submits beyond
+    #                                 this many queued requests get 429
+    serve_batch_delay_ms: float = 2.0  # micro-batch flush deadline: how
+    #                                 long the oldest queued request may
+    #                                 wait for batchmates
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -100,7 +100,8 @@ def _make_checkpointer(cfg: ExperimentConfig):
     from fedml_tpu.utils.checkpoint import RoundCheckpointer
     return RoundCheckpointer(cfg.checkpoint_dir,
                              save_every=cfg.checkpoint_every,
-                             async_save=cfg.checkpoint_async)
+                             async_save=cfg.checkpoint_async,
+                             keep_last_n=cfg.checkpoint_keep_last_n)
 
 
 def _eval_global(workload, params, data) -> Dict[str, float]:
@@ -626,7 +627,7 @@ def run_cross_silo(cfg, data, mesh, sink):
     # optional lossy upload compression (comm/compress.py): silos send the
     # compressed DELTA to the global model; the server reconstructs.  The
     # down-link broadcast stays exact.
-    encode = decode = None
+    encode = decode = ef_extra = None
     wire_stats = {"bytes": 0}
     if cfg.wire_compression != "none":
         # host-side numpy throughout — compression is a wire-boundary op
@@ -646,6 +647,32 @@ def run_cross_silo(cfg, data, mesh, sink):
         # stateless-client contract (flag-gated).
         from fedml_tpu.comm.compress import ErrorFeedback
         _ef = ErrorFeedback()
+        if cfg.error_feedback and cfg.silo_backend == "local":
+            # EF residuals are silo-side cross-round state; fold them into
+            # the server's round checkpoint (fixed-shape template, so it
+            # doubles as the orbax restore skeleton).  LOCAL backend only:
+            # one process holds every silo's EF there.  A gRPC server
+            # never sees silo residuals — checkpointing its own (empty)
+            # EF would bloat every checkpoint with model-sized zero trees
+            # while restoring nothing; distributed silos keep their own
+            # state and are expected to stay alive across server crashes.
+            _ef_template = jax.tree.map(
+                lambda v: np.zeros_like(np.asarray(v)), init)
+            _ef_silos = tuple(range(1, n_silos + 1))
+            ef_extra = (lambda: _ef.state_dict(_ef_silos, _ef_template),
+                        _ef.load_state_dict)
+
+        # bandwidth observability (the obs report's "bytes saved per
+        # round"): compressed-vs-raw bytes of every accepted upload, plus
+        # the per-upload compression ratio (handles cached here — null
+        # no-ops when telemetry is disabled)
+        from fedml_tpu.obs import telemetry as _tel
+        _reg = _tel.get_registry()
+        _c_comp = _reg.counter("fedml_comm_compressed_bytes_total")
+        _c_raw = _reg.counter("fedml_comm_raw_bytes_total")
+        _h_ratio = _reg.histogram(
+            "fedml_comm_compression_ratio_total",
+            buckets=(0.01, 0.025, 0.05, 0.1, 0.2, 0.35, 0.5, 0.75, 1.0))
 
         def encode(new_params, global_params, _silo=None):
             from fedml_tpu.algorithms.async_fl import delta_encoder
@@ -669,8 +696,14 @@ def run_cross_silo(cfg, data, mesh, sink):
                                                      global_params)
                 _decode_cache["ref"] = global_params
             host_global = _decode_cache["host"]
-            wire_stats["bytes"] += wire_bytes(payload)
+            compressed = wire_bytes(payload)
+            wire_stats["bytes"] += compressed
             delta = decompress_update(payload, host_global)
+            raw = wire_bytes(delta)
+            _c_comp.inc(compressed)
+            _c_raw.inc(raw)
+            if raw:
+                _h_ratio.observe(compressed / raw)
             return jax.tree.map(np.add, host_global, delta)
 
     def make_encode(silo_id):
@@ -703,6 +736,40 @@ def run_cross_silo(cfg, data, mesh, sink):
             suspect_after_s=cfg.suspect_after_s or cfg.dead_after_s / 2,
             dead_after_s=cfg.dead_after_s)
 
+    # serve-while-train (fedml_tpu/serve): the server node publishes each
+    # round's global into a hot-swap registry behind an HTTP frontend, so
+    # the federation serves its own model live.  A gRPC SILO process never
+    # serves — only rank 0 holds the global.
+    frontend = publish = None
+    if cfg.serve_port > 0 and (cfg.silo_backend == "local"
+                               or cfg.node_id == 0):
+        from fedml_tpu.serve import (MicroBatcher, ModelRegistry,
+                                     ServeFrontend)
+        predict = jax.jit(lambda p, x: wl.apply(p, x))
+        registry = ModelRegistry(predict)
+        buckets = tuple(int(b) for b in cfg.serve_buckets.split(","))
+        batcher = MicroBatcher(
+            registry, buckets=buckets,
+            max_delay_s=cfg.serve_batch_delay_ms / 1e3,
+            queue_depth=cfg.serve_queue_depth,
+            default_deadline_s=cfg.serve_deadline_ms / 1e3)
+        frontend = ServeFrontend(registry, batcher,
+                                 port=cfg.serve_port).start()
+        _sample_x = np.asarray(data.train["x"][0, 0, 0])
+        _warmed = []
+
+        def publish(params, version):
+            registry.publish(params, version)
+            if not _warmed:
+                _warmed.append(True)
+                # compile every bucket off the round path: without this
+                # the FIRST request per bucket size pays the jit compile
+                # inside its own deadline and is shed 429 from an
+                # otherwise idle server
+                import threading as _th
+                _th.Thread(target=lambda: batcher.warmup(_sample_x),
+                           daemon=True, name="serve-warmup").start()
+
     def make_server(transport):
         s = FedAvgServerActor(
             transport, init, data.client_num, n_silos, cfg.comm_round,
@@ -710,7 +777,8 @@ def run_cross_silo(cfg, data, mesh, sink):
             straggler_policy=cfg.straggler_policy,
             round_timeout_s=timeout, min_silo_frac=cfg.min_silo_frac,
             decode_upload=decode, failure_detector=detector,
-            checkpointer=_make_checkpointer(cfg))
+            checkpointer=_make_checkpointer(cfg),
+            publish=publish, extra_state=ef_extra)
         s.register_handlers()
         return s
 
@@ -719,92 +787,101 @@ def run_cross_silo(cfg, data, mesh, sink):
     if chaos_on and cfg.silo_backend != "local":
         raise ValueError("--chaos_* injection wraps the local hub only; "
                          "for real wires compose ChaosTransport in code")
-    if cfg.silo_backend == "local":
-        import threading
-        from fedml_tpu.comm.local import LocalHub
-        hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
-        wrap = lambda t: t  # noqa: E731
-        if chaos_on:
-            from fedml_tpu.algorithms.cross_silo import MsgType
-            from fedml_tpu.comm.chaos import (ChaosPlan, ChaosTransport,
-                                              LinkChaos)
-            if cfg.chaos_drop > 0 and (cfg.straggler_policy == "wait"
-                                       or not timeout):
-                raise ValueError(
-                    "--chaos_drop with the strict 'wait' barrier (or no "
-                    "--round_timeout_s) would wedge the federation on the "
-                    "first lost upload; use --straggler_policy drop "
-                    "--round_timeout_s T")
-            plan = ChaosPlan(
-                seed=cfg.chaos_seed,
-                default=LinkChaos(drop_prob=cfg.chaos_drop,
-                                  delay_prob=cfg.chaos_delay,
-                                  max_delay_s=cfg.chaos_max_delay_s,
-                                  dup_prob=cfg.chaos_dup,
-                                  reorder_prob=cfg.chaos_reorder),
-                # FINISH: shutdown liveness.  ROUND_TIMEOUT: the straggler
-                # timer's SELF-message rides the server's own chaotic
-                # transport on link (0,0) — dropping it disarms the only
-                # re-arm path and wedges the round
-                immune_types=(MsgType.S2C_FINISH, MsgType.ROUND_TIMEOUT))
-            wrap = lambda t: ChaosTransport(t, plan)  # noqa: E731
-        server = make_server(wrap(hub.transport(0)))
-        silos = [FedAvgClientActor(
-                     i, wrap(hub.transport(i)), make_train_fn(i),
-                     encode_upload=make_encode(i),
-                     on_accepted=make_on_accepted(i),
-                     heartbeat_interval_s=(cfg.heartbeat_s or None)
-                     if chaos_on else None)
-                 for i in range(1, n_silos + 1)]
-        if not chaos_on:
-            for s in silos:
-                s.register_handlers()
+    try:
+        if cfg.silo_backend == "local":
+            import threading
+            from fedml_tpu.comm.local import LocalHub
+            hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
+            wrap = lambda t: t  # noqa: E731
+            if chaos_on:
+                from fedml_tpu.algorithms.cross_silo import MsgType
+                from fedml_tpu.comm.chaos import (ChaosPlan, ChaosTransport,
+                                                  LinkChaos)
+                if cfg.chaos_drop > 0 and (cfg.straggler_policy == "wait"
+                                           or not timeout):
+                    raise ValueError(
+                        "--chaos_drop with the strict 'wait' barrier (or no "
+                        "--round_timeout_s) would wedge the federation on "
+                        "the first lost upload; use --straggler_policy drop "
+                        "--round_timeout_s T")
+                plan = ChaosPlan(
+                    seed=cfg.chaos_seed,
+                    default=LinkChaos(drop_prob=cfg.chaos_drop,
+                                      delay_prob=cfg.chaos_delay,
+                                      max_delay_s=cfg.chaos_max_delay_s,
+                                      dup_prob=cfg.chaos_dup,
+                                      reorder_prob=cfg.chaos_reorder),
+                    # FINISH: shutdown liveness.  ROUND_TIMEOUT: the
+                    # straggler timer's SELF-message rides the server's own
+                    # chaotic transport on link (0,0) — dropping it disarms
+                    # the only re-arm path and wedges the round
+                    immune_types=(MsgType.S2C_FINISH, MsgType.ROUND_TIMEOUT))
+                wrap = lambda t: ChaosTransport(t, plan)  # noqa: E731
+            server = make_server(wrap(hub.transport(0)))
+            silos = [FedAvgClientActor(
+                         i, wrap(hub.transport(i)), make_train_fn(i),
+                         encode_upload=make_encode(i),
+                         on_accepted=make_on_accepted(i),
+                         heartbeat_interval_s=(cfg.heartbeat_s or None)
+                         if chaos_on else None)
+                     for i in range(1, n_silos + 1)]
+            if not chaos_on:
+                for s in silos:
+                    s.register_handlers()
+                server.start()
+                hub.pump()
+                return history[-1] if history else {}
+            # chaos delivers delayed/reordered frames on wall-clock timers,
+            # which the synchronous pump cannot wait for — drive each actor
+            # on its own thread like a real deployment
+            threads = [threading.Thread(target=s.run, daemon=True,
+                                        name=f"silo-{s.node_id}")
+                       for s in silos]
+            for th in threads:
+                th.start()
             server.start()
-            hub.pump()
+            server.transport.run()  # blocks until the final round's FINISH
+            for th in threads:
+                th.join(timeout=10)
             return history[-1] if history else {}
-        # chaos delivers delayed/reordered frames on wall-clock timers,
-        # which the synchronous pump cannot wait for — drive each actor
-        # on its own thread like a real deployment
-        threads = [threading.Thread(target=s.run, daemon=True,
-                                    name=f"silo-{s.node_id}")
-                   for s in silos]
-        for th in threads:
-            th.start()
-        server.start()
-        server.transport.run()  # blocks until the final round's FINISH
-        for th in threads:
-            th.join(timeout=10)
-        return history[-1] if history else {}
-    if cfg.silo_backend == "grpc":
-        from fedml_tpu.comm.grpc_transport import GrpcTransport, load_ip_table
-        table = (load_ip_table(cfg.ip_config) if cfg.ip_config
-                 else {i: "127.0.0.1" for i in range(n_silos + 1)})
-        transport = GrpcTransport(cfg.node_id, table,
-                                  base_port=cfg.base_port,
-                                  idle_timeout_s=cfg.silo_idle_timeout_s)
-        if cfg.silo_retries > 0:
-            # production posture: retried, backed-off, dead-lettered sends
-            # with channel re-dial between attempts (comm/resilient.py)
-            from fedml_tpu.comm.resilient import (ResilientTransport,
-                                                  RetryPolicy)
-            transport = ResilientTransport(
-                transport, RetryPolicy(max_attempts=cfg.silo_retries),
-                seed=cfg.seed)
-        if cfg.node_id == 0:
-            server = make_server(transport)
-            server.start()
-            transport.run()   # blocks until the final round's FINISH
-            return history[-1] if history else {}
-        silo = FedAvgClientActor(cfg.node_id, transport,
-                                 make_train_fn(cfg.node_id),
-                                 encode_upload=make_encode(cfg.node_id),
-                                 on_accepted=make_on_accepted(cfg.node_id),
-                                 heartbeat_interval_s=cfg.heartbeat_s or None)
-        # run() (not bare transport.run()) so the heartbeat thread starts
-        silo.run()
-        return {}
-    raise ValueError(f"unknown silo_backend {cfg.silo_backend!r}; "
-                     f"available: ('local', 'grpc')")
+        if cfg.silo_backend == "grpc":
+            from fedml_tpu.comm.grpc_transport import (GrpcTransport,
+                                                       load_ip_table)
+            table = (load_ip_table(cfg.ip_config) if cfg.ip_config
+                     else {i: "127.0.0.1" for i in range(n_silos + 1)})
+            transport = GrpcTransport(cfg.node_id, table,
+                                      base_port=cfg.base_port,
+                                      idle_timeout_s=cfg.silo_idle_timeout_s)
+            if cfg.silo_retries > 0:
+                # production posture: retried, backed-off, dead-lettered
+                # sends with channel re-dial between attempts
+                # (comm/resilient.py)
+                from fedml_tpu.comm.resilient import (ResilientTransport,
+                                                      RetryPolicy)
+                transport = ResilientTransport(
+                    transport, RetryPolicy(max_attempts=cfg.silo_retries),
+                    seed=cfg.seed)
+            if cfg.node_id == 0:
+                server = make_server(transport)
+                server.start()
+                transport.run()   # blocks until the final round's FINISH
+                return history[-1] if history else {}
+            silo = FedAvgClientActor(
+                cfg.node_id, transport, make_train_fn(cfg.node_id),
+                encode_upload=make_encode(cfg.node_id),
+                on_accepted=make_on_accepted(cfg.node_id),
+                heartbeat_interval_s=cfg.heartbeat_s or None)
+            # run() (not bare transport.run()) so the heartbeat thread
+            # starts
+            silo.run()
+            return {}
+        raise ValueError(f"unknown silo_backend {cfg.silo_backend!r}; "
+                         f"available: ('local', 'grpc')")
+    finally:
+        if frontend is not None:
+            # drain-on-shutdown: queued requests still answer, then the
+            # listener closes — training's end never drops live traffic
+            frontend.stop(drain=True)
 
 
 @runner("turboaggregate")
@@ -1120,6 +1197,13 @@ def main(argv=None) -> Dict[str, Any]:
     if cfg.error_feedback and cfg.wire_compression == "none":
         raise ValueError("--error_feedback requires --wire_compression "
                          "topk or int8")
+    if cfg.serve_port > 0 and cfg.algo != "cross_silo":
+        raise ValueError(
+            "--serve_port starts the serve-while-train frontend, which is "
+            f"wired into --algo cross_silo only; --algo {cfg.algo} would "
+            "silently train without serving.  To serve a finished "
+            "checkpoint directory, use scripts/serve_bench.py "
+            "--ckpt_dir instead.")
     # decentralized_online consumes a streaming dataset (UCI SUSY/RO or a
     # synthetic stream) that the registry doesn't serve — its runner builds
     # it; loading here would KeyError on --dataset SUSY

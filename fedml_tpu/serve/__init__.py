@@ -1,0 +1,34 @@
+"""TPU-native serving: the train → aggregate → checkpoint → **serve** leg.
+
+The reference FedML stack (and PRs 0-2 here) ends at the aggregated
+checkpoint — there is no path from a federation round to an inference
+request.  This package closes the loop, stdlib-only (plus jax), in three
+layers plus a bench harness:
+
+    fedml_tpu.serve.registry  versioned model registry: atomic hot-swap of
+                              the live (params, apply_fn, version) triple,
+                              pin/rollback, background checkpoint watcher
+                              (serve-while-train against RoundCheckpointer)
+    fedml_tpu.serve.batcher   dynamic micro-batching queue: size/deadline
+                              flush triggers, power-of-two shape buckets
+                              (one jit compile per bucket — the FedJAX
+                              static-shapes lesson, arXiv:2108.02117),
+                              deadline-based load shedding, drain-on-stop
+    fedml_tpu.serve.server    ThreadingHTTPServer frontend (/predict,
+                              /healthz, /version, /metrics) with admission
+                              control and per-request deadline propagation
+    scripts/serve_bench.py    open-loop load generator → BENCH_serve.json
+
+Everything is instrumented through the PR 2 telemetry registry under
+``fedml_serve_*`` (see the README metric table) and designed to survive
+chaos: a mid-load hot swap must never produce a torn read (the whole
+triple swaps as one immutable snapshot), and a checkpoint directory GC'd
+between list and load is tolerated, not fatal.
+"""
+
+from fedml_tpu.serve.batcher import MicroBatcher, ShedError
+from fedml_tpu.serve.registry import ModelRegistry, ServedModel
+from fedml_tpu.serve.server import ServeFrontend
+
+__all__ = ["MicroBatcher", "ShedError", "ModelRegistry", "ServedModel",
+           "ServeFrontend"]

@@ -1,0 +1,373 @@
+"""Dynamic micro-batching with power-of-two shape buckets.
+
+Single requests are terrible for an accelerator: a [1, ...] forward
+wastes the MXU and every distinct batch size jit-compiles a new program.
+So requests accumulate in a bounded queue until a SIZE trigger (the
+largest bucket fills) or a DEADLINE trigger (the oldest request has
+waited ``max_delay_s``), then the batch is padded up to a small fixed set
+of power-of-two bucket sizes — one compile per bucket, forever warm
+after, exactly the pad-to-static trick `data/stacking.gather_cohort`
+uses for training cohorts — and per-request rows are scattered back.
+
+Overload handling is shed-don't-collapse: a full queue rejects at
+``submit`` (HTTP 429 upstream), and a request whose deadline expired
+while queued is shed at dequeue instead of wasting a batch slot on an
+answer nobody is waiting for.  ``stop(drain=True)`` mirrors
+`ResilientTransport.stop`: already-queued requests still get answers,
+then the worker exits.
+
+Model consistency: the worker reads ONE `ServedModel` snapshot per batch
+from the registry, so every row of a batch is served by the same
+(params, version) — a hot swap landing mid-batch affects only the next
+batch, never tears this one.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Optional, Sequence
+
+import numpy as np
+
+from fedml_tpu.obs import telemetry
+
+log = logging.getLogger(__name__)
+
+_STOP = object()
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+class ShedError(RuntimeError):
+    """A request was rejected by admission control or load shedding.
+    ``reason`` ∈ {queue_full, deadline, shutdown, no_model} — the HTTP
+    frontend maps it to 429 (503 for no_model)."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class BadInstanceError(ValueError):
+    """The REQUEST's payload is at fault (wrong sample shape) — the one
+    prediction failure the HTTP frontend may map to 400; everything else
+    is a server fault (500)."""
+
+
+class PredictResult:
+    """One request's answer: the output row and the model version that
+    produced it (the bench's torn-read probe pairs these)."""
+    __slots__ = ("y", "version")
+
+    def __init__(self, y, version: int):
+        self.y = y
+        self.version = version
+
+
+def _settle(fut: Future, result=None, exc=None) -> None:
+    """Resolve a future, tolerating a client that already cancelled it:
+    set_result on a cancelled Future raises InvalidStateError, and one
+    impatient caller must not kill the worker thread for everyone."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+    except InvalidStateError:
+        pass
+
+
+class _Request:
+    __slots__ = ("x", "deadline", "enq_t", "future")
+
+    def __init__(self, x, deadline: Optional[float], enq_t: float,
+                 future: Future):
+        self.x = x
+        self.deadline = deadline
+        self.enq_t = enq_t
+        self.future = future
+
+
+class MicroBatcher:
+    """The request queue + batching worker thread.
+
+    ``registry``: a `ModelRegistry` (or anything with ``current()``).
+    ``buckets``: strictly-increasing batch-size buckets; the largest is
+    the size trigger.  ``max_delay_s``: the deadline trigger — how long
+    the OLDEST queued request may wait for batchmates.
+    ``queue_depth``: bound on queued requests (admission control).
+    ``default_deadline_s``: per-request deadline when submit passes none
+    (None = no deadline, requests never shed once admitted).
+    """
+
+    def __init__(self, registry, buckets: Sequence[int] = DEFAULT_BUCKETS,
+                 max_delay_s: float = 0.005, queue_depth: int = 256,
+                 default_deadline_s: Optional[float] = None):
+        buckets = tuple(int(b) for b in buckets)
+        if not buckets or list(buckets) != sorted(set(buckets)) \
+                or buckets[0] < 1:
+            raise ValueError(f"buckets must be strictly-increasing "
+                             f"positive ints, got {buckets}")
+        self.registry = registry
+        self.buckets = buckets
+        self.max_delay_s = max_delay_s
+        self.default_deadline_s = default_deadline_s
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+        self._stopped = False      # rejects new submits
+        self._drain = True         # False: fail queued requests on stop
+        self._thread: Optional[threading.Thread] = None
+        # serializes the stopped-check + enqueue against stop(): without
+        # it a submit that passed the check could land AFTER the drain
+        # sentinel and leave its Future unresolved forever
+        self._admit_lock = threading.Lock()
+        reg = telemetry.get_registry()
+        self._c_requests = reg.counter("fedml_serve_requests_total")
+        self._c_batches = reg.counter("fedml_serve_batches_total")
+        self._c_shed = {r: reg.counter("fedml_serve_shed_total", reason=r)
+                        for r in ("queue_full", "deadline", "shutdown",
+                                  "no_model")}
+        self._g_depth = reg.gauge("fedml_serve_queue_depth_total")
+        self._h_occupancy = reg.histogram(
+            "fedml_serve_batch_occupancy_total",
+            buckets=tuple(float(b) for b in buckets))
+        self._h_request = reg.histogram("fedml_serve_request_seconds")
+        self._h_predict = reg.histogram("fedml_serve_predict_seconds")
+        # the model's per-instance shape, learned from warmup or the
+        # first successful batch: the screening anchor, so one malformed
+        # FIRST arrival cannot fail its innocent batchmates
+        self._expected_shape: Optional[tuple] = None
+
+    # -- client side ---------------------------------------------------------
+    def submit(self, x, deadline_s: Optional[float] = None) -> Future:
+        """Enqueue one instance (shape = the model's sample shape).
+        Returns a Future resolving to a `PredictResult`, or raising
+        `ShedError` if the request is shed.  Raises `ShedError`
+        IMMEDIATELY when the queue is full or the batcher is stopped —
+        admission control happens here, not after queueing."""
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        now = time.monotonic()
+        req = _Request(x, None if deadline_s is None else now + deadline_s,
+                       now, Future())
+        with self._admit_lock:
+            if self._stopped:
+                self._c_shed["shutdown"].inc()
+                raise ShedError("shutdown")
+            try:
+                self._q.put_nowait(req)
+            except queue.Full:
+                self._c_shed["queue_full"].inc()
+                raise ShedError("queue_full") from None
+        self._c_requests.inc()
+        self._g_depth.set(self._q.qsize())
+        return req.future
+
+    def predict(self, x, deadline_s: Optional[float] = None,
+                timeout: Optional[float] = 30.0) -> PredictResult:
+        """Blocking submit-and-wait convenience (the bench hot path)."""
+        return self.submit(x, deadline_s).result(timeout)
+
+    def depth(self) -> int:
+        """Currently queued requests (the /healthz headroom signal)."""
+        return self._q.qsize()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="serve-batcher")
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop accepting requests; with ``drain`` answer everything
+        already queued first (the sentinel rides the FIFO behind them),
+        without it shed the queue.  Idempotent."""
+        if self._stopped and self._thread is None:
+            return
+        with self._admit_lock:
+            # once this releases, no submit can pass the stopped check,
+            # so everything ever admitted is ahead of the sentinel
+            self._stopped = True
+            self._drain = drain
+        if self._thread is None:  # never started: settle inline
+            self._flush_remaining()
+            return
+        # land the sentinel: the queue is bounded, so on a full queue
+        # wait for the worker to make room — and if the worker is gone
+        # (died, or a previous join timed out), settle inline instead of
+        # blocking shutdown forever
+        while True:
+            try:
+                self._q.put(_STOP, timeout=1.0)
+                break
+            except queue.Full:
+                if not self._thread.is_alive():
+                    self._thread = None
+                    self._flush_remaining()
+                    return
+        self._thread.join(timeout=30)
+        self._thread = None
+
+    def warmup(self, sample_x) -> int:
+        """Compile every bucket against the live model (one forward per
+        bucket size) so no request ever pays a jit compile.  Returns the
+        number of buckets warmed; no-op without a live model."""
+        m = self.registry.current()
+        if m is None:
+            return 0
+        row = np.asarray(sample_x)
+        for b in self.buckets:
+            xb = np.broadcast_to(row, (b,) + row.shape)
+            np.asarray(m.apply_fn(m.params, xb))
+        self._expected_shape = row.shape
+        return len(self.buckets)
+
+    # -- worker --------------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is _STOP:
+                break
+            batch = [first]
+            stop_seen = self._accumulate(batch)
+            self._g_depth.set(self._q.qsize())
+            self._process(batch)
+            if stop_seen:
+                break
+        # post-sentinel: anything still queued arrived before stop()
+        # returned the sentinel — drain answers it, abort sheds it
+        self._flush_remaining()
+
+    def _accumulate(self, batch) -> bool:
+        """Fill ``batch`` until the largest bucket or the oldest
+        request's flush deadline.  Returns True when the STOP sentinel
+        was consumed (caller processes the batch, then exits).
+
+        The already-queued backlog is drained GREEDILY first: under
+        load the oldest request's flush deadline is already past, and
+        consulting it before grabbing queued batchmates would dribble
+        out singleton batches at exactly the moment big batches matter
+        most (the failure mode the first bench run caught: 2k req/s
+        arrivals served 1.2k/s in batches of one)."""
+        cap = self.buckets[-1]
+        while len(batch) < cap:
+            try:
+                nxt = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if nxt is _STOP:
+                return True
+            batch.append(nxt)
+        flush_at = batch[0].enq_t + self.max_delay_s
+        while len(batch) < cap:
+            wait = flush_at - time.monotonic()
+            if wait <= 0:
+                return False
+            try:
+                nxt = self._q.get(timeout=wait)
+            except queue.Empty:
+                return False
+            if nxt is _STOP:
+                return True
+            batch.append(nxt)
+        return False
+
+    def _flush_remaining(self) -> None:
+        while True:
+            remaining = []
+            try:
+                while True:
+                    r = self._q.get_nowait()
+                    if r is not _STOP:
+                        remaining.append(r)
+            except queue.Empty:
+                pass
+            if not remaining:
+                return
+            if self._drain:
+                # answer in bucket-sized waves (still one snapshot/batch)
+                for i in range(0, len(remaining), self.buckets[-1]):
+                    self._process(remaining[i:i + self.buckets[-1]])
+            else:
+                for r in remaining:
+                    self._c_shed["shutdown"].inc()
+                    _settle(r.future, exc=ShedError("shutdown"))
+
+    def _process(self, batch) -> None:
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline is not None and now > r.deadline:
+                self._c_shed["deadline"].inc()
+                _settle(r.future, exc=ShedError("deadline"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        snapshot = self.registry.current()  # ONE snapshot for the batch
+        if snapshot is None:
+            for r in live:
+                self._c_shed["no_model"].inc()
+                _settle(r.future, exc=ShedError("no_model"))
+            return
+        # per-request shape screening: one malformed x must fail ITS
+        # request, not every innocent batchmate np.stack would drag
+        # down.  Anchor on the learned model shape when known (warmup /
+        # first good batch) so a malformed FIRST arrival can't hijack
+        # the anchor and fail valid batchmates.
+        rows_np, keep = [], []
+        for r in live:
+            arr = np.asarray(r.x)
+            anchor = self._expected_shape or (rows_np[0].shape if rows_np
+                                              else None)
+            if anchor is not None and arr.shape != anchor:
+                _settle(r.future, exc=BadInstanceError(
+                    f"instance shape {arr.shape} does not match the "
+                    f"model's {anchor}"))
+                continue
+            rows_np.append(arr)
+            keep.append(r)
+        live = keep
+        if not live:
+            return
+        bucket = next(b for b in self.buckets if b >= len(live))
+        try:
+            rows = np.stack(rows_np)
+            if bucket > len(live):  # pad with the first row (any valid
+                # shape works; padded outputs are sliced off below)
+                pad = np.broadcast_to(rows[:1],
+                                      (bucket - len(live),) + rows.shape[1:])
+                rows = np.concatenate([rows, pad])
+            t0 = time.perf_counter()
+            out = np.asarray(snapshot.apply_fn(snapshot.params, rows))
+            self._h_predict.observe(time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 — bad payload/model: fail
+            # the batch's requests, never the worker thread
+            log.exception("batch of %d failed", len(live))
+            for r in live:
+                _settle(r.future, exc=e)
+            return
+        if self._expected_shape is None:
+            self._expected_shape = rows_np[0].shape  # learned: this
+            # batch applied cleanly, so its shape IS the model's
+        self._c_batches.inc()
+        self._h_occupancy.observe(len(live))
+        done = time.monotonic()
+        for i, r in enumerate(live):
+            if r.deadline is not None and done > r.deadline:
+                # the answer exists but nobody useful is waiting: a late
+                # response is a failed response — shed it so delivered
+                # latency stays under the deadline by construction
+                self._c_shed["deadline"].inc()
+                _settle(r.future, exc=ShedError("deadline"))
+                continue
+            self._h_request.observe(done - r.enq_t)
+            _settle(r.future, PredictResult(out[i], snapshot.version))

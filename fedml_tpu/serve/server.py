@@ -1,0 +1,188 @@
+"""HTTP serving frontend: ThreadingHTTPServer over the micro-batcher.
+
+Stdlib-only (the `telemetry.start_http_server` posture — one daemon
+thread per connection, fine for the CPU/silo edge; a TPU pod fronts this
+with a real LB).  Endpoints:
+
+* ``POST /predict`` — body ``{"x": [...], "deadline_ms": 50}``; the
+  instance rides the micro-batcher and the answer carries the model
+  version that produced it: ``{"y": [...], "version": 12}``.  Shed
+  requests answer **429** (deadline/queue-full — retry later), a
+  registry with no model yet answers **503**.  The per-request deadline
+  (body field or ``X-Deadline-Ms`` header) propagates into the batcher,
+  so a request that waited out its budget in the queue is shed there
+  instead of dispatched late.
+* ``GET /healthz`` — 200 with ``{"status": "ok", "version": ...,
+  "queue_depth": ...}`` once a model is live, 503 before (a load
+  balancer keeps the instance out of rotation until the first publish).
+* ``GET /version`` — the live/pinned version and known history (the
+  bench asserts this ADVANCES across hot swaps).
+* ``GET /metrics`` — Prometheus text from the process telemetry
+  registry (the PR 2 exposition, `fedml_serve_*` series included).
+
+Request spans: with tracing enabled each /predict records a
+``serve_request`` span, so serving latency lands in the same Perfetto
+timeline as the training rounds it interleaves with.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+from concurrent.futures import TimeoutError as FuturesTimeout
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.obs import telemetry, trace
+from fedml_tpu.serve.batcher import (BadInstanceError, MicroBatcher,
+                                     ShedError)
+from fedml_tpu.serve.registry import ModelRegistry
+
+log = logging.getLogger(__name__)
+
+
+class ServeFrontend:
+    """Own the HTTP server lifecycle around a (registry, batcher) pair.
+
+    ``port=0`` binds an ephemeral port (tests); read ``.port`` after
+    ``start()``.  ``stop()`` closes the listener, then drains the
+    batcher — in-flight requests still answer."""
+
+    def __init__(self, registry: ModelRegistry, batcher: MicroBatcher,
+                 port: int = 0, host: str = "127.0.0.1"):
+        self.registry = registry
+        self.batcher = batcher
+        self._host = host
+        self._requested_port = port
+        self._server: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        if self._server is None:
+            return self._requested_port
+        return self._server.server_address[1]
+
+    def start(self) -> "ServeFrontend":
+        if self._server is not None:
+            return self
+        handler = _make_handler(self.registry, self.batcher)
+        self._server = http.server.ThreadingHTTPServer(
+            (self._host, self._requested_port), handler)
+        self._server.daemon_threads = True
+        self.batcher.start()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"serve-http-{self.port}")
+        self._thread.start()
+        log.info("serving /predict on %s:%d", self._host, self.port)
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            self._thread = None
+        self.batcher.stop(drain=drain)
+
+
+def _make_handler(registry: ModelRegistry, batcher: MicroBatcher):
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # keep-alive: the load generator
+        # reuses connections, without this every request pays a TCP dial
+        disable_nagle_algorithm = True  # headers+body go out as separate
+        # small writes; with Nagle on, loopback keep-alive traffic stalls
+        # on the peer's ~40ms delayed ACK and p50 jumps 10x
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            # drop any query string before matching: LB health probes
+            # commonly append cache-busting params (/healthz?probe=1)
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path == "/healthz":
+                m = registry.current()
+                if m is None:
+                    self._reply(503, {"status": "no_model"})
+                else:
+                    self._reply(200, {"status": "ok", "version": m.version,
+                                      "queue_depth": batcher.depth()})
+            elif path == "/version":
+                self._reply(200, {"version": registry.version,
+                                  "pinned": registry.pinned,
+                                  "history": registry.versions()})
+            elif path == "/metrics":
+                body = telemetry.get_registry().render_prometheus().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._reply(404, {"error": "not_found", "path": self.path})
+
+        def do_POST(self):
+            # ALWAYS consume the body first: on HTTP/1.1 keep-alive an
+            # unread body would be parsed as the NEXT request line and
+            # desync the connection
+            try:
+                n = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                n = 0
+            body = self.rfile.read(n)
+            if self.path.split("?", 1)[0].rstrip("/") != "/predict":
+                self._reply(404, {"error": "not_found", "path": self.path})
+                return
+            try:
+                req = json.loads(body or b"{}")
+                x = np.asarray(req["x"], dtype=np.float32)
+                deadline_ms = req.get("deadline_ms",
+                                      self.headers.get("X-Deadline-Ms"))
+                deadline_s = (float(deadline_ms) / 1e3
+                              if deadline_ms is not None else None)
+            except (ValueError, KeyError, TypeError) as e:
+                self._reply(400, {"error": "bad_request", "detail": str(e)})
+                return
+            tracer = trace.get_tracer()
+            span = (tracer.start_span("serve_request", parent=None,
+                                      version=registry.version)
+                    if tracer is not None else None)
+            try:
+                result = batcher.predict(x, deadline_s=deadline_s)
+                self._reply(200, {"y": np.asarray(result.y).tolist(),
+                                  "version": result.version})
+            except ShedError as e:
+                self._reply(503 if e.reason == "no_model" else 429,
+                            {"error": "shed", "reason": e.reason})
+            except FuturesTimeout:
+                # the batcher never answered: a server-side stall, not a
+                # client error — 503 so LBs retry/fail over instead of
+                # blaming the request
+                self._reply(503, {"error": "timeout"})
+            except BadInstanceError as e:
+                # the one prediction failure that IS the client's fault
+                self._reply(400, {"error": "bad_instance",
+                                  "detail": str(e)})
+            except Exception as e:  # noqa: BLE001 — model/params fault:
+                # a 4xx here would stop LBs retrying a broken instance
+                self._reply(500, {"error": "predict_failed",
+                                  "detail": str(e)})
+            finally:
+                if span is not None:
+                    span.end()
+
+        def log_message(self, *args):  # no per-request stderr spam
+            pass
+
+    return _Handler

@@ -51,28 +51,44 @@ class RoundCheckpointer:
     rounds, keeping ``max_to_keep`` checkpoints."""
 
     def __init__(self, ckpt_dir: str, save_every: int = 1,
-                 max_to_keep: int = 3, async_save: bool = False):
+                 max_to_keep: int = 3, async_save: bool = False,
+                 keep_last_n: Optional[int] = None):
         """``async_save=True`` lets orbax serialize in a background thread
         so training never blocks on checkpoint I/O (the TPU stays fed).
         Durability semantics: a save is guaranteed on disk only after the
         NEXT save, ``flush()``, ``close()``, or any read (latest_round /
         restore) — a process killed mid-write leaves the previous
         checkpoint intact (orbax writes to a tmp dir and renames).  The
-        sync default trades round latency for save-returns-durable."""
+        sync default trades round latency for save-returns-durable.
+
+        ``keep_last_n`` is the retention knob for serve-while-train runs
+        (the serving registry watches this directory, so an unbounded
+        run would fill the disk it serves from): only the newest N round
+        dirs survive each save — older ones are GC'd, and readers (the
+        `serve.registry.CheckpointWatcher`) must tolerate a step
+        vanishing between list and load.  It overrides ``max_to_keep``
+        when set; 0/None keeps the default of 3."""
         import orbax.checkpoint as ocp
         self.save_every = max(1, int(save_every))
         self.async_save = async_save
         self.ckpt_dir = os.path.abspath(ckpt_dir)
+        if keep_last_n:
+            max_to_keep = int(keep_last_n)
+        self.keep_last_n = max_to_keep
         self._mngr = ocp.CheckpointManager(
             self.ckpt_dir,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep))
         self._ocp = ocp
 
-    def maybe_save(self, round_idx: int, state: Dict[str, Any],
+    def maybe_save(self, round_idx: int, state,
                    last_round: bool = False) -> bool:
+        """``state`` may be the state dict OR a zero-arg callable building
+        it — callers with expensive state (device→host copies, the EF
+        fixed-shape serialization) pass a thunk so skipped rounds pay
+        nothing for the ``save_every`` gate."""
         if not last_round and (round_idx + 1) % self.save_every:
             return False
-        self.save(round_idx, state)
+        self.save(round_idx, state() if callable(state) else state)
         return True
 
     def save(self, round_idx: int, state: Dict[str, Any]) -> None:
@@ -117,7 +133,11 @@ class RoundCheckpointer:
             restored = self._mngr.restore(
                 step, args=self._ocp.args.StandardRestore(_pack_keys(like)))
         else:
-            restored = self._mngr.restore(step)
+            # explicit StandardRestore: a FRESH manager (the serving
+            # watcher opens one read-side per load) has no handler
+            # registry from a prior save and a bare restore() refuses
+            restored = self._mngr.restore(
+                step, args=self._ocp.args.StandardRestore())
         return _unpack_keys(restored)
 
     def close(self) -> None:

@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""Open-loop load generator for the serving layer → BENCH_serve.json.
+
+Open-loop (arrivals paced by a clock, not by completions — the honest
+way to measure a queueing system: a closed loop self-throttles and hides
+collapse) against the linear/MNIST model (784→10).  Reports p50/p95/p99
+latency, sustained throughput, shed rate, and the batch-occupancy
+histogram, while a swapper thread hot-swaps the model version mid-load
+``--swaps`` times; every response is probed for torn reads.
+
+Torn-read probe: version v serves kernel ``W[0, :] = v`` and bias
+``onehot(v % 10)``, and every request sends ``x = e_0``, so a response
+must satisfy BOTH ``round(min(y)) == version`` (kernel half) and
+``argmax(y) == version % 10`` (bias half) for the version the batcher
+says served it.  A swap landing mid-batch that mixed leaves from two
+versions fails one of the two.
+
+Default drive is in-process (request → batcher future), isolating the
+serving stack from HTTP client throughput; ``--http`` routes the same
+schedule through the ThreadingHTTPServer frontend with keep-alive
+connections.  ``--ckpt_dir`` serves a real checkpoint directory through
+the `CheckpointWatcher` instead of the synthetic fingerprint models
+(torn-read probing is then skipped — real params have no fingerprint).
+
+    JAX_PLATFORMS=cpu python scripts/serve_bench.py \
+        --rate 2000 --duration_s 5 --swaps 10 --out BENCH_serve.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DIM, CLASSES = 784, 10  # MNIST linear
+
+
+def fingerprint_params(version: int):
+    w = np.zeros((DIM, CLASSES), np.float32)
+    w[0, :] = float(version)
+    b = np.zeros(CLASSES, np.float32)
+    b[version % CLASSES] = 1.0
+    return {"w": w, "b": b}
+
+
+def is_torn(y: np.ndarray, version: int) -> bool:
+    return (int(round(float(y.min()))) != version
+            or int(np.argmax(y)) != version % CLASSES)
+
+
+def build_stack(args):
+    import jax
+
+    from fedml_tpu.obs import telemetry
+    from fedml_tpu.serve import MicroBatcher, ModelRegistry
+
+    telemetry.enable()
+    apply_fn = jax.jit(lambda p, x: x @ p["w"] + p["b"])
+    registry = ModelRegistry(apply_fn, history=max(4, args.swaps + 2))
+    watcher = None
+    if args.ckpt_dir:
+        from fedml_tpu.experiments.models import create_workload
+        from fedml_tpu.serve.registry import CheckpointWatcher
+        wl = create_workload(args.model, args.dataset, CLASSES, (28, 28, 1))
+        predict = jax.jit(lambda p, x: wl.apply(p, x))
+        registry = ModelRegistry(predict, history=16)
+        watcher = CheckpointWatcher(registry, args.ckpt_dir, poll_s=0.25)
+        watcher.poll_once()  # publish what's already on disk
+        watcher.start()
+        if registry.current() is None:
+            raise SystemExit(f"no loadable checkpoint under {args.ckpt_dir}")
+    else:
+        registry.publish(fingerprint_params(0), 0)
+    batcher = MicroBatcher(
+        registry,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        max_delay_s=args.batch_delay_ms / 1e3,
+        queue_depth=args.queue_depth,
+        default_deadline_s=args.deadline_ms / 1e3).start()
+    return registry, batcher, watcher
+
+
+def run_bench(args):
+    registry, batcher, watcher = build_stack(args)
+    sample = np.zeros(DIM, np.float32)
+    sample[0] = 1.0
+    if args.ckpt_dir:
+        sample = np.zeros((28, 28, 1), np.float32)
+    batcher.warmup(sample)
+
+    results = []          # (latency_s, version, torn) — appended per future
+    shed = [0]
+    issued = [0]
+    lock = threading.Lock()
+    stop_swapper = threading.Event()
+
+    def swapper():
+        """--swaps mid-load hot swaps, evenly spaced over the run."""
+        for i in range(1, args.swaps + 1):
+            if stop_swapper.wait(args.duration_s / (args.swaps + 1)):
+                return
+            registry.publish(fingerprint_params(i), i)
+        stop_swapper.wait()
+
+    swap_thread = None
+    if args.swaps and not args.ckpt_dir:
+        swap_thread = threading.Thread(target=swapper, daemon=True)
+        swap_thread.start()
+
+    def on_done(t_submit, fut):
+        try:
+            r = fut.result()
+        except Exception:  # ShedError (deadline) rides the future
+            with lock:
+                shed[0] += 1
+            return
+        lat = time.perf_counter() - t_submit
+        torn = (not args.ckpt_dir) and is_torn(np.asarray(r.y), r.version)
+        with lock:
+            results.append((lat, r.version, torn))
+
+    def drive_inproc():
+        from fedml_tpu.serve.batcher import ShedError
+        interval = 1.0 / args.rate
+        t_next = time.perf_counter()
+        t_end = t_next + args.duration_s
+        while (now := time.perf_counter()) < t_end:
+            if now < t_next:
+                time.sleep(t_next - now)
+            t_next += interval
+            issued[0] += 1
+            t0 = time.perf_counter()
+            try:
+                fut = batcher.submit(sample)
+            except ShedError:
+                with lock:
+                    shed[0] += 1
+                continue
+            fut.add_done_callback(lambda f, t0=t0: on_done(t0, f))
+
+    def drive_http():
+        import http.client
+
+        from fedml_tpu.serve import ServeFrontend
+        frontend = ServeFrontend(registry, batcher, port=args.port).start()
+        payload = json.dumps({"x": sample.tolist()})
+        hdrs = {"Content-Type": "application/json"}
+        n_threads = args.http_clients
+        per_rate = args.rate / n_threads
+
+        def fresh_conn():
+            import socket
+            conn = http.client.HTTPConnection("127.0.0.1", frontend.port)
+            conn.connect()
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return conn
+
+        def client(tid):
+            conn = fresh_conn()
+            interval = 1.0 / per_rate
+            t_next = time.perf_counter()
+            t_end = t_next + args.duration_s
+            while (now := time.perf_counter()) < t_end:
+                if now < t_next:
+                    time.sleep(t_next - now)
+                t_next += interval
+                with lock:  # shared across client threads
+                    issued[0] += 1
+                t0 = time.perf_counter()
+                try:
+                    conn.request("POST", "/predict", payload, hdrs)
+                    resp = conn.getresponse()
+                    body = json.loads(resp.read())
+                except Exception:
+                    conn.close()
+                    conn = fresh_conn()
+                    with lock:
+                        shed[0] += 1
+                    continue
+                lat = time.perf_counter() - t0
+                if resp.status != 200:
+                    with lock:
+                        shed[0] += 1
+                    continue
+                y = np.asarray(body["y"])
+                torn = (not args.ckpt_dir) and is_torn(y, body["version"])
+                with lock:
+                    results.append((lat, body["version"], torn))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        frontend.stop()
+        return time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    if args.http:
+        wall = drive_http()
+    else:
+        drive_inproc()
+        batcher.stop(drain=True)  # drain: every queued request answers
+        wall = time.perf_counter() - t0
+    stop_swapper.set()
+    if watcher is not None:
+        watcher.stop()
+
+    lats = sorted(r[0] for r in results)
+    torn_count = sum(1 for r in results if r[2])
+    versions = sorted({r[1] for r in results})
+    from fedml_tpu.obs import telemetry
+    snap = telemetry.get_registry().snapshot()
+    occupancy = snap.get("histograms", {}).get(
+        "fedml_serve_batch_occupancy_total", {})
+    pct = (lambda p: lats[min(len(lats) - 1, int(p * len(lats)))]
+           if lats else None)
+    out = {
+        "bench": "serve",
+        "mode": "http" if args.http else "inproc",
+        "model": "linear_mnist_784x10",
+        "rate_target_rps": args.rate,
+        "duration_s": round(wall, 3),
+        "issued": issued[0],
+        "completed": len(results),
+        "throughput_rps": round(len(results) / wall, 1) if wall else 0.0,
+        "shed": shed[0],
+        "shed_rate": round(shed[0] / max(issued[0], 1), 4),
+        "deadline_ms": args.deadline_ms,
+        "latency_ms": {p: round(v * 1e3, 3) if v is not None else None
+                       for p, v in (("p50", pct(0.50)), ("p95", pct(0.95)),
+                                    ("p99", pct(0.99)),
+                                    ("max", lats[-1] if lats else None))},
+        "hot_swaps": args.swaps if not args.ckpt_dir else None,
+        "versions_served": versions,
+        "torn_responses": torn_count,
+        "batch_occupancy": occupancy,
+    }
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rate", type=float, default=2000.0,
+                    help="open-loop arrival rate, req/s")
+    ap.add_argument("--duration_s", type=float, default=5.0)
+    ap.add_argument("--swaps", type=int, default=10,
+                    help="mid-load hot swaps (synthetic mode)")
+    ap.add_argument("--buckets", default="1,2,4,8,16,32,64")
+    ap.add_argument("--deadline_ms", type=float, default=50.0)
+    ap.add_argument("--batch_delay_ms", type=float, default=2.0)
+    ap.add_argument("--queue_depth", type=int, default=512)
+    ap.add_argument("--http", action="store_true",
+                    help="drive through the HTTP frontend (keep-alive)")
+    ap.add_argument("--http_clients", type=int, default=8)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--ckpt_dir", default="",
+                    help="serve a RoundCheckpointer dir via the watcher "
+                         "instead of synthetic fingerprint models")
+    ap.add_argument("--model", default="lr")
+    ap.add_argument("--dataset", default="mnist")
+    ap.add_argument("--out", default="BENCH_serve.json")
+    args = ap.parse_args(argv)
+
+    out = run_bench(args)
+    print(json.dumps(out, indent=2))
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+    print(f"wrote {args.out}")
+
+    p99 = out["latency_ms"]["p99"]
+    ok = (out["throughput_rps"] >= 1000 if args.rate >= 1000 else True) \
+        and out["torn_responses"] == 0 \
+        and (p99 is None or p99 <= args.deadline_ms)
+    if not ok:
+        print("BENCH FAILED acceptance: need >=1k req/s, p99 under "
+              f"deadline, zero torn; got {out['throughput_rps']} rps, "
+              f"p99={p99}ms, torn={out['torn_responses']}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,13 @@
 #
 #   WORKERS=4 scripts/test_fast.sh          # explicit worker count
 #   scripts/test_fast.sh -k compress        # extra pytest args pass through
+#
+# The fast tier covers every non-slow test file under tests/, including
+# the serving layer (tests/test_serve.py — registry hot-swap, batching,
+# shedding, HTTP frontend); sustained-load serve cases are @slow and run
+# via scripts/serve_bench.py / run_serve_demo.sh instead.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+[ -f tests/test_serve.py ]  # fast tier must include the serve suite
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

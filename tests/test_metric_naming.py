@@ -43,6 +43,17 @@ EXPECTED = {
     "fedml_async_version_duration_seconds", "fedml_async_staleness_total",
     "fedml_trainer_compile_seconds", "fedml_trainer_train_seconds",
     "fedml_trainer_examples_total",
+    # PR 3: wire-compression bandwidth accounting (experiments/main.py)
+    "fedml_comm_compressed_bytes_total", "fedml_comm_raw_bytes_total",
+    "fedml_comm_compression_ratio_total",
+    # PR 3: the serving subsystem (fedml_tpu/serve/ — the rglob scan
+    # below covers the new tree automatically)
+    "fedml_serve_model_version_total", "fedml_serve_hot_swap_total",
+    "fedml_serve_rollback_total", "fedml_serve_checkpoint_load_total",
+    "fedml_serve_requests_total", "fedml_serve_batches_total",
+    "fedml_serve_shed_total", "fedml_serve_queue_depth_total",
+    "fedml_serve_batch_occupancy_total",
+    "fedml_serve_request_seconds", "fedml_serve_predict_seconds",
 }
 
 

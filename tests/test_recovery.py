@@ -194,6 +194,136 @@ def test_async_duplicate_upload_rejected_even_after_flush():
     assert len(server.staleness_seen) == 1
 
 
+def _ef_federation(init, num_rounds, ck=None, crash_after=None,
+                   restore_ef=True):
+    """Cross-silo federation with topk wire compression + deferred error
+    feedback, mirroring the run_cross_silo wiring: one process-shared
+    `ErrorFeedback` (the local backend), encode applies+records, the
+    server ack (ARG_ACCEPTED on the next sync) resolves, and — when
+    ``restore_ef`` — the EF state rides the server checkpoint via the
+    extra_state hook."""
+    import jax
+
+    from fedml_tpu.comm.compress import (ErrorFeedback, compress_update,
+                                         decompress_update)
+
+    ef = ErrorFeedback()
+    n_silos = 3
+    assert init["dense"]["kernel"].size >= 16, \
+        "leaves under 16 entries ride compress_update's dense (lossless) " \
+        "path — the EF residual would be identically zero"
+
+    def make_train_fn(silo):
+        def fn(params, client_idx, round_idx):
+            # deterministic per (silo, round) so an uninterrupted and a
+            # resumed run see IDENTICAL deltas; varied magnitudes so topk
+            # keeps different coordinates each round (residuals matter)
+            rs = np.random.RandomState(silo * 1000 + round_idx)
+            new = jax.tree.map(
+                lambda v: v + rs.randn(*v.shape).astype(v.dtype), params)
+            return new, 10
+        return fn
+
+    def make_encode(silo):
+        def enc(new_params, global_params):
+            delta = jax.tree.map(np.subtract, new_params, global_params)
+            delta = ef.apply(silo, delta)
+            payload = compress_update(delta, "topk", topk_frac=0.25)
+            ef.record(silo, delta, decompress_update(payload, delta))
+            return payload
+        return enc
+
+    def decode(payload, global_params):
+        host = jax.tree.map(np.asarray, global_params)
+        return jax.tree.map(np.add, host,
+                            decompress_update(payload, host))
+
+    extra = None
+    if restore_ef:
+        template = jax.tree.map(lambda v: np.zeros_like(np.asarray(v)),
+                                init)
+        extra = (lambda: ef.state_dict(range(1, n_silos + 1), template),
+                 ef.load_state_dict)
+
+    hub = LocalHub()
+    completed = []
+
+    def on_done(r, p):
+        completed.append(r)
+        if crash_after is not None and r >= crash_after:
+            raise _Crash()
+
+    server = FedAvgServerActor(
+        hub.transport(0), init, client_num_in_total=n_silos,
+        client_num_per_round=n_silos, num_rounds=num_rounds,
+        on_round_done=on_done, decode_upload=decode, checkpointer=ck,
+        extra_state=extra)
+    clients = [
+        FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
+                          encode_upload=make_encode(i),
+                          on_accepted=lambda acc, i=i: ef.resolve(i, acc))
+        for i in range(1, n_silos + 1)]
+    server.register_handlers()
+    for c in clients:
+        c.register_handlers()
+    if crash_after is not None:
+        with pytest.raises(_Crash):
+            server.start()
+            hub.pump()
+    else:
+        server.start()
+        hub.pump()
+    return server, completed
+
+
+def test_error_feedback_resume_is_bit_identical(tmp_path):
+    """ISSUE 3 satellite/acceptance: EF residuals are cross-round state —
+    a checkpoint without them makes a resumed --error_feedback run
+    diverge.  With the extra_state hook, kill-after-round-2 + resume
+    lands on EXACTLY (bit-identical, not allclose) the uninterrupted
+    run's params; without it, the divergence the bug caused is visible."""
+    rng = np.random.RandomState(11)
+    init = {"dense": {"kernel": rng.randn(8, 6).astype(np.float32),
+                      "bias": rng.randn(6).astype(np.float32)}}
+    straight, comp = _ef_federation(init, 5)
+    assert comp == [0, 1, 2, 3, 4]
+
+    ck = RoundCheckpointer(str(tmp_path / "ck"), save_every=1)
+    _, comp1 = _ef_federation(init, 5, ck=ck, crash_after=2)
+    assert comp1 == [0, 1, 2]
+
+    resumed, comp2 = _ef_federation(
+        init, 5, ck=RoundCheckpointer(str(tmp_path / "ck")))
+    assert comp2 == [3, 4]
+    for key in ("kernel", "bias"):
+        np.testing.assert_array_equal(
+            np.asarray(resumed.params["dense"][key]),
+            np.asarray(straight.params["dense"][key]),
+            err_msg="EF resume is not bit-identical")
+
+    # the regression the fix closes: checkpoints carrying only the old
+    # (params, round, accepted) tuple — no EF state — silently diverge
+    ck2 = RoundCheckpointer(str(tmp_path / "ck2"), save_every=1)
+    _ef_federation(init, 5, ck=ck2, crash_after=2, restore_ef=False)
+    diverged, _ = _ef_federation(
+        init, 5, ck=RoundCheckpointer(str(tmp_path / "ck2")),
+        restore_ef=False)
+    assert np.abs(np.asarray(diverged.params["dense"]["kernel"])
+                  - np.asarray(straight.params["dense"]["kernel"])).max() \
+        > 0, "EF state did not matter — the test lost its teeth"
+
+    # schema drift must not crash: a pre-EF checkpoint (no "extra" leaf)
+    # resumed with EF configured falls back to an untemplated restore
+    # and completes (resuming beats crashing)
+    ck3 = RoundCheckpointer(str(tmp_path / "ck3"), save_every=1)
+    _ef_federation(init, 5, ck=ck3, crash_after=2, restore_ef=False)
+    upgraded, comp3 = _ef_federation(
+        init, 5, ck=RoundCheckpointer(str(tmp_path / "ck3")))
+    assert comp3 == [3, 4]
+    assert np.isfinite(
+        np.asarray(upgraded.params["dense"]["kernel"])).all()
+
+
 def _route_timeout(hub, round_idx):
     hub.route(Message(MsgType.ROUND_TIMEOUT, 0, 0)
               .add(Message.ARG_ROUND, round_idx))
