@@ -32,8 +32,10 @@ FedAvgClientActor choreography — INIT/SYNC in, MODEL out.
 from __future__ import annotations
 
 import logging
+import math
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+import zlib
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 import jax
 import numpy as np
@@ -50,6 +52,20 @@ log = logging.getLogger(__name__)
 # server self-message from the re-task watchdog timer (value continues
 # the MsgType numbering in algorithms/cross_silo.py)
 MSG_RETASK_TICK = 7
+
+
+def _payload_crc(tree) -> int:
+    """Content crc32 over a delta's leaf bytes (the cheap frame identity
+    the rejected-upload dedupe keys on).  Non-tree junk payloads hash to
+    a sentinel — admission rejects them anyway."""
+    try:
+        crc = 0
+        for leaf in jax.tree.leaves(tree):
+            crc = zlib.crc32(
+                np.ascontiguousarray(np.asarray(leaf)).tobytes(), crc)
+        return crc
+    except Exception:  # noqa: BLE001 — unhashable garbage payload
+        return -1
 
 
 def delta_encoder(new_params, global_params):
@@ -73,7 +89,9 @@ class AsyncFedServerActor(ServerManager):
                  staleness_exponent: float = 0.5, server_lr: float = 1.0,
                  on_version: Optional[Callable[[int, object], None]] = None,
                  seed: int = 0, checkpointer=None,
-                 retask_timeout_s: Optional[float] = None):
+                 retask_timeout_s: Optional[float] = None,
+                 admission=None,
+                 defended_aggregate: Optional[Callable] = None):
         """``checkpointer``: a `RoundCheckpointer`; every applied version
         is saved per its ``save_every`` gating and ``start()`` resumes
         from the latest saved version — a crashed async server restarts
@@ -86,7 +104,24 @@ class AsyncFedServerActor(ServerManager):
         active the server wedges.  With a watchdog, any silo quiet for
         this long is re-tasked with a fresh assignment against the
         current global (a duplicate from a silo that was merely slow is
-        handled by the at-most-once buffer guard)."""
+        handled by the at-most-once buffer guard).
+
+        ``admission``: a `fedml_tpu.robust.AdmissionPipeline` built with
+        ``kind="delta"`` — screen BEFORE buffering: a rejected delta
+        never enters the buffer, the offending silo is struck, and a
+        QUARANTINED silo is benched (not re-tasked) until its sentence
+        expires at a later version, when it is re-tasked on probation.
+        Honest-looking rejects (wire corruption) are re-tasked
+        immediately so they stay in rotation.
+
+        ``defended_aggregate``: a
+        `fedml_tpu.robust.make_defended_aggregate` product applied to
+        the static ``[goal, ...]`` stacked delta buffer with the raw
+        sample weights; the staleness discount is applied AFTER the
+        robust aggregate (the buffer's sample-weighted mean discount
+        scales the applied step), so a Byzantine rule cannot be gamed
+        through staleness claims.  When None, the exact legacy
+        sample+discount weighted mean is used."""
         super().__init__(0, transport)
         if not 1 <= aggregation_goal <= n_silos:
             raise ValueError(
@@ -111,6 +146,23 @@ class AsyncFedServerActor(ServerManager):
         # (silo, base_version) pairs already aggregated — the at-most-once
         # guard must survive buffer flushes, not just scan the live buffer
         self._consumed: set = set()
+        self.admission = admission
+        self.defended_aggregate = defended_aggregate
+        # quarantined silos we declined to re-task; released on probation
+        self._benched: Set[int] = set()
+        # (silo, base_version) -> payload crcs already REJECTED — a
+        # duplicated delivery of the SAME frame (chaos dup, transport
+        # retry) must not strike twice, but a FRESH malicious upload
+        # after a re-task (same silo + base version, different payload)
+        # is a new offense and must strike again.  The crc is computed
+        # lazily: accepted-path uploads pay one dict miss, never a
+        # model-bytes hash; entries are pruned as versions advance.
+        self._rejected_crcs: Dict[Tuple[int, int], set] = {}
+        # defended-path templates, built on first flush and reused: the
+        # shapes are static by design (the jit-once premise), so the
+        # model-sized zeros trees must not be reallocated every version
+        self._delta_zeros = None    # one [ ... ] zero delta (pad slots)
+        self._stacked_zeros = None  # the clip reference for the jit
         self._finished = False
         # version observability: inter-aggregation gap + per-upload
         # staleness (null no-ops when telemetry is disabled)
@@ -180,8 +232,14 @@ class AsyncFedServerActor(ServerManager):
         # duplicate the at-most-once guard rejects
         buffered = {s for _, _, _, s, _ in self._buffer}
         for silo in range(1, self.n_silos + 1):
-            if silo in buffered:
+            if silo in buffered or silo in self._benched:
+                # benched silos are OWNED by the version-close probation
+                # release — a watchdog nudge here would double-task them
+                # the moment their quarantine lazily expires
                 continue
+            if self.admission is not None and self.admission.trust.state(
+                    silo, self.version) == "quarantined":
+                continue  # jailed but never benched: wait out the sentence
             quiet = now - self._last_heard.get(silo, now)
             if quiet >= self.retask_timeout_s:
                 log.warning("silo %d quiet for %.1fs; re-tasking against "
@@ -216,7 +274,23 @@ class AsyncFedServerActor(ServerManager):
         self._last_heard[msg.sender_id] = time.monotonic()
         if self.version >= self.num_versions:
             return  # late upload after FINISH
-        base_version = int(msg.get(Message.ARG_ROUND))
+        try:
+            base_version = int(msg.get(Message.ARG_ROUND))
+        except (TypeError, ValueError):
+            # a frame without a round tag has no staleness — reject it
+            # with a warning instead of killing the handler thread
+            self._reject_malformed(
+                msg, -1, f"missing/invalid round tag "
+                f"{msg.get(Message.ARG_ROUND)!r}")
+            return
+        if base_version > self.version:
+            # a FUTURE version tag is forged (the server never issued it):
+            # staleness would go negative and (1+s)^-alpha would divide by
+            # zero (s=-1) or go complex (s<=-2) — reject instead
+            self._reject_malformed(
+                msg, base_version, f"future version tag {base_version} "
+                f"(current {self.version})")
+            return
         if (msg.sender_id, base_version) in self._consumed or \
                 any(s == msg.sender_id and b == base_version
                     for _, _, _, s, b in self._buffer):
@@ -228,15 +302,120 @@ class AsyncFedServerActor(ServerManager):
                         base_version, msg.sender_id)
             return
         delta = msg.get(Message.ARG_MODEL_PARAMS)
-        num_samples = float(msg.get(Message.ARG_NUM_SAMPLES))
+        raw_samples = msg.get(Message.ARG_NUM_SAMPLES)
+        if self.admission is not None:
+            pair = (msg.sender_id, base_version)
+            seen = self._rejected_crcs.get(pair)
+            crc = _payload_crc(delta) if seen is not None else None
+            if seen is not None and crc in seen:
+                # duplicate delivery of an already-rejected FRAME: one
+                # offense must yield exactly one strike / counter tick
+                # (the first copy's handling already re-tasked or
+                # benched the silo)
+                log.info("ignoring duplicate rejected version-%d upload "
+                         "from silo %d", base_version, msg.sender_id)
+                return
+            # screen BEFORE buffering: a poisoned delta must never sit in
+            # the buffer waiting to be applied
+            verdict = self.admission.admit(msg.sender_id, delta,
+                                           raw_samples, None, self.version)
+            if not verdict.ok:
+                log.warning("rejecting version-%d upload from silo %d "
+                            "(reason=%s)", base_version, msg.sender_id,
+                            verdict.reason)
+                if crc is None:
+                    crc = _payload_crc(delta)
+                self._rejected_crcs.setdefault(pair, set()).add(crc)
+                if self.admission.trust.state(
+                        msg.sender_id, self.version) == "quarantined":
+                    self._bench(msg.sender_id)
+                else:
+                    # an honest silo behind a corrupting wire stays in
+                    # rotation — only quarantine takes it out
+                    self._task(msg.sender_id, self._next_client())
+                return
+            num_samples = verdict.num_samples
+        else:
+            # minimal validation even undefended: float(None) used to
+            # raise TypeError and kill the handler thread, and negative/
+            # NaN counts corrupted every later mixing ratio
+            try:
+                num_samples = float(raw_samples)
+            except (TypeError, ValueError):
+                num_samples = float("nan")
+            if not math.isfinite(num_samples) or num_samples <= 0:
+                self._reject_malformed(
+                    msg, base_version,
+                    f"invalid num_samples {raw_samples!r} "
+                    f"(version {base_version})")
+                return
         staleness = self.version - base_version
         discount = float(1.0 + staleness) ** (-self.alpha)
         self.staleness_seen.append(staleness)
         self._h_staleness.observe(staleness)
         self._buffer.append(
             (delta, num_samples, discount, msg.sender_id, base_version))
-        if len(self._buffer) >= self.goal:
+        if len(self._buffer) >= self._effective_goal():
             self._apply_buffer()
+
+    def _bench(self, silo: int) -> None:
+        """Take a quarantined silo out of the rotation; flush a buffer
+        the shrunk goal now satisfies; finish cleanly if NOBODY is left
+        (quarantine expiry is version-based, so a frozen version counter
+        could never release anyone — hanging would be forever; this is
+        the defended analog of straggler_policy 'abort')."""
+        self._benched.add(silo)
+        if len(self._benched) >= self.n_silos:
+            log.error("every silo is quarantined; no safe progress is "
+                      "possible — finishing at version %d", self.version)
+            for s in range(1, self.n_silos + 1):
+                self.send(MsgType.S2C_FINISH, s)
+            self.finish()
+            return
+        if self._buffer and len(self._buffer) >= self._effective_goal():
+            self._apply_buffer()
+
+    def _reject_malformed(self, msg: Message, base_version: int,
+                          detail: str) -> None:
+        """Shared reject path for structurally-malformed frames (bad
+        round tag, bad sample count without admission): warn, strike
+        (when the admission pipeline is armed — malformed spam must be
+        countable and quarantinable like any other offense), then
+        re-task the silo ONCE per unique offending frame — with the
+        watchdog off nothing else would ever re-assign it, and the
+        active pool would silently shrink below the goal; the crc
+        dedupe keeps transport-duplicated copies from multiplying
+        assignments."""
+        pair = (msg.sender_id, base_version)
+        crc = _payload_crc(msg.get(Message.ARG_MODEL_PARAMS))
+        seen = self._rejected_crcs.setdefault(pair, set())
+        if crc in seen:
+            log.info("ignoring duplicate malformed upload from silo %d",
+                     msg.sender_id)
+            return
+        seen.add(crc)
+        log.warning("rejecting upload from silo %d: %s", msg.sender_id,
+                    detail)
+        if self.admission is not None:
+            # malformed metadata is structural damage: count + strike
+            self.admission.reject(msg.sender_id, self.version,
+                                  "fingerprint")
+            if self.admission.trust.state(
+                    msg.sender_id, self.version) == "quarantined":
+                self._bench(msg.sender_id)
+                return
+        if msg.sender_id in self._benched:
+            return  # owned by the probation release — never double-task
+        self._task(msg.sender_id, self._next_client())
+
+    def _effective_goal(self) -> int:
+        """The aggregation goal, shrunk by quarantined silos exactly like
+        the sync path's quorum: benched silos can contribute nothing, and
+        a goal above the active-silo count would freeze versions forever
+        (quarantine expiry is version-based, so a frozen federation could
+        never release anyone)."""
+        active = self.n_silos - len(self._benched)
+        return max(1, min(self.goal, active))
 
     def _apply_buffer(self) -> None:
         now = time.monotonic()
@@ -248,26 +427,69 @@ class AsyncFedServerActor(ServerManager):
                              np.float64)
         discounts = np.asarray([c for _, _, c, _, _ in self._buffer],
                                np.float64)
-        # Sample ratios sum to 1; the staleness discount multiplies each
-        # term afterwards so stale buffers shrink the applied step itself.
-        coeffs = discounts * samples / max(samples.sum(), 1e-12)
         # traced as a child of whichever upload's handling tripped the
         # goal, so the async trace shows which silo closed each version
         with self._span("aggregate", version=self.version,
                         buffered=len(deltas)):
-            mean = jax.tree.map(
-                lambda *leaves: sum(c * np.asarray(l, np.float64)
-                                    for c, l in zip(coeffs, leaves)),
-                *deltas)
-            self.params = jax.tree.map(
-                lambda p, d: (np.asarray(p, np.float64)
-                              + self.server_lr * d).astype(
-                                  np.asarray(p).dtype),
-                self.params, mean)
+            if self.defended_aggregate is not None:
+                # staleness-aware defended variant: the Byzantine rule
+                # sees the raw sample weights (staleness claims cannot
+                # steer the selection), and the buffer's sample-weighted
+                # MEAN discount scales the applied step afterwards —
+                # zero staleness reduces to the plain defended mean.
+                # The stack is padded to the FULL ``goal`` width with
+                # weight-0 zero slots (every rule is padding-invariant),
+                # so a quarantine-shrunk buffer keeps the static shape
+                # and the jit still compiles exactly once.
+                if self._delta_zeros is None:
+                    self._delta_zeros = jax.tree.map(
+                        lambda v: np.zeros_like(np.asarray(v)), deltas[0])
+                pad = [self._delta_zeros] * (self.goal - len(deltas))
+                stacked = jax.tree.map(
+                    lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                    *(deltas + pad))
+                w = np.concatenate(
+                    [samples, np.zeros(len(pad))]).astype(np.float32)
+                if self._stacked_zeros is None:
+                    self._stacked_zeros = jax.tree.map(
+                        lambda x: np.zeros(x.shape[1:], x.dtype), stacked)
+                robust = self.defended_aggregate(
+                    self._stacked_zeros, stacked, w, self.version)
+                davg = float((discounts * samples).sum()
+                             / max(samples.sum(), 1e-12))
+                self.params = jax.tree.map(
+                    lambda p, d: (np.asarray(p, np.float64)
+                                  + self.server_lr * davg
+                                  * np.asarray(d, np.float64)).astype(
+                                      np.asarray(p).dtype),
+                    self.params, robust)
+            else:
+                # sample ratios sum to 1; the staleness discount
+                # multiplies each term so stale buffers shrink the
+                # applied step itself
+                coeffs = discounts * samples / max(samples.sum(), 1e-12)
+                mean = jax.tree.map(
+                    lambda *leaves: sum(c * np.asarray(l, np.float64)
+                                        for c, l in zip(coeffs, leaves)),
+                    *deltas)
+                self.params = jax.tree.map(
+                    lambda p, d: (np.asarray(p, np.float64)
+                                  + self.server_lr * d).astype(
+                                      np.asarray(p).dtype),
+                    self.params, mean)
         silos = [s for _, _, _, s, _ in self._buffer]
         self._consumed.update((s, b) for _, _, _, s, b in self._buffer)
         self._buffer.clear()
         self.version += 1
+        if self._rejected_crcs:
+            # prune the dedupe ledger: a duplicate of a frame 64+
+            # versions stale is indistinguishable from a fresh offense
+            # at that point, and the ledger must not grow for the life
+            # of a long federation
+            horizon = self.version - 64
+            self._rejected_crcs = {p: c for p, c in
+                                   self._rejected_crcs.items()
+                                   if p[1] >= horizon}
         if self.checkpointer is not None:
             self.checkpointer.maybe_save(
                 self.version - 1, self._checkpoint_state(),
@@ -281,6 +503,23 @@ class AsyncFedServerActor(ServerManager):
             return
         for silo in silos:  # only the consumed silos need new work
             self._task(silo, self._next_client())
+        if self.admission is not None:
+            # sweep trust states once per version: transitions expired
+            # quarantines to probation and refreshes the
+            # fedml_robust_quarantined_total gauge (the sync path's
+            # per-broadcast sweep, mirrored here)
+            self.admission.trust.quarantined(
+                self.version, range(1, self.n_silos + 1))
+            # probation release: silos whose quarantine expired at this
+            # version re-enter the rotation against the current global
+            for silo in sorted(self._benched):
+                if self.admission.trust.state(
+                        silo, self.version) != "quarantined":
+                    self._benched.discard(silo)
+                    log.info("silo %d released from quarantine at version "
+                             "%d; re-tasking on probation", silo,
+                             self.version)
+                    self._task(silo, self._next_client())
 
     def finish(self) -> None:
         self._finished = True

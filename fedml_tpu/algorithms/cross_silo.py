@@ -153,7 +153,9 @@ class FedAvgServerActor(ServerManager):
                  failure_detector: Optional[FailureDetector] = None,
                  checkpointer=None,
                  publish: Optional[Callable] = None,
-                 extra_state: Optional[tuple] = None):
+                 extra_state: Optional[tuple] = None,
+                 admission=None,
+                 aggregate_fn: Optional[Callable] = None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -196,6 +198,25 @@ class FedAvgServerActor(ServerManager):
         are cross-round state the (params, round, rng) tuple silently
         dropped — a resumed --error_feedback run used to diverge from an
         uninterrupted one (tests/test_recovery.py pins bit-identity).
+
+        ``admission``: a `fedml_tpu.robust.AdmissionPipeline`; when set,
+        every upload is screened (fingerprint / finite / sample-count /
+        norm-outlier) before it may aggregate.  A REJECTED upload still
+        satisfies the round barrier (the silo reported; its payload is
+        inadmissible) but carries weight 0, and its strike feeds the
+        pipeline's `TrustTracker` — silos QUARANTINED there are excluded
+        from the broadcast and the quorum exactly like
+        FailureDetector-dead ones, and re-enter on probation when the
+        quarantine expires.
+
+        ``aggregate_fn``: a `fedml_tpu.robust.make_defended_aggregate`
+        product ``fn(global_params, stacked, weights, round_idx)``.
+        When set, the round's admitted uploads are stacked into the
+        STATIC ``[cohort, ...]`` shape (missing/rejected slots hold the
+        current global with weight 0) and the whole clip + Byzantine
+        rule + noise + mean step runs as that one jit — no recompiles
+        after round 1.  When None, the legacy exact
+        ``tree_weighted_mean`` over the received list is used.
         """
         super().__init__(0, transport)
         if straggler_policy not in ("wait", "drop", "abort"):
@@ -218,6 +239,8 @@ class FedAvgServerActor(ServerManager):
         self.checkpointer = checkpointer
         self.publish = publish
         self.extra_state = extra_state
+        self.admission = admission
+        self.aggregate_fn = aggregate_fn
         self.dropped_silos: Dict[int, list] = {}  # round -> missing silo ids
         self._received: Dict[int, tuple] = {}
         self._num_silos = 0  # silos contacted this round (= sampled cohort)
@@ -275,9 +298,13 @@ class FedAvgServerActor(ServerManager):
                 self.params = state["params"]
                 self.round_idx = int(np.asarray(state["round_idx"])) + 1
                 mask = np.asarray(state["accepted_mask"])
+                # possibly-empty ARRAY, mirroring _complete_round: a
+                # crash right after an all-rejected round must resume
+                # broadcasting an EMPTY ack, not None — EF residual
+                # settlement reads None as "assume accepted" and would
+                # drop the rejected uploads' deltas from the carry
                 self._last_accepted = (
-                    (np.flatnonzero(mask) + 1).astype(np.int32)
-                    if mask.any() else None)
+                    np.flatnonzero(mask) + 1).astype(np.int32)
                 if self.extra_state is not None and "extra" in state:
                     self.extra_state[1](state["extra"])
                 if self.publish is not None:
@@ -335,18 +362,26 @@ class FedAvgServerActor(ServerManager):
             for silo in cohort:
                 self.failure_detector.register(silo)
             dead = self.failure_detector.dead_silos() & cohort
-            if dead == cohort:
-                # every silo dead: fall back to expecting the full cohort
-                # (the classic timeout path), so a rejoin can still revive
-                # the federation instead of the barrier closing on nothing
-                dead = set()
+        # quarantined silos (TrustTracker strikes) are excluded exactly
+        # like dead ones: weight 0, never waited on.  The sweep also
+        # transitions expired quarantines to probation — a probation
+        # silo is tasked again from THIS broadcast.
+        if self.admission is not None:
+            dead = dead | self.admission.trust.quarantined(
+                self.round_idx, cohort)
+        if dead == cohort:
+            # every silo dead/quarantined: fall back to expecting the
+            # full cohort (the classic timeout path), so a rejoin can
+            # still revive the federation instead of the barrier
+            # closing on nothing
+            dead = set()
         # silos already known dead are dropped AT BROADCAST: they are
         # logged for this round immediately and the barrier never waits
         # on them (the quorum "shrinks" instead of re-paying the timeout)
         self._expected = cohort - dead
         if dead:
-            log.info("round %d: excluding dead silos %s from the quorum",
-                     self.round_idx, sorted(dead))
+            log.info("round %d: excluding dead/quarantined silos %s from "
+                     "the quorum", self.round_idx, sorted(dead))
             self.dropped_silos.setdefault(self.round_idx, []).extend(
                 sorted(dead))
         self._round_t0 = time.monotonic()
@@ -460,35 +495,113 @@ class FedAvgServerActor(ServerManager):
             log.info("discarding round-%d upload from unexpected silo %d",
                      self.round_idx, msg.sender_id)
             return
+        if msg.sender_id in self._received:
+            # duplicate delivery of this round's report (chaos dup,
+            # transport retry): the first copy already went through
+            # decode + admission — re-admitting would double-strike the
+            # silo, double-count the telemetry, bank its norm twice, and
+            # could even overwrite an ACCEPTED entry with a rejection
+            log.info("ignoring duplicate round-%d upload from silo %d",
+                     self.round_idx, msg.sender_id)
+            return
         # barrier semantics: wait for every sampled silo
         # (check_whether_all_receive, FedAvgServerManager.py:51)
         upload = msg.get(Message.ARG_MODEL_PARAMS)
         # compression-scheme handshake: a payload with a "scheme" tag is a
         # compressed frame (comm/compress.py) — both mismatch directions
-        # would otherwise crash far from the misconfiguration
+        # would otherwise crash far from the misconfiguration.  Without
+        # the admission pipeline, mismatches keep the fail-loudly
+        # contract (a misconfigured fleet should crash at the server);
+        # WITH it, a mismatched payload is attacker-reachable structural
+        # damage and takes the reject-and-strike path instead of killing
+        # the handler thread.
         is_compressed = isinstance(upload, dict) and "scheme" in upload
+        handshake_err = None
         if self.decode_upload is None and is_compressed:
-            raise ValueError(
+            handshake_err = (
                 f"silo {msg.sender_id} sent a compressed upload "
                 f"(scheme={upload['scheme']!r}) but the server has no "
                 f"--wire_compression configured")
+        elif self.decode_upload is not None and not is_compressed:
+            handshake_err = (
+                f"server expects compressed uploads but silo "
+                f"{msg.sender_id} sent plain parameters; launch silos "
+                f"with the same --wire_compression")
+        if handshake_err is not None:
+            if self.admission is None:
+                raise ValueError(handshake_err)
+            log.warning("round %d: rejecting upload from silo %d "
+                        "(handshake mismatch: %s)", self.round_idx,
+                        msg.sender_id, handshake_err)
+            self.admission.reject(msg.sender_id, self.round_idx,
+                                  "fingerprint")
+            if self._first_upload_t is None:
+                self._first_upload_t = time.monotonic()
+            self._note_upload(msg.sender_id, None)
+            return
         if self.decode_upload is not None:
-            if not is_compressed:
-                raise ValueError(
-                    f"server expects compressed uploads but silo "
-                    f"{msg.sender_id} sent plain parameters; launch silos "
-                    f"with the same --wire_compression")
-            upload = self.decode_upload(upload, self.params)
+            try:
+                upload = self.decode_upload(upload, self.params)
+            except Exception:  # noqa: BLE001 — damaged compressed frame
+                if self.admission is None:
+                    raise  # legacy fail-loudly contract
+                # a frame corrupted in flight (chaos 'corrupt', bad wire)
+                # can make the codec itself throw; with the admission
+                # pipeline on, that is structural damage, not a server
+                # crash — leave the raw payload in place and let the
+                # fingerprint check below reject + strike it
+                log.warning("round %d: undecodable upload from silo %d; "
+                            "routing to admission as structural damage",
+                            self.round_idx, msg.sender_id)
         if self._first_upload_t is None:
             self._first_upload_t = time.monotonic()
-        self._received[msg.sender_id] = (
-            upload, msg.get(Message.ARG_NUM_SAMPLES))
+        entry = (upload, msg.get(Message.ARG_NUM_SAMPLES))
+        if self.admission is not None:
+            verdict = self.admission.admit(
+                msg.sender_id, upload, msg.get(Message.ARG_NUM_SAMPLES),
+                self.params, self.round_idx)
+            if verdict.ok:
+                entry = (upload, verdict.num_samples)
+            else:
+                # the silo DID report — the barrier closes over it — but
+                # its payload is inadmissible: weight 0, never aggregated
+                log.warning("round %d: rejecting upload from silo %d "
+                            "(reason=%s)", self.round_idx, msg.sender_id,
+                            verdict.reason)
+                entry = None
+        self._note_upload(msg.sender_id, entry)
+
+    def _note_upload(self, silo: int, entry: Optional[tuple]) -> None:
+        """Record a silo's report (``None`` = reported-but-inadmissible)
+        and close the round when the barrier is satisfied
+        (check_whether_all_receive, FedAvgServerManager.py:51)."""
+        self._received[silo] = entry
         if self._expected:
             if not self._expected <= set(self._received):
                 return
         elif len(self._received) < self._num_silos:
             return
         self._complete_round()
+
+    def _stack_cohort(self, admitted: Dict[int, tuple]):
+        """Stack admitted uploads into the STATIC ``[cohort, ...]`` tree
+        the defended aggregate jits against: slot ``i-1`` belongs to silo
+        ``i``; silos that were dropped, quarantined, or rejected hold a
+        copy of the current global with weight 0 (a zero diff that every
+        defense masks out) — the shape never depends on who showed up,
+        so the jit compiles once at round 1 and never again."""
+        n = self._num_silos
+        host_global = jax.tree.map(np.asarray, self.params)
+        trees, w = [], np.zeros(n, np.float32)
+        for silo in range(1, n + 1):
+            if silo in admitted:
+                trees.append(admitted[silo][0])
+                w[silo - 1] = admitted[silo][1]
+            else:
+                trees.append(host_global)
+        stacked = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees)
+        return stacked, w
 
     def _complete_round(self) -> None:
         self._cancel_timer()
@@ -503,14 +616,28 @@ class FedAvgServerActor(ServerManager):
         if self.round_idx in self.dropped_silos:  # normalize the drop log
             self.dropped_silos[self.round_idx] = sorted(
                 set(self.dropped_silos[self.round_idx]))
-        trees = [self._received[s][0] for s in sorted(self._received)]
-        weights = np.array([self._received[s][1] for s in sorted(self._received)],
+        # admission-rejected reports ride as None entries: they satisfied
+        # the barrier but must not aggregate (and must not be EF-acked)
+        admitted = {s: v for s, v in self._received.items() if v is not None}
+        trees = [admitted[s][0] for s in sorted(admitted)]
+        weights = np.array([admitted[s][1] for s in sorted(admitted)],
                            dtype=np.float32)
-        self._last_accepted = np.asarray(sorted(self._received), np.int32)
+        # possibly EMPTY (all uploads rejected) — never None here: None
+        # means "no ack info" and EF residual settlement would wrongly
+        # assume the rejected uploads were aggregated
+        self._last_accepted = np.asarray(sorted(admitted), np.int32)
         self._received.clear()
         with self._span("aggregate", parent=self._round_span,
                         round=self.round_idx, quorum=len(trees)):
-            self.params = tree_weighted_mean(trees, weights)
+            if not trees:
+                log.warning("round %d: no admissible uploads; the global "
+                            "model is unchanged this round", self.round_idx)
+            elif self.aggregate_fn is not None:
+                stacked, w = self._stack_cohort(admitted)
+                self.params = self.aggregate_fn(self.params, stacked, w,
+                                                self.round_idx)
+            else:
+                self.params = tree_weighted_mean(trees, weights)
         if self._round_span is not None:
             self._round_span.end()
             self._round_span = None

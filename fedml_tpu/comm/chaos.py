@@ -19,11 +19,21 @@ according to a seeded `ChaosPlan`:
   cannot be held forever);
 * **partition** — all matching traffic on a link is dropped, either for
   a wall-clock window (``window_s``, the "mid-round partition" case) or
-  from a round tag onward (``after_round``, a silo death).
+  from a round tag onward (``after_round``, a silo death);
+* **corrupt** — the ``ARG_MODEL_PARAMS`` payload is damaged in flight:
+  one array leaf gets either a NaN injected or a byte bit-flipped
+  (seeded choice of leaf/mode/position).  The frame still delivers —
+  this is the fault the robust admission pipeline
+  (fedml_tpu/robust/admission.py) must catch, not the transport layer.
+  The original message is never mutated (copy-on-corrupt), so a hub
+  sharing references with sender state stays safe.
 
 Determinism: every fault decision comes from a per-link RNG derived
 from ``(plan.seed, src, dst)``, drawn under a lock — one fixed-size
-draw per message, in send order.  A single-threaded sender (the pump
+draw per message, in send order (links with ``corrupt_prob > 0`` draw a
+larger fixed size; either way the per-link size is constant, so
+schedules replay and corruption-free plans keep their historical
+streams).  A single-threaded sender (the pump
 hub, or one event loop per node) therefore replays identical fault
 choices for a seed; when several threads send on ONE link (event loop +
 heartbeat), the draws stay race-free but their assignment to messages
@@ -41,6 +51,8 @@ import dataclasses
 import threading
 import time
 from typing import Dict, Optional, Tuple
+
+import numpy as np
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
@@ -80,13 +92,14 @@ class LinkChaos:
     max_delay_s: float = 0.0
     dup_prob: float = 0.0
     reorder_prob: float = 0.0
+    corrupt_prob: float = 0.0
     partition: Optional[Partition] = None
 
     @property
     def quiet(self) -> bool:
         return (self.drop_prob == 0 and self.delay_prob == 0
                 and self.dup_prob == 0 and self.reorder_prob == 0
-                and self.partition is None)
+                and self.corrupt_prob == 0 and self.partition is None)
 
 
 class ChaosPlan:
@@ -118,6 +131,66 @@ class ChaosPlan:
         return np.random.RandomState(mix)
 
 
+def _array_leaves(tree, out):
+    """Collect non-empty array leaves of the dict/list/tuple nests the
+    wire codec carries (depth-first, key-sorted — a stable enumeration
+    so the seeded leaf choice is reproducible)."""
+    if hasattr(tree, "items"):
+        for _, v in sorted(tree.items()):
+            _array_leaves(v, out)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            _array_leaves(v, out)
+    elif hasattr(tree, "dtype") and hasattr(tree, "shape"):
+        if np.asarray(tree).size > 0:
+            out.append(tree)
+    return out
+
+
+def _corrupt_payload(msg: Message, u_leaf: float, u_mode: float,
+                     u_pos: float) -> Optional[Message]:
+    """A copy of ``msg`` with one ARG_MODEL_PARAMS leaf damaged: NaN
+    injection into a float leaf (``u_mode < 0.5``) or a raw byte
+    bit-flip.  Returns None when the message carries no array payload.
+    The original message (and its arrays, possibly shared with sender
+    state on an in-process hub) is never touched."""
+    tree = msg.get(Message.ARG_MODEL_PARAMS)
+    if tree is None:
+        return None
+    leaves = _array_leaves(tree, [])
+    if not leaves:
+        return None
+    target = min(int(u_leaf * len(leaves)), len(leaves) - 1)
+    idx = [0]
+
+    def _rebuild(t):
+        if hasattr(t, "items"):
+            return {k: _rebuild(v) for k, v in sorted(t.items())}
+        if isinstance(t, (list, tuple)):
+            out = [_rebuild(v) for v in t]
+            return tuple(out) if isinstance(t, tuple) else out
+        if hasattr(t, "dtype") and hasattr(t, "shape") \
+                and np.asarray(t).size > 0:
+            i, idx[0] = idx[0], idx[0] + 1
+            if i != target:
+                return t
+            arr = np.array(t, copy=True)
+            if u_mode < 0.5 and np.issubdtype(arr.dtype, np.floating):
+                arr.flat[min(int(u_pos * arr.size), arr.size - 1)] = np.nan
+            else:
+                raw = bytearray(arr.tobytes())
+                raw[min(int(u_pos * len(raw)), len(raw) - 1)] ^= 0xFF
+                arr = np.frombuffer(bytes(raw), dtype=arr.dtype) \
+                    .reshape(arr.shape).copy()
+            return arr
+        return t
+
+    out = Message(msg.type, msg.sender_id, msg.receiver_id)
+    out.params = dict(msg.params)
+    out.params[Message.ARG_MODEL_PARAMS] = _rebuild(tree)
+    return out
+
+
 class ChaosTransport(Transport):
     """Wrap ``inner``; apply the plan's faults to outgoing messages.
 
@@ -138,7 +211,8 @@ class ChaosTransport(Transport):
         self._stopped = False
         # fault kind -> count, for assertions ("chaos actually happened")
         self.faults: Dict[str, int] = {
-            "drop": 0, "delay": 0, "dup": 0, "reorder": 0, "partition": 0}
+            "drop": 0, "delay": 0, "dup": 0, "reorder": 0, "partition": 0,
+            "corrupt": 0}
         # telemetry mirror, one labeled counter per kind (null no-ops when
         # telemetry is disabled); handles are pre-built so the fault path
         # never allocates
@@ -199,13 +273,22 @@ class ChaosTransport(Transport):
         # deterministic even when probabilities differ between links; the
         # draw happens under the lock because two sender threads (event
         # loop + heartbeat) can share a link and RandomState is not
-        # thread-safe
+        # thread-safe.  Links with corruption enabled draw 4 extra
+        # uniforms (gate, leaf, mode, position) — still per-link
+        # fixed-size, and corruption-free links keep the historical
+        # 5-draw stream.
         with self._lock:
-            u_drop, u_delay, u_dup, u_reorder, u_t = \
-                self._rng(src, dst).uniform(size=5)
+            u = self._rng(src, dst).uniform(
+                size=9 if link.corrupt_prob > 0 else 5)
+        u_drop, u_delay, u_dup, u_reorder, u_t = u[:5]
         if u_drop < link.drop_prob:
             self._fault("drop")
             return
+        if link.corrupt_prob > 0 and u[5] < link.corrupt_prob:
+            corrupted = _corrupt_payload(msg, u[6], u[7], u[8])
+            if corrupted is not None:  # no array payload: nothing to damage
+                msg = corrupted
+                self._fault("corrupt")
         with self._lock:
             held = self._held.pop((src, dst), None)
         if u_reorder < link.reorder_prob:

@@ -140,6 +140,11 @@ def geometric_median(stacked: Pytree, weights: jax.Array,
     mean.  The iterations run entirely in the flat [N, D] distance space
     (z_flat is one matvec); only the FINAL weights touch the pytree."""
     w = jnp.asarray(weights, jnp.float32)
+    # all-weights-zero cohort guard: the Weiszfeld loop would divide by a
+    # zero weight sum (0/0 NaNs through tree_weighted_mean).  Fall back to
+    # uniform weights — the unweighted geometric median over all slots —
+    # which is finite and deterministic; a live cohort is untouched.
+    w = jnp.where(jnp.sum(w) > 0, w, jnp.ones_like(w))
     flat = _flatten_clients(stacked)
 
     def body(_, beta):

@@ -163,6 +163,40 @@ class ExperimentConfig:
     #                                   the next round's delta (EF-SGD style;
     #                                   silo-local state, so gRPC silos must
     #                                   be persistent processes — they are)
+    # ---- payload defense (fedml_tpu/robust: admission + defended agg) --
+    robust_agg: str = "mean"          # cross_silo/async_fl LIVE aggregation
+    #                                   rule: mean | coordinate_median |
+    #                                   trimmed_mean | krum | multi_krum |
+    #                                   geometric_median (rule knobs ride
+    #                                   --trim_frac/--byz_f/--krum_m/
+    #                                   --gm_iters/--gm_eps)
+    norm_clip: float = 0.0            # >0: clip each upload's update norm
+    #                                   (reference RobustAggregator parity)
+    agg_noise_std: float = 0.0        # >0: weak-DP noise on the defended
+    #                                   aggregate (reference parity)
+    admission: str = "auto"           # upload admission screen: auto (on
+    #                                   whenever any defense flag is set,
+    #                                   or under --chaos_corrupt — an
+    #                                   unscreened corrupted frame can
+    #                                   crash the decoder) | on | off
+    max_num_samples: float = 1e6      # admission: cap on the self-reported
+    #                                   sample count (0 = uncapped)
+    norm_screen_k: float = 6.0        # admission: reject norms beyond
+    #                                   median + k * MAD of recent accepts
+    norm_screen_window: int = 64      # admission: rolling norm history
+    norm_screen_min_history: int = 8  # admission: norms banked before the
+    #                                   outlier screen arms
+    strikes_to_quarantine: int = 3    # TrustTracker: strikes before
+    #                                   quarantine
+    quarantine_rounds: int = 4        # TrustTracker: rounds served before
+    #                                   probation
+    probation_rounds: int = 2         # TrustTracker: clean rounds to
+    #                                   restore full trust
+    adversary: str = ""               # seeded per-silo attacks over the
+    #                                   real message path, e.g.
+    #                                   "2:scale:20,3:sign_flip" (kinds:
+    #                                   sign_flip scale gauss nan_bomb
+    #                                   inflate backdoor)
     async_goal: int = 0               # async_fl: aggregate every K uploads
     #                                   (0 = n_silos // 2, FedBuff style)
     staleness_exponent: float = 0.5   # async_fl: (1+s)^-alpha discount
@@ -201,6 +235,10 @@ class ExperimentConfig:
     chaos_max_delay_s: float = 0.05      # delay bound (also reorder flush)
     chaos_dup: float = 0.0               # duplicate prob
     chaos_reorder: float = 0.0           # reorder (hold-back) prob
+    chaos_corrupt: float = 0.0           # payload corruption prob (seeded
+    #                                      bit-flip/NaN into model_params —
+    #                                      the admission screen's sparring
+    #                                      partner)
     chaos_seed: int = 0                  # fault-schedule seed
 
     # ---- checkpoint / resume (orbax round-level, SURVEY §5.4) ----------

@@ -516,10 +516,16 @@ def _silo_training_setup(cfg, data, wl):
                 _chain["next_round"] += 1
             return _chain["last"]
 
-    def make_train_fn(silo_id):
+    def make_train_fn(silo_id, shard_transform=None):
+        # shard_transform(shard, client_idx, round_idx) -> shard: the
+        # adversary harness's data-poisoning seam (robust/adversary.py
+        # backdoor) — the silo genuinely trains on the transformed shard
         def train_fn(params, client_idx, round_idx):
-            shard = {k: jnp.asarray(data.train[k][client_idx])
+            shard = {k: data.train[k][client_idx]
                      for k in ("x", "y", "mask")}
+            if shard_transform is not None:
+                shard = shard_transform(shard, client_idx, round_idx)
+            shard = {k: jnp.asarray(v) for k, v in shard.items()}
             rng = jax.random.fold_in(_round_rng(round_idx), silo_id - 1)
             new, _ = local(params, shard, rng)
             return new, float(data.train["num_samples"][client_idx])
@@ -529,6 +535,80 @@ def _silo_training_setup(cfg, data, wl):
                           {k: data.train[k] for k in ("x", "y", "mask")})
     _, init_rng = jax.random.split(jax.random.key(cfg.seed))
     return wl.init(init_rng, sample), make_train_fn
+
+
+def _robust_setup(cfg: ExperimentConfig, template, kind: str):
+    """Payload-defense wiring shared by the sync and async actor modes
+    (fedml_tpu/robust): the admission pipeline (``--admission`` — 'auto'
+    arms it whenever any defense flag is set) and the jit-once defended
+    aggregate (``--robust_agg/--norm_clip/--agg_noise_std``).  Returns
+    ``(admission, defended_aggregate)``, either possibly None."""
+    if cfg.admission not in ("auto", "on", "off"):
+        raise ValueError(f"--admission must be auto|on|off, "
+                         f"got {cfg.admission!r}")
+    robust_on = (cfg.robust_agg != "mean" or cfg.norm_clip > 0
+                 or cfg.agg_noise_std > 0)
+    # 'auto' also arms the screen under payload corruption: a corrupted
+    # compressed frame can make the DECODER itself throw, and without
+    # admission that exception kills the server event loop mid-run
+    # (adversary flags alone do NOT arm it — the undefended-under-attack
+    # baseline must stay runnable)
+    screen_on = robust_on or cfg.chaos_corrupt > 0
+    admission = defended = None
+    if cfg.admission == "on" or (cfg.admission == "auto" and screen_on):
+        from fedml_tpu.robust import AdmissionPipeline, TrustTracker
+        admission = AdmissionPipeline(
+            template, kind=kind, max_num_samples=cfg.max_num_samples,
+            norm_k=cfg.norm_screen_k, norm_window=cfg.norm_screen_window,
+            norm_min_history=cfg.norm_screen_min_history,
+            trust=TrustTracker(
+                strikes_to_quarantine=cfg.strikes_to_quarantine,
+                quarantine_rounds=cfg.quarantine_rounds,
+                probation_rounds=cfg.probation_rounds))
+    if robust_on:
+        from fedml_tpu.robust import make_defended_aggregate
+        defended = make_defended_aggregate(
+            cfg.robust_agg, trim_frac=cfg.trim_frac, byz_f=cfg.byz_f,
+            krum_m=cfg.krum_m, gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps,
+            norm_clip=cfg.norm_clip, noise_std=cfg.agg_noise_std,
+            seed=cfg.seed)
+    return admission, defended
+
+
+def _adversary_train_fns(cfg: ExperimentConfig, data, make_train_fn,
+                         n_silos: int):
+    """Wrap the silo train-fn factory with the ``--adversary`` spec
+    (fedml_tpu/robust/adversary.py): listed silos run their seeded attack
+    over the real message path; everyone else is untouched."""
+    if not cfg.adversary:
+        return make_train_fn
+    from fedml_tpu.robust import (make_backdoor_shard_transform,
+                                  make_malicious_train_fn,
+                                  parse_adversary_spec)
+    adversaries = parse_adversary_spec(cfg.adversary)
+    bad = sorted(s for s in adversaries if s > n_silos)
+    if bad:
+        raise ValueError(f"--adversary names silos {bad} but the "
+                         f"deployment has only {n_silos} silos (ids 1.."
+                         f"{n_silos})")
+
+    def wrapped(silo_id):
+        atk = adversaries.get(silo_id)
+        if atk is None:
+            return make_train_fn(silo_id)
+        transform = None
+        if atk.kind == "backdoor":
+            _image_sample_shape(cfg, data,
+                                f"--adversary backdoor (silo {silo_id})")
+            target = int(atk.param) if atk.param >= 0 else cfg.target_label
+            transform = make_backdoor_shard_transform(
+                target, trigger_size=cfg.trigger_size,
+                poison_frac=cfg.poison_frac, seed=cfg.seed)
+        return make_malicious_train_fn(atk, make_train_fn(silo_id,
+                                                          transform),
+                                       silo_id, seed=cfg.seed)
+
+    return wrapped
 
 
 @runner("async_fl")
@@ -562,6 +642,11 @@ def run_async_fl(cfg, data, mesh, sink):
     init, make_train_fn = _silo_training_setup(cfg, data, wl)
     n_silos = min(cfg.client_num_per_round, data.client_num)
     goal = cfg.async_goal or max(1, n_silos // 2)
+    make_train_fn = _adversary_train_fns(cfg, data, make_train_fn, n_silos)
+    # async uploads are deltas — the admission screen fingerprints them
+    # against the params template (same treedef/shapes/dtypes) and
+    # screens the raw delta norm
+    admission, defended = _robust_setup(cfg, init, kind="delta")
 
     history = []
 
@@ -580,7 +665,8 @@ def run_async_fl(cfg, data, mesh, sink):
         staleness_exponent=cfg.staleness_exponent,
         server_lr=cfg.async_server_lr, on_version=on_version,
         seed=cfg.seed, checkpointer=_make_checkpointer(cfg),
-        retask_timeout_s=cfg.retask_timeout_s or None)
+        retask_timeout_s=cfg.retask_timeout_s or None,
+        admission=admission, defended_aggregate=defended)
     server.register_handlers()
     silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
                                encode_upload=delta_encoder)
@@ -623,6 +709,8 @@ def run_cross_silo(cfg, data, mesh, sink):
     init, make_train_fn = _silo_training_setup(cfg, data, wl)
     n_silos = min(cfg.client_num_per_round, data.client_num)
     timeout = cfg.round_timeout_s or None
+    make_train_fn = _adversary_train_fns(cfg, data, make_train_fn, n_silos)
+    admission, defended = _robust_setup(cfg, init, kind="params")
 
     # optional lossy upload compression (comm/compress.py): silos send the
     # compressed DELTA to the global model; the server reconstructs.  The
@@ -778,12 +866,13 @@ def run_cross_silo(cfg, data, mesh, sink):
             round_timeout_s=timeout, min_silo_frac=cfg.min_silo_frac,
             decode_upload=decode, failure_detector=detector,
             checkpointer=_make_checkpointer(cfg),
-            publish=publish, extra_state=ef_extra)
+            publish=publish, extra_state=ef_extra,
+            admission=admission, aggregate_fn=defended)
         s.register_handlers()
         return s
 
     chaos_on = any((cfg.chaos_drop, cfg.chaos_delay, cfg.chaos_dup,
-                    cfg.chaos_reorder))
+                    cfg.chaos_reorder, cfg.chaos_corrupt))
     if chaos_on and cfg.silo_backend != "local":
         raise ValueError("--chaos_* injection wraps the local hub only; "
                          "for real wires compose ChaosTransport in code")
@@ -810,7 +899,8 @@ def run_cross_silo(cfg, data, mesh, sink):
                                       delay_prob=cfg.chaos_delay,
                                       max_delay_s=cfg.chaos_max_delay_s,
                                       dup_prob=cfg.chaos_dup,
-                                      reorder_prob=cfg.chaos_reorder),
+                                      reorder_prob=cfg.chaos_reorder,
+                                      corrupt_prob=cfg.chaos_corrupt),
                     # FINISH: shutdown liveness.  ROUND_TIMEOUT: the
                     # straggler timer's SELF-message rides the server's own
                     # chaotic transport on link (0,0) — dropping it disarms
@@ -1189,11 +1279,25 @@ def main(argv=None) -> Dict[str, Any]:
         raise ValueError("--wire_compression only applies to "
                          "--algo cross_silo (the host-edge wire)")
     if any((cfg.chaos_drop, cfg.chaos_delay, cfg.chaos_dup,
-            cfg.chaos_reorder)) and cfg.algo != "cross_silo":
+            cfg.chaos_reorder, cfg.chaos_corrupt)) \
+            and cfg.algo != "cross_silo":
         raise ValueError(
             f"--chaos_* injection is wired into --algo cross_silo only; "
             f"--algo {cfg.algo} would silently run a CLEAN network and "
             f"label the results as chaos results")
+    # the live-path payload defense + adversary harness (fedml_tpu/robust)
+    # rides the distributed actor modes only; on the cohort-simulation
+    # algorithms the flags would silently do nothing and label plain runs
+    # as defended/attacked ones
+    if cfg.algo not in ("cross_silo", "async_fl") and (
+            cfg.robust_agg != "mean" or cfg.norm_clip or cfg.agg_noise_std
+            or cfg.adversary or cfg.admission == "on"):
+        raise ValueError(
+            f"--robust_agg/--norm_clip/--agg_noise_std/--adversary/"
+            f"--admission on are the live distributed defense "
+            f"(fedml_tpu/robust) and apply to --algo cross_silo/async_fl "
+            f"only; got --algo {cfg.algo}.  For the single-chip cohort "
+            f"simulation use --algo fedavg_robust --defense ... instead.")
     if cfg.error_feedback and cfg.wire_compression == "none":
         raise ValueError("--error_feedback requires --wire_compression "
                          "topk or int8")

@@ -1,0 +1,28 @@
+"""Payload defense for the live distributed path.
+
+PR 1 hardened the *transport* (drop/delay/dup/reorder + retry + crash
+recovery); this package hardens the *payload*: every upload entering a
+distributed server actor passes the admission pipeline (structural
+fingerprint, finite guard, sample-count cap, robust norm-outlier
+screen) and repeated offenders are quarantined by the `TrustTracker`;
+the accepted cohort is then aggregated by one jit-compiled defended
+aggregate (norm clipping + weak-DP noise, reference parity, composed
+with the Byzantine rules of `core/byzantine.py`).  `adversary.py` is
+the attack half — seeded malicious silo behaviors riding the real
+message path, symmetric to `comm/chaos.py` on the wire.
+"""
+
+from fedml_tpu.robust.admission import (AdmissionPipeline, AdmissionVerdict,
+                                        TrustTracker, params_fingerprint)
+from fedml_tpu.robust.adversary import (ATTACK_KINDS, Attack,
+                                        make_backdoor_shard_transform,
+                                        make_malicious_train_fn,
+                                        parse_adversary_spec)
+from fedml_tpu.robust.defense import ROBUST_AGG_METHODS, make_defended_aggregate
+
+__all__ = [
+    "AdmissionPipeline", "AdmissionVerdict", "TrustTracker",
+    "params_fingerprint", "make_defended_aggregate", "ROBUST_AGG_METHODS",
+    "Attack", "ATTACK_KINDS", "parse_adversary_spec",
+    "make_malicious_train_fn", "make_backdoor_shard_transform",
+]

@@ -1,0 +1,374 @@
+"""Update admission pipeline: screen every upload before it may aggregate.
+
+The distributed servers used to weighted-average whatever bytes arrived
+(`FedAvgServerActor._complete_round`) with the weight taken verbatim
+from the client-reported ``num_samples`` — one NaN leaf or one silo
+claiming ``num_samples=1e9`` poisoned every future round.  This module
+is the bouncer at the door.  An upload must pass, in order:
+
+1. **fingerprint** — treedef/shape/dtype must match the global params
+   exactly (a wrong-model, truncated, or type-confused payload never
+   reaches tree math);
+2. **finite guard** — every float leaf NaN/Inf-free;
+3. **sample-count validation** — ``num_samples`` present, finite,
+   positive, and at most ``max_num_samples`` (the weight-inflation cap);
+4. **norm-outlier screen** — the update norm (``||upload - global||``
+   for parameter uploads, ``||delta||`` for async deltas) is compared
+   against rolling robust statistics — median + MAD over the most
+   recent accepted norms — and rejected beyond ``median + k * MAD``.
+
+Every rejection is counted by reason (``fedml_robust_rejected_total``)
+and feeds the silo's strike count in the `TrustTracker`: K strikes ⇒
+quarantine for ``quarantine_rounds`` (the silo is excluded from the
+round quorum exactly like a FailureDetector-dead one and its weight is
+0), then **probation** — re-tasked and screened normally; a strike on
+probation re-quarantines immediately, ``probation_rounds`` clean
+accepted uploads restore full trust.  The protocol is deliberately
+symmetric to `FailureDetector`'s dead/rejoin: one handles silos that
+stop talking, this one handles silos that talk poison.
+
+Everything here is host-side numpy at message rate — the aggregation
+itself stays one jit (`robust/defense.py`); admission never traces.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import math
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fedml_tpu.obs import telemetry
+
+log = logging.getLogger(__name__)
+
+# the closed set of rejection reasons (each is a labeled series of
+# fedml_robust_rejected_total; tests assert the sum accounts for every
+# rejected upload)
+REASONS = ("quarantined", "fingerprint", "bad_num_samples", "nonfinite",
+           "norm_outlier")
+
+
+def _canon_key(k) -> str:
+    """Canonical Mapping-key form shared by `params_fingerprint` and
+    `_leaves`: the key TYPE is part of the identity (an int-keyed tree
+    must NOT fingerprint equal to its str-keyed twin — their leaf
+    orders differ, and later tree math would treedef-mismatch), and the
+    str form gives a total order even across mixed key types."""
+    return f"{type(k).__name__}:{k}"
+
+
+def params_fingerprint(tree) -> object:
+    """Codec-stable structural description of a params pytree: nested
+    plain containers with ``(shape, dtype)`` leaves.  Mapping flavors
+    (dict / flax FrozenDict) normalize to plain dicts keyed by
+    `_canon_key`, so a tree that went through the wire codec
+    fingerprints identically to the live global it must match — while
+    a key-type-confused payload (int keys posing as str keys) does
+    NOT match."""
+    if hasattr(tree, "items"):
+        return {_canon_key(k): params_fingerprint(v)
+                for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [params_fingerprint(v) for v in tree]
+    arr = np.asarray(tree)
+    return (tuple(arr.shape), np.dtype(arr.dtype).str)
+
+
+def _leaves(tree) -> List[np.ndarray]:
+    """Flatten in `_canon_key` order — the SAME canonicalization as
+    `params_fingerprint` (only called on trees whose fingerprints
+    already matched, so two flattenings zip leaf-for-leaf)."""
+    if hasattr(tree, "items"):
+        out: List[np.ndarray] = []
+        for _, v in sorted(tree.items(),
+                           key=lambda kv: _canon_key(kv[0])):
+            out.extend(_leaves(v))
+        return out
+    if isinstance(tree, (list, tuple)):
+        out = []
+        for v in tree:
+            out.extend(_leaves(v))
+        return out
+    return [np.asarray(tree)]
+
+
+def _all_finite(tree) -> bool:
+    for leaf in _leaves(tree):
+        if np.issubdtype(leaf.dtype, np.floating) \
+                and not np.isfinite(leaf).all():
+            return False
+    return True
+
+
+def _update_norm(upload, reference_leaves) -> float:
+    """||upload - reference||_2 over all leaves in f64 (host math; the
+    screen must not be fooled by f32 overflow on a scale attack).
+    ``reference_leaves``: pre-flattened f64 host leaves (the per-round
+    cache below — never a fresh device transfer per upload)."""
+    total = 0.0
+    for u, g in zip(_leaves(upload), reference_leaves):
+        d = u.astype(np.float64) - g
+        total += float(np.sum(d * d))
+    return math.sqrt(total)
+
+
+def _norm(tree) -> float:
+    total = 0.0
+    for u in _leaves(tree):
+        d = u.astype(np.float64)
+        total += float(np.sum(d * d))
+    return math.sqrt(total)
+
+
+class TrustTracker:
+    """Per-silo strike ledger: TRUSTED → QUARANTINED → PROBATION → TRUSTED.
+
+    * every rejected upload is a **strike**; ``strikes_to_quarantine``
+      strikes quarantine the silo until ``round + quarantine_rounds``;
+    * while quarantined the silo contributes weight 0 and is excluded
+      from the round quorum (the server actors treat it like a
+      FailureDetector-dead silo — the barrier never waits on it);
+    * quarantine expiry moves the silo to **probation**: it is tasked
+      and screened normally, but ONE strike re-quarantines immediately,
+      and ``probation_rounds`` clean accepted uploads restore trust;
+    * while trusted, each clean upload decays one old strike, so honest
+      silos with occasional wire corruption never ratchet into
+      quarantine.
+
+    ``events`` keeps an append-only ``(round, silo, event)`` log —
+    the audit trail tests and the run_byzantine demo assert on.
+
+    Trust is SOFT state, deliberately not checkpointed — exactly like
+    the `FailureDetector` health registry it mirrors: a crash-resumed
+    server re-learns a quarantine within ``strikes_to_quarantine``
+    rounds of fresh evidence (and the norm screen re-arms after its
+    warm-up window).  Only state that affects numerical resume
+    equivalence (params, EF residuals) rides checkpoints.
+    """
+
+    TRUSTED = "trusted"
+    QUARANTINED = "quarantined"
+    PROBATION = "probation"
+
+    def __init__(self, strikes_to_quarantine: int = 3,
+                 quarantine_rounds: int = 4, probation_rounds: int = 2):
+        if strikes_to_quarantine < 1:
+            raise ValueError(f"strikes_to_quarantine must be >= 1, got "
+                             f"{strikes_to_quarantine}")
+        if quarantine_rounds < 1:
+            raise ValueError(f"quarantine_rounds must be >= 1, got "
+                             f"{quarantine_rounds}")
+        if probation_rounds < 0:
+            raise ValueError(f"probation_rounds must be >= 0, got "
+                             f"{probation_rounds}")
+        self.strikes_to_quarantine = strikes_to_quarantine
+        self.quarantine_rounds = quarantine_rounds
+        self.probation_rounds = probation_rounds
+        self._strikes: Dict[int, int] = {}
+        self._quarantine_until: Dict[int, int] = {}   # silo -> first free round
+        self._probation_left: Dict[int, int] = {}
+        self.events: List[Tuple[int, int, str]] = []
+        reg = telemetry.get_registry()
+        self._c_strikes = reg.counter("fedml_robust_strikes_total")
+        self._c_quarantines = reg.counter(
+            "fedml_robust_quarantine_events_total")
+        self._g_quarantined = reg.gauge("fedml_robust_quarantined_total")
+
+    def state(self, silo: int, round_idx: int) -> str:
+        until = self._quarantine_until.get(silo)
+        if until is not None:
+            if round_idx < until:
+                return self.QUARANTINED
+            # lazy expiry: the first query past the sentence starts
+            # probation (symmetric to FailureDetector's sticky-DEAD
+            # cleared by the next beat)
+            del self._quarantine_until[silo]
+            if self.probation_rounds > 0:
+                self._probation_left[silo] = self.probation_rounds
+                self.events.append((round_idx, silo, "probation"))
+                return self.PROBATION
+            self.events.append((round_idx, silo, "trusted"))
+            return self.TRUSTED
+        if self._probation_left.get(silo, 0) > 0:
+            return self.PROBATION
+        return self.TRUSTED
+
+    def strike(self, silo: int, round_idx: int, reason: str) -> bool:
+        """Record a strike; returns True when this strike QUARANTINES."""
+        self._c_strikes.inc()
+        state = self.state(silo, round_idx)
+        if state == self.QUARANTINED:
+            return False  # already serving — nothing escalates
+        self._strikes[silo] = self._strikes.get(silo, 0) + 1
+        if state == self.PROBATION \
+                or self._strikes[silo] >= self.strikes_to_quarantine:
+            self._strikes[silo] = 0
+            self._probation_left.pop(silo, None)
+            self._quarantine_until[silo] = round_idx + self.quarantine_rounds
+            self._c_quarantines.inc()
+            self.events.append((round_idx, silo, f"quarantined:{reason}"))
+            log.warning("silo %d quarantined at round %d (reason=%s) until "
+                        "round %d", silo, round_idx, reason,
+                        self._quarantine_until[silo])
+            return True
+        return False
+
+    def record_clean(self, silo: int, round_idx: int) -> None:
+        """An accepted upload: burn one probation round / decay a strike."""
+        state = self.state(silo, round_idx)
+        if state == self.PROBATION:
+            self._probation_left[silo] -= 1
+            if self._probation_left[silo] <= 0:
+                del self._probation_left[silo]
+                self._strikes.pop(silo, None)
+                self.events.append((round_idx, silo, "trusted"))
+        elif state == self.TRUSTED and self._strikes.get(silo, 0) > 0:
+            self._strikes[silo] -= 1
+
+    def quarantined(self, round_idx: int, silos=None) -> set:
+        """The silos serving quarantine at ``round_idx`` (sweeps states,
+        so expiry → probation transitions happen here; refreshes the
+        quarantine gauge)."""
+        pool = (set(silos) if silos is not None
+                else set(self._quarantine_until))
+        out = {s for s in pool
+               if self.state(s, round_idx) == self.QUARANTINED}
+        self._g_quarantined.set(len(out))
+        return out
+
+
+@dataclasses.dataclass
+class AdmissionVerdict:
+    ok: bool
+    reason: Optional[str] = None     # one of REASONS when rejected
+    num_samples: float = 0.0         # sanitized weight (valid when ok)
+    norm: Optional[float] = None     # update norm (None if screened earlier)
+
+
+class AdmissionPipeline:
+    """The per-upload screen in front of both distributed server actors.
+
+    ``template``: the global params at federation start — its structural
+    fingerprint is the contract every upload must match.  ``kind``:
+    ``"params"`` (cross-silo uploads are full parameter trees; the norm
+    screened is ``||upload - global||``) or ``"delta"`` (async uploads
+    are updates already; the norm is ``||delta||``).
+
+    The norm screen keeps the last ``norm_window`` ACCEPTED norms and
+    rejects ``norm > median + norm_k * max(MAD, 5% of median)`` once
+    ``norm_min_history`` norms are banked — robust statistics, so up to
+    half the history being poisoned cannot drag the threshold up, and
+    the screen stays silent during warm-up instead of rejecting honest
+    round-0 variance.  The MAD floor keeps a freakishly-uniform history
+    (MAD 0) from rejecting benign jitter.
+    """
+
+    def __init__(self, template, *, kind: str = "params",
+                 max_num_samples: float = 1e6,
+                 norm_k: float = 6.0, norm_window: int = 64,
+                 norm_min_history: int = 8,
+                 trust: Optional[TrustTracker] = None):
+        if kind not in ("params", "delta"):
+            raise ValueError(f"kind must be 'params' or 'delta', got {kind!r}")
+        if max_num_samples < 0:
+            raise ValueError(f"max_num_samples must be >= 0 (0 disables the "
+                             f"cap), got {max_num_samples}")
+        if norm_window < 1 or norm_min_history < 1:
+            raise ValueError("norm_window and norm_min_history must be >= 1")
+        self.kind = kind
+        self.fingerprint = params_fingerprint(template)
+        self.max_num_samples = max_num_samples
+        self.norm_k = norm_k
+        self.norm_min_history = norm_min_history
+        self._norms: Deque[float] = collections.deque(maxlen=norm_window)
+        self.trust = trust if trust is not None else TrustTracker()
+        reg = telemetry.get_registry()
+        self._c_admitted = reg.counter("fedml_robust_admitted_total")
+        self._c_rejected = {r: reg.counter("fedml_robust_rejected_total",
+                                           reason=r) for r in REASONS}
+        self._h_norm = reg.histogram(
+            "fedml_robust_update_norm_total",
+            buckets=(0.01, 0.1, 0.5, 1, 2, 5, 10, 50, 100, 1000, 1e5))
+        # reason -> count mirror for in-process assertions (tests, demo)
+        self.rejected: Dict[str, int] = {r: 0 for r in REASONS}
+        self.admitted = 0
+        # identity-keyed host copy of the reference globals: ONE
+        # device->host transfer per round, not one per upload (the same
+        # idiom as the wire-decompression cache in experiments/main.py)
+        self._ref_cache: Tuple[object, Optional[list]] = (None, None)
+
+    def _reject(self, silo: int, round_idx: int, reason: str,
+                norm: Optional[float] = None) -> AdmissionVerdict:
+        self.rejected[reason] += 1
+        self._c_rejected[reason].inc()
+        if reason != "quarantined":
+            # serving quarantine is not a NEW offense — strikes come
+            # from fresh evidence only
+            self.trust.strike(silo, round_idx, reason)
+        return AdmissionVerdict(False, reason=reason, norm=norm)
+
+    def reject(self, silo: int, round_idx: int,
+               reason: str) -> AdmissionVerdict:
+        """Administrative rejection for structural damage detected
+        UPSTREAM of `admit` (compression-handshake mismatch, a frame the
+        codec itself cannot decode): counted and struck exactly like a
+        pipeline rejection, so the accounting invariant — every rejected
+        upload appears in ``fedml_robust_rejected_total`` — holds."""
+        if reason not in REASONS:
+            raise ValueError(f"unknown rejection reason {reason!r}; "
+                             f"available: {REASONS}")
+        return self._reject(silo, round_idx, reason)
+
+    def _reference_leaves(self, global_params) -> list:
+        if self._ref_cache[0] is not global_params:
+            self._ref_cache = (global_params,
+                               [np.asarray(leaf, np.float64)
+                                for leaf in _leaves(global_params)])
+        return self._ref_cache[1]
+
+    def norm_threshold(self) -> Optional[float]:
+        if len(self._norms) < self.norm_min_history:
+            return None
+        arr = np.asarray(self._norms, np.float64)
+        med = float(np.median(arr))
+        mad = float(np.median(np.abs(arr - med)))
+        return med + self.norm_k * max(mad, 0.05 * med, 1e-12)
+
+    def admit(self, silo: int, upload, num_samples, global_params,
+              round_idx: int) -> AdmissionVerdict:
+        """Screen one upload.  ``global_params`` is the CURRENT global
+        (the reference point for ``kind="params"`` norms; ignored for
+        deltas).  Order matters: structural checks run before any tree
+        math touches the payload."""
+        if self.trust.state(silo, round_idx) == TrustTracker.QUARANTINED:
+            return self._reject(silo, round_idx, "quarantined")
+        try:
+            fp_ok = params_fingerprint(upload) == self.fingerprint
+        except Exception:  # noqa: BLE001 — unhashable garbage payload
+            fp_ok = False
+        if not fp_ok:
+            return self._reject(silo, round_idx, "fingerprint")
+        try:
+            n = float(num_samples)
+        except (TypeError, ValueError):
+            n = float("nan")
+        if not math.isfinite(n) or n <= 0 \
+                or (self.max_num_samples > 0 and n > self.max_num_samples):
+            return self._reject(silo, round_idx, "bad_num_samples")
+        if not _all_finite(upload):
+            return self._reject(silo, round_idx, "nonfinite")
+        norm = (_update_norm(upload, self._reference_leaves(global_params))
+                if self.kind == "params" else _norm(upload))
+        self._h_norm.observe(norm)
+        thresh = self.norm_threshold()
+        if thresh is not None and norm > thresh:
+            return self._reject(silo, round_idx, "norm_outlier", norm)
+        self._norms.append(norm)
+        self.admitted += 1
+        self._c_admitted.inc()
+        self.trust.record_clean(silo, round_idx)
+        return AdmissionVerdict(True, num_samples=n, norm=norm)

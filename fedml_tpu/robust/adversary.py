@@ -1,0 +1,219 @@
+"""Adversary injection: seeded malicious silos over the real message path.
+
+Symmetric to `comm/chaos.py` — chaos perturbs the WIRE, this perturbs
+the PAYLOAD at its source.  A malicious silo is an unmodified
+`FedAvgClientActor` whose ``train_fn`` is wrapped by
+`make_malicious_train_fn`: the silo really trains, really uploads over
+the real transport, and the server sees exactly what a compromised
+trust domain would send.  Attacks are selected per silo with the CLI
+``--adversary`` spec::
+
+    --adversary "2:scale:20,3:sign_flip"       # silo 2 scales x20, 3 flips
+    --adversary "4:nan_bomb"                   # silo 4 NaNs a leaf
+    --adversary "1:inflate:1e9,2:backdoor"     # weight inflation + backdoor
+
+Kinds (classic Byzantine attack zoo):
+
+* ``sign_flip``  — upload ``global - param * (update)`` (param: flip
+  magnitude, default 1 = pure sign flip; Bernstein et al. 2018);
+* ``scale``      — upload ``global + param * update`` (param: scale
+  factor, default 10; the model-replacement/boosting attack);
+* ``gauss``      — add N(0, param) noise to the update (default std 1);
+* ``nan_bomb``   — one parameter leaf becomes all-NaN (the crash/poison
+  probe the finite guard must catch);
+* ``inflate``    — honest update, but ``num_samples`` claimed as
+  ``param`` (default 1e9 — the weight-capture attack the admission cap
+  must catch);
+* ``backdoor``   — trains on trigger-stamped, target-relabeled data
+  (`data/edge_case.apply_pixel_trigger` via the shard transform below,
+  reusing the `algorithms/backdoor.py` poison semantics); param is the
+  target label (omitted: the run's ``--target_label``).
+
+All randomness is seeded per ``(seed, silo, round)``, so attacked runs
+replay bit-identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+ATTACK_KINDS = ("sign_flip", "scale", "gauss", "nan_bomb", "inflate",
+                "backdoor")
+
+# backdoor's -1 sentinel means "use the run's --target_label"
+_DEFAULT_PARAM = {"sign_flip": 1.0, "scale": 10.0, "gauss": 1.0,
+                  "nan_bomb": 0.0, "inflate": 1e9, "backdoor": -1.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class Attack:
+    kind: str
+    param: float
+
+    def __post_init__(self):
+        if self.kind not in ATTACK_KINDS:
+            raise ValueError(f"unknown attack kind {self.kind!r}; "
+                             f"available: {ATTACK_KINDS}")
+
+
+def parse_adversary_spec(spec: str) -> Dict[int, Attack]:
+    """``"silo:kind[:param],..."`` → {silo_id: Attack}.  Silo ids are the
+    1-based actor ids of the cross-silo/async deployments."""
+    out: Dict[int, Attack] = {}
+    if not spec:
+        return out
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"bad --adversary entry {entry!r}; expected "
+                f"silo:kind[:param] (e.g. '2:scale:20')")
+        try:
+            silo = int(parts[0])
+        except ValueError:
+            raise ValueError(f"bad --adversary silo id {parts[0]!r} "
+                             f"in {entry!r}") from None
+        if silo < 1:
+            raise ValueError(f"--adversary silo ids are 1-based actor ids; "
+                             f"got {silo}")
+        kind = parts[1].strip()
+        param = float(parts[2]) if len(parts) == 3 else _DEFAULT_PARAM.get(
+            kind, 0.0)
+        if silo in out:
+            raise ValueError(f"--adversary lists silo {silo} twice")
+        out[silo] = Attack(kind, param)
+    return out
+
+
+def _tree_map2(fn, a, b):
+    """Structure-preserving two-tree map over the plain dict/list nests
+    the wire codec produces (numpy host math — no device bounce)."""
+    if hasattr(a, "items"):
+        return {k: _tree_map2(fn, a[k], b[k]) for k in a}
+    if isinstance(a, (list, tuple)):
+        t = [_tree_map2(fn, x, y) for x, y in zip(a, b)]
+        return tuple(t) if isinstance(a, tuple) else t
+    return fn(np.asarray(a), np.asarray(b))
+
+
+def _tree_map1(fn, t):
+    """One-tree map (numpy host leaves)."""
+    if hasattr(t, "items"):
+        return {k: _tree_map1(fn, v) for k, v in t.items()}
+    if isinstance(t, (list, tuple)):
+        out = [_tree_map1(fn, v) for v in t]
+        return tuple(out) if isinstance(t, tuple) else out
+    return fn(np.asarray(t))
+
+
+def _tree_host(t):
+    """One-tree host materialization (np.asarray every leaf)."""
+    return _tree_map1(lambda a: a, t)
+
+
+def _first_float_leaf_to_nan(tree):
+    """Copy the tree with its first float leaf replaced by all-NaN."""
+    done = [False]
+
+    def _walk(t):
+        if hasattr(t, "items"):
+            return {k: _walk(v) for k, v in t.items()}
+        if isinstance(t, (list, tuple)):
+            out = [_walk(v) for v in t]
+            return tuple(out) if isinstance(t, tuple) else out
+        arr = np.asarray(t)
+        if not done[0] and np.issubdtype(arr.dtype, np.floating):
+            done[0] = True
+            return np.full_like(arr, np.nan)
+        return arr
+
+    return _walk(tree)
+
+
+def make_malicious_train_fn(attack: Attack, train_fn: Callable,
+                            silo: int, seed: int = 0) -> Callable:
+    """Wrap a silo's honest ``train_fn(params, client_idx, round_idx)``
+    with the attack.  The wrapped function keeps the SiloTrainFn
+    contract, so the standard client actor (and therefore the real
+    transport, codec, compression, and tracing) carries the attack —
+    no test-only message forging."""
+
+    def malicious(params, client_idx, round_idx):
+        new_params, num_samples = train_fn(params, client_idx, round_idx)
+        if attack.kind == "backdoor":
+            # the poisoning happened in the shard transform (the silo
+            # genuinely trained on triggered data); the upload is honest
+            return new_params, num_samples
+        if attack.kind == "inflate":
+            return new_params, float(attack.param)
+        host_new = _tree_host(new_params)
+        host_old = _tree_host(params)
+        if attack.kind == "sign_flip":
+            out = _tree_map2(lambda g, n: (g - attack.param * (n - g))
+                             .astype(n.dtype), host_old, host_new)
+        elif attack.kind == "scale":
+            out = _tree_map2(lambda g, n: (g + attack.param * (n - g))
+                             .astype(n.dtype), host_old, host_new)
+        elif attack.kind == "gauss":
+            rng = np.random.RandomState(
+                (seed * 1_000_003 + silo * 7919 + int(round_idx) * 101)
+                % (2 ** 32))
+            out = _tree_map1(
+                lambda n: (n + rng.normal(0.0, attack.param, n.shape))
+                .astype(n.dtype) if np.issubdtype(n.dtype, np.floating)
+                else n, host_new)
+        elif attack.kind == "nan_bomb":
+            out = _first_float_leaf_to_nan(host_new)
+        else:  # pragma: no cover — Attack.__post_init__ already validated
+            raise ValueError(f"unhandled attack kind {attack.kind!r}")
+        return out, num_samples
+
+    return malicious
+
+
+def make_backdoor_shard_transform(target_label: int, trigger_size: int = 3,
+                                  poison_frac: float = 1.0,
+                                  seed: int = 0) -> Callable:
+    """A ``shard_transform(shard, client_idx, round_idx)`` hook for the
+    silo training setup: stamps the pixel trigger + target relabel onto
+    ``poison_frac`` of the shard's real (masked) samples, exactly the
+    `algorithms/backdoor.poison_stacked_clients` semantics but applied
+    silo-side per round — the attacker poisons whatever client shard it
+    is assigned, as a real compromised silo would."""
+    from fedml_tpu.data.edge_case import apply_pixel_trigger
+
+    def transform(shard, client_idx, round_idx):
+        x = np.array(shard["x"], copy=True)
+        y = np.array(shard["y"], copy=True)
+        mask = np.asarray(shard["mask"])
+        sample_shape = x.shape[2:]  # shard is [S, B, ...]
+        flat_x = x.reshape((-1,) + tuple(sample_shape))
+        flat_y = y.reshape(-1)
+        real = np.where(mask.reshape(-1) > 0)[0]
+        k = int(round(poison_frac * len(real)))
+        if k:
+            rng = np.random.RandomState(
+                (seed * 1_000_003 + int(client_idx) * 7919
+                 + int(round_idx) * 101) % (2 ** 32))
+            sel = rng.choice(real, k, replace=False)
+            px, py = apply_pixel_trigger(flat_x[sel], target_label,
+                                         trigger_size=trigger_size)
+            flat_x[sel] = px
+            flat_y[sel] = py
+        return {**shard, "x": flat_x.reshape(x.shape),
+                "y": flat_y.reshape(y.shape)}
+
+    return transform
+
+
+def attacked_silos(adversaries: Dict[int, Attack],
+                   kinds: Optional[List[str]] = None) -> List[int]:
+    """Silo ids running one of ``kinds`` (all kinds when None)."""
+    return sorted(s for s, a in adversaries.items()
+                  if kinds is None or a.kind in kinds)

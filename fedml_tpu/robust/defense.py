@@ -1,0 +1,83 @@
+"""The defended aggregate: one jit for clip + noise + Byzantine rule.
+
+Following FedJAX's one-XLA-program aggregation discipline, the whole
+screen-survivors → defend → aggregate step compiles ONCE: the server
+stacks the round's admitted uploads into the static ``[N, ...]`` cohort
+shape (quarantined / rejected / dropped slots hold a copy of the global
+with weight 0 — masked, never gathered out, so shapes never depend on
+who showed up), and this module's jitted function does the rest:
+
+1. **norm-diff clipping** (reference parity,
+   ``fedml_core/robustness/robust_aggregation.py:38-49``) — each slot's
+   update is clipped to ``norm_clip`` via `core.robust.clip_update`
+   vmapped over the cohort axis;
+2. **aggregation** — plain ``tree_weighted_mean`` or any
+   `core/byzantine.py` rule (coordinate_median / trimmed_mean / krum /
+   multi_krum / geometric_median), all of which honor weight-0 slots;
+3. **weak-DP noise** (reference parity, ``:51-55``) — seeded Gaussian
+   noise on the aggregate, folded per round so every round's draw is
+   fresh but the run replays deterministically.
+
+The async server reuses the same function on its ``[goal, ...]`` delta
+buffer with a zeros reference tree (clipping a delta against zero IS
+norm clipping the delta) and applies the staleness discount to the
+robust aggregate afterwards — screen before buffering, discount after.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.byzantine import METHODS, make_byzantine_aggregate
+from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.core.robust import add_gaussian_noise, clip_update
+
+ROBUST_AGG_METHODS = ("mean",) + METHODS
+
+
+def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
+                            byz_f: int = 0, krum_m: int = 1,
+                            gm_iters: int = 8, gm_eps: float = 1e-6,
+                            norm_clip: float = 0.0, noise_std: float = 0.0,
+                            seed: int = 0) -> Callable:
+    """Build the jitted ``fn(global_params, stacked, weights, step) ->
+    new_params`` the server actors call once per round/version.
+
+    ``stacked``: the static ``[N, ...]`` cohort tree (weight-0 slots are
+    copies of ``global_params`` for the sync path / zeros for deltas).
+    ``weights``: ``[N]`` raw sample counts, 0 for masked slots —
+    callers must guard the all-zero cohort (skip aggregation) before
+    calling.  ``step`` seeds the per-round noise fold; it traces as a
+    scalar, so varying it never recompiles.  The returned function is a
+    single jit — tests pin ``fn._cache_size() == 1`` after a full run
+    (no per-round recompiles, the acceptance criterion).
+    """
+    if method not in ROBUST_AGG_METHODS:
+        raise ValueError(f"unknown robust aggregation method {method!r}; "
+                         f"available: {ROBUST_AGG_METHODS}")
+    if norm_clip < 0 or noise_std < 0:
+        raise ValueError(f"norm_clip/noise_std must be >= 0, got "
+                         f"{norm_clip}/{noise_std}")
+    if method == "mean":
+        base = tree_weighted_mean
+    else:
+        base = make_byzantine_aggregate(method, trim_frac=trim_frac,
+                                        byz_f=byz_f, krum_m=krum_m,
+                                        gm_iters=gm_iters, gm_eps=gm_eps)
+
+    def _aggregate(global_params, stacked, weights, step):
+        weights = jnp.asarray(weights, jnp.float32)
+        if norm_clip > 0:
+            stacked = jax.vmap(
+                lambda c: clip_update(c, global_params, norm_clip))(stacked)
+        out = base(stacked, weights)
+        if noise_std > 0:
+            key = jax.random.fold_in(jax.random.key(seed),
+                                     jnp.asarray(step, jnp.uint32))
+            out = add_gaussian_noise(out, key, noise_std)
+        return out
+
+    return jax.jit(_aggregate)

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Live-path Byzantine defense demo (ISSUE 4 acceptance): 1 attacker among
+# 4 silos over the real local transport, three arms —
+#
+#   1. clean        — no attacker, plain mean (the reference trajectory);
+#   2. undefended   — silo 2 runs a x50 scale attack, plain mean: the
+#                     final eval loss demonstrably degrades;
+#   3. defended     — same attack, --robust_agg trimmed_mean + the
+#                     admission pipeline: final loss back within 10% of
+#                     clean, the attacker ends QUARANTINED, and the
+#                     telemetry accounts for every rejected upload.
+#
+# Usage: scripts/run_byzantine.sh [workdir]  (default: a fresh mktemp dir)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR="${1:-$(mktemp -d /tmp/fedml_byzantine.XXXXXX)}"
+mkdir -p "$DIR"
+echo "== byzantine demo: artifacts under $DIR"
+
+BASE=(--algo cross_silo --model lr --dataset mnist
+      --client_num_in_total 4 --client_num_per_round 4 --comm_round 6
+      --frequency_of_the_test 6 --batch_size 4 --log_stdout false)
+ATTACK=(--adversary "2:scale:50")
+DEFENSE=(--robust_agg trimmed_mean --trim_frac 0.3
+         --norm_screen_min_history 3 --strikes_to_quarantine 2)
+
+env JAX_PLATFORMS=cpu python -m fedml_tpu "${BASE[@]}" \
+    --run_dir "$DIR/clean" > "$DIR/clean.json"
+env JAX_PLATFORMS=cpu python -m fedml_tpu "${BASE[@]}" "${ATTACK[@]}" \
+    --run_dir "$DIR/undefended" > "$DIR/undefended.json"
+env JAX_PLATFORMS=cpu python -m fedml_tpu "${BASE[@]}" "${ATTACK[@]}" \
+    "${DEFENSE[@]}" --telemetry true \
+    --run_dir "$DIR/defended" > "$DIR/defended.json"
+
+echo "== asserting the three-arm comparison + quarantine telemetry"
+python - "$DIR" <<'EOF'
+import json, sys
+d = sys.argv[1]
+loss = {arm: json.load(open(f"{d}/{arm}.json"))["test_loss"]
+        for arm in ("clean", "undefended", "defended")}
+print("final test_loss:", {k: round(v, 4) for k, v in loss.items()})
+assert loss["undefended"] > loss["clean"] * 1.01, (
+    "the scale attack failed to degrade the undefended mean")
+assert loss["defended"] <= loss["clean"] * 1.10, (
+    "the defended run strayed >10% from the clean trajectory")
+tel = json.load(open(f"{d}/defended/telemetry.json"))
+rejected = {k: v for k, v in tel["counters"].items()
+            if k.startswith("fedml_robust_rejected_total")}
+assert sum(rejected.values()) >= 1, "no upload was ever rejected"
+assert tel["counters"]["fedml_robust_quarantine_events_total"] >= 1, (
+    "the attacker was never quarantined")
+assert tel["gauges"]["fedml_robust_quarantined_total"] >= 1, (
+    "the attacker did not END the run quarantined")
+print("rejections by reason:", rejected)
+print("quarantine events:",
+      tel["counters"]["fedml_robust_quarantine_events_total"])
+EOF
+echo "== byzantine demo OK ($DIR)"

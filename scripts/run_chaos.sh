@@ -2,7 +2,10 @@
 # Full seeded chaos + fault-tolerance matrix (includes the slow cases
 # tier-1 skips): 20-seed drop-policy and async chaos sweeps, the
 # resilient-transport suite (gRPC receiver restart, MQTT reconnect),
-# crash-recovery, and the end-to-end convergence-under-chaos runs.
+# crash-recovery, the end-to-end convergence-under-chaos runs, and the
+# payload-defense suite (corrupt-fault injection exercising the robust
+# admission pipeline, defended-vs-undefended convergence under attack,
+# combined chaos+adversary runs).
 #
 # Usage: scripts/run_chaos.sh [extra pytest args]
 set -euo pipefail
@@ -10,4 +13,5 @@ cd "$(dirname "$0")/.."
 
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_resilient.py tests/test_recovery.py \
+    tests/test_robust_round.py \
     -q -p no:cacheprovider "$@"

@@ -16,6 +16,7 @@
 # via scripts/serve_bench.py / run_serve_demo.sh instead.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-[ -f tests/test_serve.py ]  # fast tier must include the serve suite
+[ -f tests/test_serve.py ]         # fast tier must include the serve suite
+[ -f tests/test_robust_round.py ]  # ...and the payload-defense suite
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

@@ -174,3 +174,40 @@ def test_byzantine_rejects_mesh_and_pallas():
         FedAvgRobust(wl, data, FedAvgRobustConfig(
             defense="multi_krum", client_num_per_round=8, byz_f=2,
             krum_m=8))
+
+
+@pytest.mark.parametrize("method", METHODS)
+def test_every_method_is_padding_invariant(method, rng):
+    """The property every rule must hold for the static-cohort defended
+    round (robust/defense.py): weight-0 slots NEVER change the result,
+    whatever garbage they hold — padded and unpadded cohorts agree."""
+    agg = make_byzantine_aggregate(method, trim_frac=0.2, byz_f=1, krum_m=2)
+    for trial in range(3):
+        n, pad = 5, int(rng.randint(1, 4))
+        tree = {"a": jnp.asarray(rng.randn(n, 3, 2).astype(np.float32)),
+                "b": jnp.asarray(rng.randn(n, 4).astype(np.float32))}
+        w = jnp.asarray(rng.rand(n).astype(np.float32) + 0.5)
+        base = agg(tree, w)
+        # padded slots carry large GARBAGE (not zeros) with weight 0
+        garbage = jax.tree.map(
+            lambda x: jnp.concatenate(
+                [x, jnp.asarray(1e4 * rng.randn(
+                    pad, *x.shape[1:]).astype(np.float32))]), tree)
+        got = agg(garbage, jnp.concatenate([w, jnp.zeros(pad)]))
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5),
+            base, got)
+
+
+def test_geometric_median_survives_all_zero_weights(rng):
+    """The all-weights-zero cohort (every silo rejected/quarantined) used
+    to divide by a zero weight sum and NaN out; now it falls back to the
+    unweighted geometric median — finite and deterministic."""
+    tree = {"w": jnp.asarray(rng.randn(5, 6).astype(np.float32))}
+    out = geometric_median(tree, jnp.zeros(5))
+    assert np.isfinite(np.asarray(out["w"])).all()
+    # the guard must not perturb live cohorts: a single live client's
+    # geometric median is that client's update
+    solo = np.asarray(geometric_median(
+        tree, jnp.asarray([0.0, 0.0, 0.0, 0.0, 1.0]))["w"])
+    np.testing.assert_allclose(solo, np.asarray(tree["w"])[4], atol=1e-3)

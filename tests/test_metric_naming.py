@@ -54,6 +54,11 @@ EXPECTED = {
     "fedml_serve_shed_total", "fedml_serve_queue_depth_total",
     "fedml_serve_batch_occupancy_total",
     "fedml_serve_request_seconds", "fedml_serve_predict_seconds",
+    # PR 4: the payload-defense pipeline (fedml_tpu/robust/admission.py)
+    "fedml_robust_admitted_total", "fedml_robust_rejected_total",
+    "fedml_robust_update_norm_total", "fedml_robust_strikes_total",
+    "fedml_robust_quarantine_events_total",
+    "fedml_robust_quarantined_total",
 }
 
 

@@ -1,0 +1,817 @@
+"""The defended distributed round (fedml_tpu/robust): admission pipeline,
+TrustTracker quarantine/probation, the jit-once defended aggregate on both
+live server actors, and the adversary harness over the real message path.
+
+Fast cases run actor-level federations with tiny parameter trees (pump
+mode — deterministic, no sleeps); the end-to-end CLI convergence matrix
+(defended vs undefended under real attacks, combined chaos+adversary)
+rides @slow alongside scripts/run_byzantine.sh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.algorithms.async_fl import AsyncFedServerActor, delta_encoder
+from fedml_tpu.algorithms.cross_silo import (FedAvgClientActor,
+                                             FedAvgServerActor, MsgType)
+from fedml_tpu.comm.chaos import ChaosPlan, ChaosTransport, LinkChaos
+from fedml_tpu.comm.local import LocalHub
+from fedml_tpu.comm.message import Message
+from fedml_tpu.robust import (AdmissionPipeline, Attack, TrustTracker,
+                              make_defended_aggregate,
+                              make_malicious_train_fn, parse_adversary_spec)
+from fedml_tpu.robust.admission import REASONS, params_fingerprint
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"dense": {"kernel": rng.randn(4, 3).astype(np.float32),
+                      "bias": rng.randn(3).astype(np.float32)}}
+
+
+def _honest_train_fn(delta=0.01):
+    def fn(params, client_idx, round_idx):
+        return jax.tree.map(lambda v: np.asarray(v) + delta, params), 10
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# admission pipeline unit behavior
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def test_reasons_account_for_every_rejection(self):
+        tmpl = _params()
+        adm = AdmissionPipeline(tmpl, norm_min_history=2,
+                                max_num_samples=1000,
+                                trust=TrustTracker(
+                                    strikes_to_quarantine=100))
+        g = _params()
+        ok = adm.admit(1, _params(1), 10, g, 0)
+        assert ok.ok and ok.num_samples == 10.0
+        # fingerprint: wrong shape
+        bad_shape = {"dense": {"kernel": np.zeros((2, 2), np.float32),
+                               "bias": np.zeros(3, np.float32)}}
+        assert adm.admit(2, bad_shape, 10, g, 0).reason == "fingerprint"
+        # fingerprint: wrong dtype
+        bad_dtype = jax.tree.map(lambda v: v.astype(np.float64), _params(1))
+        assert adm.admit(2, bad_dtype, 10, g, 0).reason == "fingerprint"
+        # fingerprint: not even a tree
+        assert adm.admit(2, "junk", 10, g, 0).reason == "fingerprint"
+        # num_samples: None / NaN / negative / inflated past the cap
+        for bad in (None, float("nan"), -5, 0, 10_000_000):
+            assert adm.admit(3, _params(1), bad, g, 0).reason \
+                == "bad_num_samples"
+        # nonfinite payload
+        nan_tree = _params(1)
+        nan_tree["dense"]["bias"] = np.full(3, np.nan, np.float32)
+        assert adm.admit(4, nan_tree, 10, g, 0).reason == "nonfinite"
+        # accounting: admitted + per-reason rejects == every admit() call
+        # (1 admit + 3 fingerprint + 5 bad_num_samples + 1 nonfinite)
+        total_rejected = sum(adm.rejected.values())
+        assert adm.admitted == 1 and total_rejected == 9
+        assert set(adm.rejected) == set(REASONS)
+
+    def test_norm_outlier_screen_uses_robust_stats(self):
+        tmpl = _params()
+        adm = AdmissionPipeline(tmpl, norm_min_history=4, norm_k=6.0)
+        g = _params()
+        honest = jax.tree.map(lambda v: np.asarray(v) + 0.01, g)
+        for i in range(6):  # bank honest norms; screen arms at 4
+            assert adm.admit(1, honest, 10, g, i).ok
+        evil = jax.tree.map(lambda v: np.asarray(v) + 5.0, g)
+        verdict = adm.admit(2, evil, 10, g, 6)
+        assert not verdict.ok and verdict.reason == "norm_outlier"
+        # the rejected norm was NOT banked: the threshold is unchanged and
+        # honest uploads keep passing (poison cannot drag the screen up)
+        assert adm.admit(1, honest, 10, g, 7).ok
+
+    def test_fingerprint_normalizes_mapping_flavor(self):
+        import flax.core
+        tmpl = _params()
+        frozen = flax.core.freeze(tmpl)
+        assert params_fingerprint(frozen) == params_fingerprint(tmpl)
+
+    def test_key_type_confusion_is_rejected_not_crashed(self):
+        """An int-keyed tree whose str() forms match the template's keys
+        must fail the fingerprint (key TYPE is identity): str-sorted and
+        native-sorted leaf orders can differ, and admitting such a tree
+        would misalign the norm zip or treedef-crash the aggregation."""
+        tmpl = {str(i): np.zeros((i + 1,), np.float32) for i in range(11)}
+        adm = AdmissionPipeline(tmpl, trust=TrustTracker(
+            strikes_to_quarantine=100))
+        forged = {i: np.zeros((i + 1,), np.float32) for i in range(11)}
+        v = adm.admit(1, forged, 10, tmpl, 0)  # must not raise
+        assert not v.ok and v.reason == "fingerprint"
+        # the honest str-keyed twin still passes
+        assert adm.admit(2, dict(tmpl), 10, tmpl, 0).ok
+
+    def test_quarantined_silo_rejected_without_new_strike(self):
+        adm = AdmissionPipeline(_params(), trust=TrustTracker(
+            strikes_to_quarantine=1, quarantine_rounds=3))
+        g = _params()
+        adm.admit(1, "junk", 10, g, 0)  # strike -> immediate quarantine
+        strikes_before = adm.trust._strikes.get(1, 0)
+        v = adm.admit(1, _params(1), 10, g, 1)  # clean payload, but jailed
+        assert v.reason == "quarantined"
+        assert adm.trust._strikes.get(1, 0) == strikes_before
+        assert adm.rejected["quarantined"] == 1
+
+
+class TestTrustTracker:
+    def test_quarantine_probation_lifecycle(self):
+        t = TrustTracker(strikes_to_quarantine=2, quarantine_rounds=3,
+                         probation_rounds=2)
+        assert t.state(1, 0) == TrustTracker.TRUSTED
+        assert not t.strike(1, 0, "nonfinite")
+        assert t.strike(1, 1, "nonfinite")          # second strike: jailed
+        assert t.state(1, 1) == TrustTracker.QUARANTINED
+        assert t.state(1, 3) == TrustTracker.QUARANTINED
+        assert t.state(1, 4) == TrustTracker.PROBATION  # sentence served
+        t.record_clean(1, 4)
+        assert t.state(1, 5) == TrustTracker.PROBATION
+        t.record_clean(1, 5)
+        assert t.state(1, 6) == TrustTracker.TRUSTED
+        events = [e for _, s, e in t.events if s == 1]
+        assert events == ["quarantined:nonfinite", "probation", "trusted"]
+
+    def test_strike_on_probation_requarantines_immediately(self):
+        t = TrustTracker(strikes_to_quarantine=3, quarantine_rounds=2,
+                         probation_rounds=2)
+        for r in range(3):
+            t.strike(1, r, "norm_outlier")
+        assert t.state(1, 3) == TrustTracker.QUARANTINED
+        assert t.state(1, 4) == TrustTracker.PROBATION
+        assert t.strike(1, 4, "norm_outlier")  # one strike is enough now
+        assert t.state(1, 5) == TrustTracker.QUARANTINED
+
+    def test_clean_uploads_decay_strikes_for_trusted_silos(self):
+        t = TrustTracker(strikes_to_quarantine=2, quarantine_rounds=2)
+        t.strike(1, 0, "nonfinite")
+        t.record_clean(1, 1)               # decays the strike
+        assert not t.strike(1, 2, "nonfinite")  # back to 1, not 2
+        assert t.state(1, 2) == TrustTracker.TRUSTED
+
+    def test_quarantined_sweep_refreshes_gauge(self):
+        t = TrustTracker(strikes_to_quarantine=1, quarantine_rounds=5)
+        t.strike(2, 0, "fingerprint")
+        assert t.quarantined(1, silos={1, 2, 3}) == {2}
+        assert t.quarantined(10, silos={1, 2, 3}) == set()
+
+
+# ---------------------------------------------------------------------------
+# the defended aggregate: one jit, padding-masked static cohort
+# ---------------------------------------------------------------------------
+
+class TestDefendedAggregate:
+    def _stack(self, trees):
+        return jax.tree.map(lambda *xs: np.stack(xs), *trees)
+
+    def test_mean_matches_tree_weighted_mean(self):
+        from fedml_tpu.core.pytree import tree_weighted_mean
+        g = _params()
+        trees = [_params(s) for s in (1, 2, 3)]
+        w = np.asarray([1.0, 2.0, 3.0], np.float32)
+        fn = make_defended_aggregate("mean")
+        got = fn(g, self._stack(trees), w, 0)
+        want = tree_weighted_mean(trees, w)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6), got, want)
+
+    def test_norm_clip_bounds_every_update(self):
+        g = _params()
+        evil = jax.tree.map(lambda v: v + 100.0, g)
+        honest = jax.tree.map(lambda v: v + 0.01, g)
+        fn = make_defended_aggregate("mean", norm_clip=1.0)
+        got = fn(g, self._stack([honest, evil]),
+                 np.asarray([1.0, 1.0], np.float32), 0)
+        # the clipped aggregate can move at most norm_clip from the global
+        from fedml_tpu.core.pytree import tree_vector_norm
+        assert float(tree_vector_norm(got, g)) <= 1.0 + 1e-4
+
+    def test_noise_is_seeded_per_step(self):
+        g = _params()
+        stacked = self._stack([_params(1), _params(2)])
+        w = np.ones(2, np.float32)
+        fn = make_defended_aggregate("mean", noise_std=0.1, seed=7)
+        a0 = fn(g, stacked, w, 0)
+        a0_again = fn(g, stacked, w, 0)
+        a1 = fn(g, stacked, w, 1)
+        jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)), a0, a0_again)
+        assert not np.allclose(np.asarray(a0["dense"]["kernel"]),
+                               np.asarray(a1["dense"]["kernel"]))
+
+    @pytest.mark.parametrize("method", ["trimmed_mean", "krum",
+                                        "geometric_median"])
+    def test_single_compile_across_rounds(self, method):
+        """The acceptance criterion: varying weights, masks, and the step
+        counter across rounds never recompiles the defended aggregate."""
+        g = _params()
+        fn = make_defended_aggregate(method, trim_frac=0.25, byz_f=1,
+                                     norm_clip=5.0, noise_std=0.01)
+        rng = np.random.RandomState(0)
+        for r in range(5):
+            trees = [_params(s) for s in rng.randint(0, 100, size=4)]
+            w = rng.rand(4).astype(np.float32)
+            w[rng.randint(4)] = 0.0  # a masked slot each round
+            fn(g, self._stack(trees), w, r)
+        assert fn._cache_size() == 1
+
+    def test_rejects_unknown_method(self):
+        with pytest.raises(ValueError, match="unknown robust aggregation"):
+            make_defended_aggregate("majority_vote")
+
+
+# ---------------------------------------------------------------------------
+# the defended round over the real local transport
+# ---------------------------------------------------------------------------
+
+def _run_defended_federation(n_silos=4, n_rounds=6, attack=None,
+                             attacker=2, method="trimmed_mean",
+                             admission=None, defended=None, hub=None,
+                             wrap=lambda i, t: t):
+    hub = hub or LocalHub(codec_roundtrip=True)
+    init = _params()
+    if defended is None and method is not None:
+        defended = make_defended_aggregate(method, trim_frac=0.3)
+    server = FedAvgServerActor(
+        wrap(0, hub.transport(0)), init, client_num_in_total=n_silos,
+        client_num_per_round=n_silos, num_rounds=n_rounds,
+        admission=admission, aggregate_fn=defended)
+    server.register_handlers()
+    silos = []
+    for i in range(1, n_silos + 1):
+        fn = _honest_train_fn()
+        if attack is not None and i == attacker:
+            fn = make_malicious_train_fn(attack, fn, silo=i, seed=0)
+        silos.append(FedAvgClientActor(i, wrap(i, hub.transport(i)), fn))
+    for s in silos:
+        s.register_handlers()
+    server.start()
+    hub.pump()
+    return server, init
+
+
+class TestDefendedRound:
+    def test_scale_attacker_is_neutralized_and_quarantined(self):
+        adm = AdmissionPipeline(_params(), norm_min_history=3,
+                                trust=TrustTracker(strikes_to_quarantine=2,
+                                                   quarantine_rounds=10))
+        server, init = _run_defended_federation(
+            attack=Attack("scale", 100.0), admission=adm)
+        # every round closed; the global tracked the honest +0.01/round
+        # drift (attacker either trimmed out or quarantined to weight 0)
+        got = np.asarray(server.params["dense"]["bias"])
+        want = np.asarray(init["dense"]["bias"]) + 0.01 * 6
+        np.testing.assert_allclose(got, want, atol=0.02)
+        # the attacker ended quarantined, and the rejection counters
+        # account for every rejected upload
+        assert adm.trust.state(2, server.round_idx) \
+            == TrustTracker.QUARANTINED
+        assert sum(adm.rejected.values()) > 0
+        assert adm.rejected["norm_outlier"] >= 2
+        # quarantined rounds: the silo was excluded from the quorum like
+        # a dead one (logged in dropped_silos)
+        assert any(2 in v for v in server.dropped_silos.values())
+
+    def test_nan_bomb_never_reaches_the_global(self):
+        adm = AdmissionPipeline(_params(), norm_min_history=3)
+        server, _ = _run_defended_federation(
+            attack=Attack("nan_bomb", 0.0), admission=adm)
+        assert all(np.isfinite(l).all()
+                   for l in jax.tree.leaves(server.params))
+        assert adm.rejected["nonfinite"] >= 1
+
+    def test_inflated_num_samples_rejected_by_cap(self):
+        adm = AdmissionPipeline(_params(), max_num_samples=1000,
+                                norm_min_history=3,
+                                trust=TrustTracker(
+                                    strikes_to_quarantine=100))
+        server, init = _run_defended_federation(
+            attack=Attack("inflate", 1e9), admission=adm)
+        assert adm.rejected["bad_num_samples"] == 6  # every round
+        got = np.asarray(server.params["dense"]["bias"])
+        want = np.asarray(init["dense"]["bias"]) + 0.01 * 6
+        np.testing.assert_allclose(got, want, atol=0.02)
+
+    def test_undefended_mean_is_poisoned_by_the_same_attack(self):
+        """The control arm: without admission + robust aggregation the
+        identical scale attack drags the global far off the honest
+        trajectory — the defense above is doing the work."""
+        server, init = _run_defended_federation(
+            attack=Attack("scale", 100.0), method=None, admission=None)
+        got = np.asarray(server.params["dense"]["bias"])
+        want = np.asarray(init["dense"]["bias"]) + 0.01 * 6
+        assert np.abs(got - want).max() > 1.0
+
+    def test_duplicate_sync_upload_admits_once(self):
+        """Chaos-dup on the uplink: the second delivery of a round's
+        report is ignored — no double admission accounting, no
+        re-screening that could overwrite an accepted entry."""
+        adm = AdmissionPipeline(_params(), norm_min_history=3,
+                                trust=TrustTracker(strikes_to_quarantine=2,
+                                                   quarantine_rounds=10))
+        plan = ChaosPlan(seed=1, links={(2, 0): LinkChaos(dup_prob=1.0)},
+                         immune_types=(MsgType.S2C_FINISH,))
+        server, init = _run_defended_federation(
+            n_rounds=4, admission=adm, method="mean",
+            wrap=lambda i, t: ChaosTransport(t, plan) if i == 2 else t)
+        assert server.round_idx == 4
+        # 4 silos x 4 rounds, duplicates discarded: exactly 16 admits
+        assert adm.admitted + sum(adm.rejected.values()) == 16
+
+    def test_probation_rejoin_after_attack_stops(self):
+        """A silo that attacks early and then behaves is quarantined,
+        serves its sentence, re-enters on probation, and regains trust —
+        the full lifecycle over the live path."""
+        trust = TrustTracker(strikes_to_quarantine=2, quarantine_rounds=2,
+                             probation_rounds=1)
+        adm = AdmissionPipeline(_params(), norm_min_history=2, trust=trust)
+        hub = LocalHub(codec_roundtrip=True)
+        init = _params()
+        server = FedAvgServerActor(
+            hub.transport(0), init, client_num_in_total=3,
+            client_num_per_round=3, num_rounds=10, admission=adm,
+            aggregate_fn=make_defended_aggregate("mean"))
+        server.register_handlers()
+        honest = _honest_train_fn()
+        evil = make_malicious_train_fn(Attack("scale", 100.0), honest,
+                                       silo=2, seed=0)
+
+        def turncoat(params, client_idx, round_idx):
+            return (evil if round_idx < 4 else honest)(
+                params, client_idx, round_idx)
+
+        silos = [FedAvgClientActor(1, hub.transport(1), honest),
+                 FedAvgClientActor(2, hub.transport(2), turncoat),
+                 FedAvgClientActor(3, hub.transport(3), honest)]
+        for s in silos:
+            s.register_handlers()
+        server.start()
+        hub.pump()
+        events = [e for _, s, e in trust.events if s == 2]
+        assert "quarantined:norm_outlier" in events[0]
+        assert "probation" in events and events[-1] == "trusted"
+        # once trusted again the silo's uploads aggregate (it appears in
+        # the final accepted set)
+        assert 2 in np.asarray(server._last_accepted)
+
+    def test_handshake_mismatch_rejects_instead_of_crashing(self):
+        """With admission armed, a payload on the wrong side of the
+        compression handshake (a compressed frame at an uncompressed
+        server) is attacker-reachable structural damage: it must take
+        the reject-and-strike path, satisfy the barrier, and count in
+        the accounting — not raise out of the handler thread."""
+        adm = AdmissionPipeline(_params(), trust=TrustTracker(
+            strikes_to_quarantine=100))
+        hub = LocalHub(codec_roundtrip=True)
+        init = _params()
+        server = FedAvgServerActor(
+            hub.transport(0), init, client_num_in_total=2,
+            client_num_per_round=2, num_rounds=2, admission=adm)
+        server.register_handlers()
+
+        def fake_compressed(params, client_idx, round_idx):
+            new, n = _honest_train_fn()(params, client_idx, round_idx)
+            return new, n
+
+        silos = [FedAvgClientActor(
+            1, hub.transport(1), fake_compressed,
+            encode_upload=lambda new, g: {
+                "scheme": "topk", "junk": np.zeros(3, np.float32)}),
+            FedAvgClientActor(2, hub.transport(2), _honest_train_fn())]
+        for s in silos:
+            s.register_handlers()
+        server.start()
+        hub.pump()
+        assert server.round_idx == 2  # barrier closed every round
+        assert adm.rejected["fingerprint"] == 2
+        # honest silo's updates still aggregated
+        got = np.asarray(server.params["dense"]["bias"])
+        np.testing.assert_allclose(
+            got, np.asarray(init["dense"]["bias"]) + 0.02, atol=1e-5)
+
+    def test_rejected_upload_still_satisfies_the_barrier(self):
+        """Strict 'wait' barrier + a permanently-NaN silo: without the
+        reported-but-inadmissible accounting the federation would wedge
+        on round 0 waiting for an upload that already arrived."""
+        adm = AdmissionPipeline(_params(), trust=TrustTracker(
+            strikes_to_quarantine=100))  # never quarantine: every round
+        server, _ = _run_defended_federation(
+            n_rounds=3, attack=Attack("nan_bomb", 0.0), admission=adm)
+        assert server.round_idx == 3  # completed, did not wedge
+        assert adm.rejected["nonfinite"] == 3
+
+
+# ---------------------------------------------------------------------------
+# async server: the satellite num_samples fix + screened buffering
+# ---------------------------------------------------------------------------
+
+class TestAsyncScreening:
+    def _server(self, hub, admission=None, defended=None, goal=2,
+                n_silos=3):
+        for i in range(1, n_silos + 1):
+            hub.transport(i)  # absorb re-task sends in these unit cases
+        server = AsyncFedServerActor(
+            hub.transport(0), _params(), client_num_in_total=n_silos,
+            n_silos=n_silos, num_versions=4, aggregation_goal=goal,
+            admission=admission, defended_aggregate=defended)
+        server.register_handlers()
+        return server
+
+    def _upload(self, silo, version=0, **overrides):
+        msg = Message(MsgType.C2S_MODEL, silo, 0)
+        params = {Message.ARG_MODEL_PARAMS: jax.tree.map(
+            lambda v: np.full_like(v, 0.01), _params()),
+            Message.ARG_NUM_SAMPLES: 10, Message.ARG_ROUND: version}
+        params.update(overrides)
+        for k, v in params.items():
+            if v is not None:
+                msg.add(k, v)
+        return msg
+
+    def test_missing_num_samples_does_not_kill_the_handler(self):
+        """float(None) used to TypeError out of _on_model; now the upload
+        is rejected with a warning and the buffer stays clean."""
+        hub = LocalHub()
+        server = self._server(hub)
+        msg = self._upload(1)
+        del msg.params[Message.ARG_NUM_SAMPLES]
+        server._on_model(msg)  # must not raise
+        assert server._buffer == []
+
+    @pytest.mark.parametrize("bad", [float("nan"), -3, 0, float("inf")])
+    def test_invalid_num_samples_rejected(self, bad):
+        hub = LocalHub()
+        server = self._server(hub)
+        server._on_model(self._upload(1, **{Message.ARG_NUM_SAMPLES: bad}))
+        assert server._buffer == []
+
+    def test_future_version_tag_rejected(self):
+        """A forged ARG_ROUND beyond the current version used to send
+        staleness negative: (1+s)^-alpha divides by zero at s=-1 and goes
+        COMPLEX at s<=-2 — now the upload is rejected with a warning."""
+        hub = LocalHub()
+        server = self._server(hub)
+        server._on_model(self._upload(1, version=server.version + 1))
+        server._on_model(self._upload(2, version=server.version + 7))
+        assert server._buffer == []
+        # missing round tag likewise rejects instead of raising
+        msg = self._upload(3)
+        del msg.params[Message.ARG_ROUND]
+        server._on_model(msg)
+        assert server._buffer == []
+
+    def test_malformed_frame_retasks_once(self):
+        """A silo whose frame is malformed stays in rotation (re-tasked —
+        with the watchdog off nothing else would ever re-assign it), but
+        a transport-duplicated copy of the SAME frame does not multiply
+        assignments."""
+        hub = LocalHub()
+        server = self._server(hub)
+        msg = self._upload(1)
+        del msg.params[Message.ARG_ROUND]
+        server._on_model(msg)
+        server._on_model(msg)  # duplicate delivery of the same frame
+        assert hub._endpoints[1]._inbox.qsize() == 1  # one re-task only
+
+    def test_malformed_spam_strikes_and_quarantines(self):
+        """With admission armed, unique malformed frames are counted and
+        strike like any other offense — an attacker cannot spam garbage
+        round tags forever without ever being quarantined."""
+        adm = AdmissionPipeline(_params(), kind="delta",
+                                trust=TrustTracker(strikes_to_quarantine=2,
+                                                   quarantine_rounds=4))
+        hub = LocalHub()
+        server = self._server(hub, admission=adm)
+        for i in range(3):  # three DIFFERENT malformed frames
+            msg = self._upload(1, **{Message.ARG_MODEL_PARAMS: jax.tree.map(
+                lambda v: np.full_like(v, float(i)), _params())})
+            msg.params[Message.ARG_ROUND] = "garbage"
+            server._on_model(msg)
+        assert adm.rejected["fingerprint"] >= 2
+        assert adm.trust.state(1, server.version) \
+            == TrustTracker.QUARANTINED
+        assert 1 in server._benched
+
+    def test_screened_nan_delta_never_buffers_and_attacker_benches(self):
+        adm = AdmissionPipeline(_params(), kind="delta",
+                                trust=TrustTracker(strikes_to_quarantine=1,
+                                                   quarantine_rounds=2))
+        hub = LocalHub()
+        server = self._server(hub, admission=adm,
+                              defended=make_defended_aggregate(
+                                  "coordinate_median"))
+        nan_delta = jax.tree.map(lambda v: np.full_like(v, np.nan),
+                                 _params())
+        server._on_model(self._upload(
+            1, **{Message.ARG_MODEL_PARAMS: nan_delta}))
+        assert server._buffer == [] and adm.rejected["nonfinite"] == 1
+        # second offense while quarantined: benched, not re-tasked
+        server._on_model(self._upload(
+            1, version=0, **{Message.ARG_MODEL_PARAMS: nan_delta}))
+        assert 1 in server._benched
+        # honest uploads still aggregate; the defended apply stays finite
+        server._on_model(self._upload(2))
+        server._on_model(self._upload(3))
+        assert server.version == 1
+        assert all(np.isfinite(l).all()
+                   for l in jax.tree.leaves(server.params))
+
+    def test_quarantine_shrinks_the_goal_instead_of_wedging(self):
+        """2 of 3 silos NaN-bombing with goal=2: once both are benched
+        only 1 active silo remains — the effective goal shrinks (like
+        the sync quorum), versions keep advancing on the honest silo's
+        deltas, and the quarantine can therefore expire."""
+        adm = AdmissionPipeline(_params(), kind="delta",
+                                trust=TrustTracker(strikes_to_quarantine=1,
+                                                   quarantine_rounds=2))
+        hub = LocalHub()
+        server = self._server(hub, admission=adm, goal=2)
+        nan_delta = jax.tree.map(lambda v: np.full_like(v, np.nan),
+                                 _params())
+        for silo in (1, 2):  # both attackers jailed on first offense
+            server._on_model(self._upload(
+                silo, **{Message.ARG_MODEL_PARAMS: nan_delta}))
+        assert server._benched == {1, 2}
+        assert server._effective_goal() == 1
+        server._on_model(self._upload(3))  # one honest delta now flushes
+        assert server.version == 1
+        # a second honest delta advances again — no wedge
+        server._on_model(self._upload(3, version=1))
+        assert server.version == 2
+
+    def test_all_silos_quarantined_finishes_instead_of_hanging(self):
+        """Every silo Byzantine: with quarantine expiry keyed on a now-
+        frozen version counter nothing could ever be released — the
+        server must FINISH cleanly (the defended analog of the abort
+        policy), not hang forever."""
+        adm = AdmissionPipeline(_params(), kind="delta",
+                                trust=TrustTracker(strikes_to_quarantine=1,
+                                                   quarantine_rounds=4))
+        hub = LocalHub()
+        server = self._server(hub, admission=adm, goal=1, n_silos=2)
+        nan_delta = jax.tree.map(lambda v: np.full_like(v, np.nan),
+                                 _params())
+        for silo in (1, 2):
+            server._on_model(self._upload(
+                silo, **{Message.ARG_MODEL_PARAMS: nan_delta}))
+        assert server._finished
+        assert server.version == 0  # no poisoned aggregate was applied
+
+    def test_watchdog_skips_benched_silos(self):
+        """The version-close probation release is the single owner of a
+        benched silo's re-entry; the watchdog must not double-task it
+        the moment its quarantine lazily expires."""
+        hub = LocalHub()
+        adm = AdmissionPipeline(_params(), kind="delta",
+                                trust=TrustTracker(strikes_to_quarantine=1,
+                                                   quarantine_rounds=1))
+        server = self._server(hub, admission=adm)
+        server.retask_timeout_s = 0.001
+        server._benched.add(3)
+        adm.trust._quarantine_until[3] = 0  # sentence already served
+        server._last_heard[3] = -1e9        # ancient: watchdog would fire
+        server._on_retask_tick(Message(7, 0, 0))
+        assert hub._endpoints[3]._inbox.qsize() == 0  # not double-tasked
+
+    def test_duplicate_rejected_upload_strikes_once(self):
+        """A chaos-duplicated rejected delta must not double-strike: one
+        offense, one strike, one rejection counter tick."""
+        adm = AdmissionPipeline(_params(), kind="delta",
+                                trust=TrustTracker(strikes_to_quarantine=3))
+        hub = LocalHub()
+        server = self._server(hub, admission=adm)
+        nan_delta = jax.tree.map(lambda v: np.full_like(v, np.nan),
+                                 _params())
+        msg = self._upload(1, **{Message.ARG_MODEL_PARAMS: nan_delta})
+        server._on_model(msg)
+        server._on_model(msg)  # duplicate delivery of the same frame
+        assert adm.rejected["nonfinite"] == 1
+        assert adm.trust._strikes.get(1, 0) == 1
+
+    def test_benched_silo_released_on_probation(self):
+        adm = AdmissionPipeline(_params(), kind="delta",
+                                trust=TrustTracker(strikes_to_quarantine=1,
+                                                   quarantine_rounds=1))
+        hub = LocalHub()
+        server = self._server(hub, admission=adm)
+        server._benched.add(3)
+        adm.trust._quarantine_until[3] = 1  # sentence ends at version 1
+        server._on_model(self._upload(1))
+        server._on_model(self._upload(2))  # closes version 0 -> 1
+        hub.pump()
+        assert 3 not in server._benched  # re-tasked on probation
+
+
+# ---------------------------------------------------------------------------
+# chaos 'corrupt' fault kind (satellite): seeded payload damage
+# ---------------------------------------------------------------------------
+
+class TestChaosCorrupt:
+    def test_corrupt_is_copy_on_write_and_counted(self):
+        hub = LocalHub()
+        inbox = []
+
+        class _Sink:
+            def receive_message(self, t, m):
+                inbox.append(m)
+
+        t0 = hub.transport(0)
+        t1 = hub.transport(1)
+        t1.add_observer(_Sink())
+        plan = ChaosPlan(seed=3, default=LinkChaos(corrupt_prob=1.0))
+        chaotic = ChaosTransport(t0, plan)
+        original = jax.tree.map(np.asarray, _params())
+        msg = Message(MsgType.C2S_MODEL, 0, 1)
+        msg.add(Message.ARG_MODEL_PARAMS, original)
+        chaotic.send_message(msg)
+        hub.pump()
+        assert chaotic.faults["corrupt"] == 1 and len(inbox) == 1
+        received = inbox[0].get(Message.ARG_MODEL_PARAMS)
+        # exactly one leaf damaged, and the SENDER's arrays are untouched
+        diffs = [not np.array_equal(np.asarray(a), np.asarray(b),
+                                    equal_nan=True)
+                 for a, b in zip(jax.tree.leaves(original),
+                                 jax.tree.leaves(received))]
+        assert sum(diffs) == 1
+        assert all(np.isfinite(l).all() for l in jax.tree.leaves(original))
+
+    def test_corrupt_draws_are_seeded(self):
+        plan = ChaosPlan(seed=11, default=LinkChaos(corrupt_prob=0.5))
+        outs = []
+        for _ in range(2):
+            hub = LocalHub()
+            got = []
+
+            class _Sink:
+                def receive_message(self, t, m):
+                    got.append(np.asarray(
+                        m.get(Message.ARG_MODEL_PARAMS)["dense"]["kernel"]))
+
+            t1 = hub.transport(1)
+            t1.add_observer(_Sink())
+            chaotic = ChaosTransport(hub.transport(0), plan)
+            for i in range(6):
+                msg = Message(MsgType.C2S_MODEL, 0, 1)
+                msg.add(Message.ARG_MODEL_PARAMS,
+                        jax.tree.map(np.asarray, _params(i)))
+                chaotic.send_message(msg)
+            hub.pump()
+            outs.append(got)
+        for a, b in zip(*outs):
+            np.testing.assert_array_equal(a, b)
+
+    def test_quiet_plan_unaffected_by_corrupt_field(self):
+        link = LinkChaos()
+        assert link.quiet
+        assert not LinkChaos(corrupt_prob=0.5).quiet
+
+    def test_corrupted_round_survives_with_admission(self):
+        """Chaos corruption on one uplink + the admission screen: every
+        round closes, the global stays finite, and the NaN injections
+        are rejected as nonfinite (the chaos matrix exercising the
+        pipeline end-to-end)."""
+        adm = AdmissionPipeline(_params(), trust=TrustTracker(
+            strikes_to_quarantine=100))
+        plan = ChaosPlan(seed=5, links={(3, 0): LinkChaos(corrupt_prob=1.0)},
+                         immune_types=(MsgType.S2C_FINISH,))
+        server, _ = _run_defended_federation(
+            n_rounds=5, admission=adm, method="mean",
+            wrap=lambda i, t: ChaosTransport(t, plan) if i == 3 else t)
+        assert server.round_idx == 5
+        assert all(np.isfinite(l).all()
+                   for l in jax.tree.leaves(server.params))
+        assert sum(adm.rejected.values()) >= 1
+
+
+# ---------------------------------------------------------------------------
+# adversary spec parsing / CLI validation
+# ---------------------------------------------------------------------------
+
+class TestAdversarySpec:
+    def test_parse(self):
+        spec = parse_adversary_spec("2:scale:20, 3:sign_flip,4:inflate")
+        assert spec[2] == Attack("scale", 20.0)
+        assert spec[3] == Attack("sign_flip", 1.0)
+        assert spec[4].param == 1e9
+        assert parse_adversary_spec("") == {}
+
+    @pytest.mark.parametrize("bad", ["2", "x:scale", "0:scale",
+                                     "2:launch_missiles", "2:scale:1:2",
+                                     "2:scale,2:gauss"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_adversary_spec(bad)
+
+    def test_cli_rejects_robust_flags_outside_actor_modes(self):
+        from fedml_tpu.experiments.main import main
+        with pytest.raises(ValueError, match="cross_silo/async_fl"):
+            main(["--algo", "fedavg", "--adversary", "2:scale"])
+        with pytest.raises(ValueError, match="cross_silo/async_fl"):
+            main(["--algo", "fedavg", "--robust_agg", "krum"])
+
+    def test_cli_rejects_unknown_silo_and_method(self):
+        from fedml_tpu.experiments.main import main
+        base = ["--algo", "cross_silo", "--model", "lr", "--dataset",
+                "mnist", "--client_num_in_total", "4",
+                "--client_num_per_round", "4", "--comm_round", "1",
+                "--batch_size", "4", "--log_stdout", "false"]
+        with pytest.raises(ValueError, match="names silos"):
+            main(base + ["--adversary", "9:scale"])
+        with pytest.raises(ValueError, match="unknown robust aggregation"):
+            main(base + ["--robust_agg", "majority_vote"])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end CLI convergence: the acceptance matrix
+# ---------------------------------------------------------------------------
+
+_CLI_BASE = ["--model", "lr", "--dataset", "mnist",
+             "--client_num_in_total", "4", "--client_num_per_round", "4",
+             "--comm_round", "6", "--frequency_of_the_test", "6",
+             "--batch_size", "4", "--log_stdout", "false"]
+
+_DEFENSE = ["--robust_agg", "trimmed_mean", "--trim_frac", "0.3",
+            "--norm_screen_min_history", "3",
+            "--strikes_to_quarantine", "2"]
+
+
+def test_cli_defended_run_matches_clean_under_scale_attack():
+    """The acceptance criterion over the real local transport: 1 of 4
+    silos runs a scale attack; --robust_agg trimmed_mean keeps the final
+    eval loss within 10% of the attack-free run, the attacker ends
+    quarantined, and the rejection counters account for every rejected
+    upload (telemetry snapshot asserted by scripts/run_byzantine.sh,
+    in-process registry asserted here)."""
+    from fedml_tpu.experiments.main import main
+    from fedml_tpu.obs import telemetry
+    clean = main(["--algo", "cross_silo"] + _CLI_BASE)
+    reg = telemetry.enable()
+    try:
+        defended = main(["--algo", "cross_silo"] + _CLI_BASE
+                        + ["--adversary", "2:scale:50"] + _DEFENSE)
+        snap = reg.snapshot()
+    finally:
+        telemetry.disable()
+    assert defended["test_loss"] <= clean["test_loss"] * 1.10
+    rejected = {k: v for k, v in snap["counters"].items()
+                if k.startswith("fedml_robust_rejected_total")}
+    assert sum(rejected.values()) >= 1
+    assert snap["counters"]["fedml_robust_quarantine_events_total"] >= 1
+    assert snap["gauges"]["fedml_robust_quarantined_total"] >= 1
+
+
+@pytest.mark.slow
+def test_cli_undefended_mean_diverges_under_scale_attack():
+    """The control arm of the acceptance criterion: the same attack with
+    plain mean aggregation demonstrably diverges (worse final loss than
+    both the clean and the defended run)."""
+    from fedml_tpu.experiments.main import main
+    clean = main(["--algo", "cross_silo"] + _CLI_BASE)
+    attacked = main(["--algo", "cross_silo"] + _CLI_BASE
+                    + ["--adversary", "2:scale:50"])
+    assert attacked["test_loss"] > clean["test_loss"] * 1.01
+    assert attacked["test_acc"] < clean["test_acc"]
+
+
+@pytest.mark.slow
+def test_cli_chaos_corrupt_plus_adversary():
+    """The combined run: wire corruption AND a malicious silo, defense
+    on — the federation completes and stays within tolerance of clean."""
+    from fedml_tpu.experiments.main import main
+    clean = main(["--algo", "cross_silo"] + _CLI_BASE)
+    combined = main(["--algo", "cross_silo"] + _CLI_BASE
+                    + ["--adversary", "2:sign_flip:2",
+                       "--chaos_corrupt", "0.3"] + _DEFENSE)
+    assert np.isfinite(combined["test_loss"])
+    assert combined["test_loss"] <= clean["test_loss"] * 1.15
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("attack", ["sign_flip:2", "gauss:5", "nan_bomb",
+                                    "inflate:1e9"])
+def test_cli_defense_matrix(attack):
+    """Every attack kind against the defended sync path: the run
+    completes finite and near the clean trajectory."""
+    from fedml_tpu.experiments.main import main
+    clean = main(["--algo", "cross_silo"] + _CLI_BASE)
+    defended = main(["--algo", "cross_silo"] + _CLI_BASE
+                    + ["--adversary", f"2:{attack}"] + _DEFENSE)
+    assert np.isfinite(defended["test_loss"])
+    assert defended["test_loss"] <= clean["test_loss"] * 1.15
+
+
+@pytest.mark.slow
+def test_cli_async_defended_under_nan_bomb():
+    from fedml_tpu.experiments.main import main
+    base = ["--algo", "async_fl"] + _CLI_BASE + ["--async_goal", "2"]
+    clean = main(base)
+    defended = main(base + ["--adversary", "2:nan_bomb",
+                            "--robust_agg", "coordinate_median",
+                            "--strikes_to_quarantine", "2"])
+    assert np.isfinite(defended["test_loss"])
+    assert defended["test_loss"] <= clean["test_loss"] * 1.15
