@@ -44,6 +44,7 @@ from fedml_tpu.comm.actors import SelfMessageTimer, ServerManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
 from fedml_tpu.algorithms.cross_silo import MsgType
+from fedml_tpu.core.pytree import HostMirror
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.obs import telemetry
 
@@ -91,7 +92,8 @@ class AsyncFedServerActor(ServerManager):
                  seed: int = 0, checkpointer=None,
                  retask_timeout_s: Optional[float] = None,
                  admission=None,
-                 defended_aggregate: Optional[Callable] = None):
+                 defended_aggregate: Optional[Callable] = None,
+                 encode_once: bool = True):
         """``checkpointer``: a `RoundCheckpointer`; every applied version
         is saved per its ``save_every`` gating and ``start()`` resumes
         from the latest saved version — a crashed async server restarts
@@ -121,7 +123,13 @@ class AsyncFedServerActor(ServerManager):
         robust aggregate (the buffer's sample-weighted mean discount
         scales the applied step), so a Byzantine rule cannot be gamed
         through staleness claims.  When None, the exact legacy
-        sample+discount weighted mean is used."""
+        sample+discount weighted mean is used.
+
+        ``encode_once``: the tasking fan-outs (initial wave, post-version
+        re-task of the consumed silos) ride the transport's ``send_many``
+        — the global serializes once per wave instead of once per silo.
+        Single-silo re-tasks (watchdog nudges, probation releases) keep
+        plain sends."""
         super().__init__(0, transport)
         if not 1 <= aggregation_goal <= n_silos:
             raise ValueError(
@@ -148,6 +156,11 @@ class AsyncFedServerActor(ServerManager):
         self._consumed: set = set()
         self.admission = admission
         self.defended_aggregate = defended_aggregate
+        self.encode_once = encode_once
+        # host mirror of the current global — a tasking wave re-tasks up
+        # to ``goal`` silos against the SAME version, and each used to
+        # pay its own device→host transfer
+        self._host_mirror = HostMirror()
         # quarantined silos we declined to re-task; released on probation
         self._benched: Set[int] = set()
         # (silo, base_version) -> payload crcs already REJECTED — a
@@ -206,9 +219,15 @@ class AsyncFedServerActor(ServerManager):
         # disconnected fragments
         with self._root_span("tasking", f"version{self.version}",
                              version=self.version):
-            for silo, client_idx in enumerate(ids, start=1):
+            assignments = {silo: int(client_idx) for silo, client_idx
+                           in enumerate(ids, start=1)}
+            # stamp only the silos actually tasked: sample_clients caps
+            # the wave at client_num_in_total, and priming the watchdog
+            # clock for an untasked silo would make it re-task silos the
+            # version-0 wave deliberately left idle
+            for silo in assignments:
                 self._last_heard[silo] = now
-                self._task(silo, int(client_idx), MsgType.S2C_INIT)
+            self._task_wave(assignments, MsgType.S2C_INIT)
         self._arm_retask_timer()
 
     # -- liveness watchdog --------------------------------------------------
@@ -253,12 +272,33 @@ class AsyncFedServerActor(ServerManager):
                     self._task(silo, self._next_client())
         self._arm_retask_timer()
 
+    def _host_params(self):
+        return self._host_mirror.get(self.params)
+
     def _task(self, silo: int, client_idx: int, msg_type=MsgType.S2C_SYNC):
-        host_params = jax.tree.map(np.asarray, self.params)
         self.send(msg_type, silo,
-                  **{Message.ARG_MODEL_PARAMS: host_params,
+                  **{Message.ARG_MODEL_PARAMS: self._host_params(),
                      Message.ARG_CLIENT_INDEX: client_idx,
                      Message.ARG_ROUND: self.version})
+
+    def _task_wave(self, assignments: Dict[int, int],
+                   msg_type=MsgType.S2C_SYNC) -> None:
+        """Task several silos against the CURRENT global: one payload
+        serialization for the whole wave (send_many), falling back to
+        per-silo sends when ``encode_once`` is off."""
+        if not assignments:
+            return
+        if not self.encode_once:
+            for silo in sorted(assignments):
+                self._task(silo, assignments[silo], msg_type)
+            return
+        self.send_many(
+            msg_type, sorted(assignments),
+            shared_params={Message.ARG_MODEL_PARAMS: self._host_params(),
+                           Message.ARG_ROUND: self.version},
+            per_receiver_params={
+                silo: {Message.ARG_CLIENT_INDEX: client_idx}
+                for silo, client_idx in assignments.items()})
 
     def _next_client(self) -> int:
         return int(self._task_rng.randint(self.client_num_in_total))
@@ -266,7 +306,7 @@ class AsyncFedServerActor(ServerManager):
     def _checkpoint_state(self) -> dict:
         """Version-state pytree (fixed shapes — doubles as the orbax
         restore template)."""
-        return {"params": jax.tree.map(np.asarray, self.params),
+        return {"params": self._host_params(),
                 "version": np.asarray(self.version, np.int64)}
 
     # -- aggregation -------------------------------------------------------
@@ -501,8 +541,10 @@ class AsyncFedServerActor(ServerManager):
                 self.send(MsgType.S2C_FINISH, silo)
             self.finish()
             return
-        for silo in silos:  # only the consumed silos need new work
-            self._task(silo, self._next_client())
+        # only the consumed silos need new work; assignments draw in
+        # buffer order (the legacy per-silo RNG schedule), the wave then
+        # serializes the new global once for all of them
+        self._task_wave({silo: self._next_client() for silo in silos})
         if self.admission is not None:
             # sweep trust states once per version: transitions expired
             # quarantines to probation and refreshes the

@@ -27,13 +27,14 @@ import time
 from typing import Callable, Dict, Optional, Set
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.comm.actors import (ClientManager, SelfMessageTimer,
                                    ServerManager)
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
-from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.core.pytree import HostMirror, tree_weighted_mean
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.obs import telemetry
 
@@ -155,7 +156,9 @@ class FedAvgServerActor(ServerManager):
                  publish: Optional[Callable] = None,
                  extra_state: Optional[tuple] = None,
                  admission=None,
-                 aggregate_fn: Optional[Callable] = None):
+                 aggregate_fn: Optional[Callable] = None,
+                 encode_once: bool = True,
+                 incremental_staging: bool = True):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -217,6 +220,21 @@ class FedAvgServerActor(ServerManager):
         rule + noise + mean step runs as that one jit — no recompiles
         after round 1.  When None, the legacy exact
         ``tree_weighted_mean`` over the received list is used.
+
+        ``encode_once``: broadcast via the transport's ``send_many`` —
+        the model bytes serialize ONCE per round no matter how many
+        silos are tasked (only the small per-silo header varies).  False
+        restores the seed per-silo encode loop; `scripts/wire_bench.py`
+        measures the two against each other.
+
+        ``incremental_staging``: with an ``aggregate_fn`` set, each
+        admitted upload is copied into its slot of a persistent
+        ``[cohort, ...]`` host staging buffer AT ARRIVAL TIME — staging
+        overlaps the straggler wait, so closing the round does only the
+        H2D transfer + the defended jit instead of a serial O(cohort)
+        ``np.stack`` per leaf at the barrier.  False restores the seed
+        stack-at-the-barrier path (bit-identical results either way;
+        tests/test_wire.py pins the equivalence).
         """
         super().__init__(0, transport)
         if straggler_policy not in ("wait", "drop", "abort"):
@@ -241,8 +259,21 @@ class FedAvgServerActor(ServerManager):
         self.extra_state = extra_state
         self.admission = admission
         self.aggregate_fn = aggregate_fn
+        self.encode_once = encode_once
+        self.incremental_staging = incremental_staging
         self.dropped_silos: Dict[int, list] = {}  # round -> missing silo ids
         self._received: Dict[int, tuple] = {}
+        # per-round host mirror of self.params: the broadcast, checkpoint,
+        # staging fill, and publish paths all read the SAME device→host
+        # transfer instead of re-running jax.tree.map(np.asarray, ...)
+        # up to 3x per round
+        self._host_mirror = HostMirror()
+        # incremental cohort staging (see __init__ docstring): allocated
+        # once at the first admitted upload, slot i-1 belongs to silo i
+        self._staging = None
+        self._staging_leaves: Optional[list] = None
+        self._staging_def = None
+        self._staged: Set[int] = set()
         self._num_silos = 0  # silos contacted this round (= sampled cohort)
         self._expected: Set[int] = set()  # silos the barrier waits on
         self._timer = SelfMessageTimer()
@@ -264,6 +295,7 @@ class FedAvgServerActor(ServerManager):
         self._round_t0: Optional[float] = None
         self._first_upload_t: Optional[float] = None
         self._round_span = None
+        self._g_staged = reg.gauge("fedml_wire_staged_uploads_total")
 
     def register_handlers(self) -> None:
         self.register_handler(MsgType.C2S_MODEL, self._on_model)
@@ -308,8 +340,7 @@ class FedAvgServerActor(ServerManager):
                 if self.extra_state is not None and "extra" in state:
                     self.extra_state[1](state["extra"])
                 if self.publish is not None:
-                    self.publish(jax.tree.map(np.asarray, self.params),
-                                 self.round_idx - 1)
+                    self.publish(self._host_params(), self.round_idx - 1)
                 log.info("resumed from checkpoint: continuing at round %d "
                          "of %d", self.round_idx, self.num_rounds)
         if self.round_idx >= self.num_rounds:
@@ -328,6 +359,12 @@ class FedAvgServerActor(ServerManager):
         return sample_clients(self.round_idx, self.client_num_in_total,
                               self.client_num_per_round)
 
+    def _host_params(self):
+        """The round's host copy of the global, transferred device→host
+        at most once per params value (broadcast, checkpoint, staging
+        fill, and publish all share it)."""
+        return self._host_mirror.get(self.params)
+
     def _checkpoint_state(self, round_idx: int,
                           host_params=None) -> Dict[str, object]:
         """Round-state pytree saved after round ``round_idx`` completes.
@@ -343,7 +380,7 @@ class FedAvgServerActor(ServerManager):
         if self._last_accepted is not None:
             mask[np.asarray(self._last_accepted) - 1] = 1
         if host_params is None:
-            host_params = jax.tree.map(np.asarray, self.params)
+            host_params = self._host_params()
         out = {"params": host_params,
                "round_idx": np.asarray(round_idx, np.int64),
                "accepted_mask": mask}
@@ -394,18 +431,38 @@ class FedAvgServerActor(ServerManager):
                 trace_id=self._tracer.new_trace_id(
                     f"round{self.round_idx}"),
                 round=self.round_idx)
-        host_params = jax.tree.map(np.asarray, self.params)
+        # the new round owns the staging buffer from here: slots will be
+        # rewritten by this round's arrivals (or refilled with the global
+        # at completion), so last round's contents are dead weight now
+        self._staged.clear()
+        self._g_staged.set(0)
+        host_params = self._host_params()
         extra = ({} if self._last_accepted is None
                  else {Message.ARG_ACCEPTED: self._last_accepted})
         with self._span("broadcast", parent=self._round_span,
                         round=self.round_idx):
-            for silo, client_idx in enumerate(ids, start=1):
-                if silo in dead:
-                    continue
-                self.send(msg_type, silo,
-                          **{Message.ARG_MODEL_PARAMS: host_params,
-                             Message.ARG_CLIENT_INDEX: int(client_idx),
-                             Message.ARG_ROUND: self.round_idx, **extra})
+            if self.encode_once:
+                # one payload serialization for the whole cohort: only
+                # the per-silo client assignment varies per frame
+                per_silo = {
+                    silo: {Message.ARG_CLIENT_INDEX: int(client_idx)}
+                    for silo, client_idx in enumerate(ids, start=1)
+                    if silo not in dead}
+                self.send_many(
+                    msg_type, sorted(per_silo),
+                    shared_params={Message.ARG_MODEL_PARAMS: host_params,
+                                   Message.ARG_ROUND: self.round_idx,
+                                   **extra},
+                    per_receiver_params=per_silo)
+            else:
+                # seed path (wire_bench baseline): N full encodes
+                for silo, client_idx in enumerate(ids, start=1):
+                    if silo in dead:
+                        continue
+                    self.send(msg_type, silo,
+                              **{Message.ARG_MODEL_PARAMS: host_params,
+                                 Message.ARG_CLIENT_INDEX: int(client_idx),
+                                 Message.ARG_ROUND: self.round_idx, **extra})
         self._arm_timer()
 
     # -- straggler timer ----------------------------------------------------
@@ -472,8 +529,7 @@ class FedAvgServerActor(ServerManager):
             ids = self._sampled()
             client_idx = int(ids[silo - 1]) if silo - 1 < len(ids) else 0
             self.send(MsgType.S2C_SYNC, silo,
-                      **{Message.ARG_MODEL_PARAMS:
-                         jax.tree.map(np.asarray, self.params),
+                      **{Message.ARG_MODEL_PARAMS: self._host_params(),
                          Message.ARG_CLIENT_INDEX: client_idx,
                          Message.ARG_ROUND: self.round_idx})
 
@@ -571,10 +627,23 @@ class FedAvgServerActor(ServerManager):
                 entry = None
         self._note_upload(msg.sender_id, entry)
 
+    # sentinel entry marker: the upload's bytes already live in the
+    # staging buffer, so the decoded frame (and the wire buffer it views)
+    # can be released immediately instead of held until the barrier
+    _STAGED = object()
+
     def _note_upload(self, silo: int, entry: Optional[tuple]) -> None:
         """Record a silo's report (``None`` = reported-but-inadmissible)
         and close the round when the barrier is satisfied
-        (check_whether_all_receive, FedAvgServerManager.py:51)."""
+        (check_whether_all_receive, FedAvgServerManager.py:51).
+
+        With incremental staging on, an admitted upload is written into
+        its cohort slot HERE — on the receive path, while the round is
+        still waiting on stragglers — so the barrier-close does no
+        per-leaf stacking at all."""
+        if entry is not None and self._staging_active():
+            self._stage(silo, entry[0])
+            entry = (self._STAGED, entry[1])
         self._received[silo] = entry
         if self._expected:
             if not self._expected <= set(self._received):
@@ -582,6 +651,40 @@ class FedAvgServerActor(ServerManager):
         elif len(self._received) < self._num_silos:
             return
         self._complete_round()
+
+    def _staging_active(self) -> bool:
+        return self.aggregate_fn is not None and self.incremental_staging
+
+    def _stage(self, silo: int, upload) -> None:
+        """Copy one admitted upload into staging slot ``silo - 1``."""
+        if self._staging is None:
+            host = self._host_params()
+            n = self._num_silos
+            self._staging_def = jax.tree.structure(host)
+            self._staging = jax.tree.map(
+                lambda l: np.empty((n,) + np.shape(l),
+                                   np.asarray(l).dtype), host)
+            self._staging_leaves = jax.tree.leaves(self._staging)
+        if jax.tree.structure(upload) != self._staging_def:
+            # unreachable with the admission fingerprint armed; without
+            # it this keeps the legacy fail-loudly contract the same way
+            # a mismatched np.stack did
+            raise ValueError(
+                f"silo {silo} upload does not match the global template "
+                f"(treedef mismatch)")
+        for buf, leaf in zip(self._staging_leaves, jax.tree.leaves(upload)):
+            arr = np.asarray(leaf)
+            if arr.dtype != buf.dtype:
+                # slot assignment would silently cast (the seed np.stack
+                # promoted instead, retracing the jit) — a dtype drift is
+                # a malformed upload either way: fail loudly, like every
+                # other template mismatch
+                raise ValueError(
+                    f"silo {silo} upload leaf dtype {arr.dtype} does not "
+                    f"match the global template ({buf.dtype})")
+            buf[silo - 1] = arr
+        self._staged.add(silo)
+        self._g_staged.set(len(self._staged))
 
     def _stack_cohort(self, admitted: Dict[int, tuple]):
         """Stack admitted uploads into the STATIC ``[cohort, ...]`` tree
@@ -603,6 +706,33 @@ class FedAvgServerActor(ServerManager):
             lambda *xs: np.stack([np.asarray(x) for x in xs]), *trees)
         return stacked, w
 
+    def _staged_cohort(self, admitted: Dict[int, tuple]):
+        """The incremental-staging counterpart of `_stack_cohort`: the
+        admitted uploads were already written into their slots at arrival
+        time, so the barrier-close only refills the ABSENT slots (dropped,
+        quarantined, rejected) with the current global — weight 0, the
+        same zero diff every defense masks out.  The buffer keeps the
+        static ``[cohort, ...]`` shape across rounds, so the defended jit
+        still compiles exactly once."""
+        n = self._num_silos
+        if self._staging is None:
+            # every upload this round was rejected before staging; the
+            # caller skips aggregation on an empty admitted set, so this
+            # only triggers when admitted is non-empty but nothing staged
+            # — impossible by construction (_note_upload stages every
+            # admitted entry), kept as a loud invariant
+            raise RuntimeError("staging buffer missing at round close")
+        w = np.zeros(n, np.float32)
+        for silo, (_, num_samples) in admitted.items():
+            w[silo - 1] = num_samples
+        missing = [s for s in range(1, n + 1) if s not in self._staged]
+        if missing:
+            host_leaves = jax.tree.leaves(self._host_params())
+            for buf, leaf in zip(self._staging_leaves, host_leaves):
+                for silo in missing:
+                    buf[silo - 1] = np.asarray(leaf)
+        return self._staging, w
+
     def _complete_round(self) -> None:
         self._cancel_timer()
         now = time.monotonic()
@@ -619,49 +749,52 @@ class FedAvgServerActor(ServerManager):
         # admission-rejected reports ride as None entries: they satisfied
         # the barrier but must not aggregate (and must not be EF-acked)
         admitted = {s: v for s, v in self._received.items() if v is not None}
-        trees = [admitted[s][0] for s in sorted(admitted)]
-        weights = np.array([admitted[s][1] for s in sorted(admitted)],
-                           dtype=np.float32)
         # possibly EMPTY (all uploads rejected) — never None here: None
         # means "no ack info" and EF residual settlement would wrongly
         # assume the rejected uploads were aggregated
         self._last_accepted = np.asarray(sorted(admitted), np.int32)
         self._received.clear()
         with self._span("aggregate", parent=self._round_span,
-                        round=self.round_idx, quorum=len(trees)):
-            if not trees:
+                        round=self.round_idx, quorum=len(admitted)):
+            if not admitted:
                 log.warning("round %d: no admissible uploads; the global "
                             "model is unchanged this round", self.round_idx)
             elif self.aggregate_fn is not None:
-                stacked, w = self._stack_cohort(admitted)
-                self.params = self.aggregate_fn(self.params, stacked, w,
+                if self._staging_active():
+                    stacked, w = self._staged_cohort(admitted)
+                else:
+                    stacked, w = self._stack_cohort(admitted)
+                # normalize the global to device arrays first: round 0's
+                # numpy init and later rounds' jax outputs would otherwise
+                # key TWO jit cache entries (numpy vs committed-array
+                # shardings) — a silent double compile of the defended
+                # aggregate.  jnp.asarray is a no-op on a jax output.
+                dev_params = jax.tree.map(jnp.asarray, self.params)
+                self.params = self.aggregate_fn(dev_params, stacked, w,
                                                 self.round_idx)
             else:
+                trees = [admitted[s][0] for s in sorted(admitted)]
+                weights = np.array([admitted[s][1] for s in sorted(admitted)],
+                                   dtype=np.float32)
                 self.params = tree_weighted_mean(trees, weights)
         if self._round_span is not None:
             self._round_span.end()
             self._round_span = None
-        host_params = None  # one host copy shared by checkpoint + publish
-
-        def _host():
-            nonlocal host_params
-            if host_params is None:
-                host_params = jax.tree.map(np.asarray, self.params)
-            return host_params
 
         if self.checkpointer is not None:
             # thunk: rounds the save_every gate skips pay no device→host
-            # copy and no EF serialization
+            # copy and no EF serialization (_host_params memoizes the
+            # transfer, and the next broadcast reuses the same copy)
             self.checkpointer.maybe_save(
                 self.round_idx,
-                lambda: self._checkpoint_state(self.round_idx,
-                                               host_params=_host()),
+                lambda: self._checkpoint_state(
+                    self.round_idx, host_params=self._host_params()),
                 last_round=self.round_idx + 1 >= self.num_rounds)
         if self.publish is not None:
             # serve-while-train: hand the registry a HOST copy so the
             # serving path never holds references into device buffers the
             # next round's aggregation will donate/overwrite
-            self.publish(_host(), self.round_idx)
+            self.publish(self._host_params(), self.round_idx)
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.params)
         self.round_idx += 1

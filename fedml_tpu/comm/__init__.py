@@ -22,7 +22,7 @@ Differences from the reference, by design:
   gRPC/proto/grpc_comm_manager.proto:3-16).
 """
 
-from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.message import Message, SharedPayload, build_fanout
 from fedml_tpu.comm.transport import Observer, Transport
 from fedml_tpu.comm.local import LocalHub, LocalTransport
 from fedml_tpu.comm.actors import NodeManager, ClientManager, ServerManager
@@ -31,7 +31,8 @@ from fedml_tpu.comm.chaos import (ChaosPlan, ChaosTransport, LinkChaos,
 from fedml_tpu.comm.resilient import ResilientTransport, RetryPolicy
 
 __all__ = [
-    "Message", "Observer", "Transport", "LocalHub", "LocalTransport",
+    "Message", "SharedPayload", "build_fanout",
+    "Observer", "Transport", "LocalHub", "LocalTransport",
     "NodeManager", "ClientManager", "ServerManager",
     "ChaosPlan", "ChaosTransport", "LinkChaos", "Partition",
     "ResilientTransport", "RetryPolicy",

@@ -20,9 +20,9 @@ import logging
 import threading
 from typing import Callable, Dict
 
-from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.message import Message, build_fanout
 from fedml_tpu.comm.transport import Transport
-from fedml_tpu.obs import trace
+from fedml_tpu.obs import telemetry, trace
 
 log = logging.getLogger(__name__)
 
@@ -106,6 +106,8 @@ class NodeManager(abc.ABC):
         self.transport.add_observer(self)
         self._handlers: Dict[object, Callable[[Message], None]] = {}
         self._tracer = trace.get_tracer()
+        self._m_fanout = telemetry.get_registry().counter(
+            "fedml_wire_fanout_total")
 
     def _span(self, name: str, **kw):
         """A span context-manager on this node's track, or a null context
@@ -164,6 +166,23 @@ class NodeManager(abc.ABC):
             if ctx is not None:
                 trace.inject(msg, ctx)
         self.transport.send_message(msg)
+
+    def send_many(self, msg_type, receivers, shared_params=None,
+                  per_receiver_params=None) -> None:
+        """Encode-once fan-out: serialize ``shared_params`` a single time
+        and deliver one message per receiver, varying only the small
+        per-receiver header (``per_receiver_params[r]``).  The trace
+        context rides each receiver's own header, so per-silo recv spans
+        stitch exactly as with single sends."""
+        messages = build_fanout(msg_type, self.node_id, receivers,
+                                shared_params, per_receiver_params)
+        if self._tracer is not None:
+            ctx = self._tracer.current_context()
+            if ctx is not None:
+                for msg in messages:
+                    trace.inject(msg, ctx)
+        self._m_fanout.inc(len(messages))
+        self.transport.send_many(messages)
 
     def finish(self) -> None:
         self.transport.stop()

@@ -256,6 +256,13 @@ class ChaosTransport(Transport):
         if held is not None:
             self._deliver(held)
 
+    # send_many (inherited): each fan-out sibling passes through here on
+    # its own link, drawing the same per-link fixed-size schedule as a
+    # single send — historical chaos seeds replay unchanged.  A corrupted
+    # sibling is REBUILT by _corrupt_payload as a fresh Message with no
+    # shared-payload attachment, so its damaged frame re-encodes privately
+    # and can never leak into a sibling's copy of the shared block.
+
     def send_message(self, msg: Message) -> None:
         if msg.type in self.plan.immune_types:
             self._deliver(msg)

@@ -26,6 +26,7 @@ from typing import Dict
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
+from fedml_tpu.obs import telemetry
 
 log = logging.getLogger(__name__)
 
@@ -44,14 +45,20 @@ class GrpcTransport(Transport):
     def __init__(self, node_id: int, ip_table: Dict[int, str],
                  base_port: int = 50000, max_message_mb: int = 1000,
                  send_timeout_s: float = 120.0,
-                 idle_timeout_s: float = 0.0):
+                 idle_timeout_s: float = 0.0,
+                 workers: int = 4):
         """``send_timeout_s`` bounds each unary send; sends also set
         ``wait_for_ready`` so a broadcast to a peer that is still booting
         blocks until its server binds instead of failing UNAVAILABLE (the
         reference has the same race and papers over it with sleep-ordered
         launches).  ``idle_timeout_s`` > 0 makes ``run()`` return after that
         long with no traffic — without it a silo whose server died leaks
-        forever in the receive loop."""
+        forever in the receive loop.  ``workers`` sizes the inbound RPC
+        thread pool (the server node of a wide federation should raise it
+        with the cohort — ``--grpc_workers``); ``max_message_mb`` is the
+        reference's 100 MB cap made configurable (``--grpc_max_message_mb``),
+        and sends log a loud warning at 80% of it instead of surfacing a
+        bare RESOURCE_EXHAUSTED from deep inside the channel."""
         super().__init__()
         import grpc  # deferred: optional at import time of the package
         self._grpc = grpc
@@ -60,13 +67,28 @@ class GrpcTransport(Transport):
         self.base_port = base_port
         self._inbox: "queue.Queue" = queue.Queue()
         self._channels: Dict[int, object] = {}
+        self._max_message_bytes = max_message_mb * 1024 * 1024
+        self._warned_large = False
+        reg = telemetry.get_registry()
+        self._m_torn = reg.counter("fedml_wire_torn_frames_total")
 
-        opts = [("grpc.max_send_message_length", max_message_mb * 1024 * 1024),
-                ("grpc.max_receive_message_length", max_message_mb * 1024 * 1024)]
+        opts = [("grpc.max_send_message_length", self._max_message_bytes),
+                ("grpc.max_receive_message_length", self._max_message_bytes)]
         inbox = self._inbox
+        torn = self._m_torn
 
         def _handle_send(request: bytes, context) -> bytes:
-            inbox.put(Message.from_bytes(request))
+            try:
+                msg = Message.from_bytes(request)
+            except ValueError as exc:
+                # a torn/corrupt frame is dropped like a lost packet — it
+                # must never kill the receive path (the sender's retry or
+                # the round's straggler policy owns recovery)
+                torn.inc()
+                log.warning("node %d: dropping undecodable %d-byte frame: "
+                            "%s", node_id, len(request), exc)
+                return b""
+            inbox.put(msg)
             return b""
 
         rpc = grpc.unary_unary_rpc_method_handler(
@@ -75,7 +97,7 @@ class GrpcTransport(Transport):
         handler = grpc.method_handlers_generic_handler(_SERVICE, {_METHOD: rpc})
         import concurrent.futures
         self._server = grpc.server(
-            concurrent.futures.ThreadPoolExecutor(max_workers=4),
+            concurrent.futures.ThreadPoolExecutor(max_workers=workers),
             handlers=(handler,), options=opts)
         self._port = self._server.add_insecure_port(
             f"[::]:{base_port + node_id}")
@@ -109,7 +131,19 @@ class GrpcTransport(Transport):
             return self._channels[receiver_id][1]
 
     def send_message(self, msg: Message) -> None:
+        # to_bytes reuses the fan-out's shared block when one is attached
+        # (send_many): per receiver this is one small header encode + one
+        # memcpy, never a re-serialization of the model bytes
         data = msg.to_bytes()
+        if len(data) > 0.8 * self._max_message_bytes \
+                and not self._warned_large:
+            self._warned_large = True  # once per transport, not per silo
+            log.warning(
+                "node %d: encoded frame is %.1f MB — over 80%% of the "
+                "%.0f MB gRPC message limit; raise --grpc_max_message_mb "
+                "before this surfaces as RESOURCE_EXHAUSTED",
+                self.node_id, len(data) / 1e6,
+                self._max_message_bytes / 1e6)
         self._obs_send(msg, len(data))
         self._stub(msg.receiver_id)(
             data, wait_for_ready=True,

@@ -48,15 +48,22 @@ class LocalHub:
 
     def route(self, msg: Message) -> None:
         if self.codec_roundtrip:
-            data = msg.to_bytes()
+            # encode-once fan-out (send_many): the shared payload was
+            # serialized once for the whole broadcast — roundtrip this
+            # receiver's frame from its PARTS (small header + a view of
+            # the shared block) so the hub neither re-encodes nor even
+            # assembles a contiguous copy per receiver
+            parts = msg.frame_parts()
+            nbytes = sum(len(p) if isinstance(p, (bytes, bytearray))
+                         else p.nbytes for p in parts)
             if self._reg.enabled:
                 # the codec roundtrip IS this hub's wire: report its frame
                 # size like a real transport reports socket bytes
                 telemetry.link_counter(
                     self._reg, self._link_bytes,
                     "fedml_comm_wire_bytes_total",
-                    msg.sender_id, msg.receiver_id).inc(len(data))
-            msg = Message.from_bytes(data)
+                    msg.sender_id, msg.receiver_id).inc(nbytes)
+            msg = Message.from_frame_parts(parts)
         target = self._endpoints.get(msg.receiver_id)
         if target is None:
             raise KeyError(f"no endpoint for receiver {msg.receiver_id}")

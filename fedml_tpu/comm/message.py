@@ -14,18 +14,138 @@ Here a message serializes to one frame::
 Array-valued params (numpy arrays, JAX arrays, and arbitrary pytrees of them)
 are flattened; the header records the treedef, dtypes, and shapes; buffers are
 the arrays' raw bytes.  Scalars/strings/lists of plain python stay in the
-JSON header.  Decode is zero-copy ``np.frombuffer`` per leaf.
+JSON header.
+
+Copy discipline (the wire hot path — see README "Wire format & round hot
+path" for the per-round inventory):
+
+* **encode** — each contiguous leaf is copied exactly ONCE, straight into
+  the output frame (``b"".join`` over memoryviews of the source arrays; the
+  old path paid ``arr.tobytes()`` + join = two copies per leaf).  A
+  non-contiguous leaf pays one extra ``ascontiguousarray`` copy.
+* **decode** — ``from_bytes`` takes read-only ``memoryview`` slices of the
+  inbound frame and ``np.frombuffer``s each leaf in place: zero copies, and
+  every decoded array is READ-ONLY (frames are immutable — the robust
+  admission pipeline screens them as delivered, so nothing downstream may
+  mutate a decoded leaf in place).  Decoded leaves keep the whole frame
+  buffer alive; model-sized payloads dominate their frame, so retention is
+  ~1x.
+* **fan-out** — `SharedPayload` serializes a payload ONCE for a whole
+  broadcast; each receiver's frame varies only the small JSON header.  Wire
+  transports that must hand the kernel one contiguous buffer (gRPC) pay a
+  single memcpy of the shared block per receiver; the in-process hub decodes
+  straight from the parts (`Message.from_frame_parts`) and pays none.
+
+A torn or truncated frame raises ``ValueError`` from every decode entry
+point — transports catch it, count ``fedml_wire_torn_frames_total``, and
+drop the frame instead of letting a corrupt wire kill a receive thread.
+
+``CODEC_COUNTS`` is the test/bench spy: it counts payload serializations
+(the expensive array-section encodes) and per-leaf byte copies, so
+`scripts/wire_bench.py` reports measured copy inventories and
+tests/test_wire.py pins "send_many serializes the shared payload exactly
+once" without reaching into private state.
 """
 
 from __future__ import annotations
 
 import json
 import struct
-from typing import Any, Dict
+import time
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from fedml_tpu.obs import telemetry
+
 _HDR = struct.Struct("<I")
+
+# codec spy counters (module-global, monotonically increasing):
+#   payload_encodes — array-section serializations (one per to_bytes with
+#                     array params; ONE per SharedPayload regardless of
+#                     fan-out width)
+#   payload_decodes — array-section decodes
+#   leaf_copies     — per-leaf byte copies paid while encoding (1 per
+#                     contiguous leaf, 2 for a non-contiguous one)
+CODEC_COUNTS = {"payload_encodes": 0, "payload_decodes": 0, "leaf_copies": 0}
+
+
+def _encode_params(params: Dict[str, Any], idx_offset: int = 0):
+    """Serialize the array half of ``params``.
+
+    Returns ``(header, buffers, n_buffers)`` where ``header`` is the
+    JSON-able ``{"plain": ..., "arrays": ...}`` dict (buffer indices start
+    at ``idx_offset``), and ``buffers`` is the flat ``[len-prefix,
+    memoryview, ...]`` part list whose concatenation is the frame's buffer
+    section — each part a view into the SOURCE array, so the single copy
+    per leaf happens where the caller materializes the frame.
+    """
+    header: Dict[str, Any] = {"plain": {}, "arrays": {}}
+    parts: List[Any] = []
+    n_buffers = 0
+    for key, value in params.items():
+        leaves, spec = _flatten_arrays(value)
+        if leaves is None:
+            header["plain"][key] = value
+        else:
+            descr = []
+            for leaf in leaves:
+                src = np.asarray(leaf)
+                arr = np.ascontiguousarray(src)
+                if arr is not src:
+                    CODEC_COUNTS["leaf_copies"] += 1
+                CODEC_COUNTS["leaf_copies"] += 1  # the copy into the frame
+                # ascontiguousarray promotes 0-d to shape (1,) — record
+                # the ORIGINAL shape so 0-d leaves round-trip exactly
+                descr.append({"dtype": arr.dtype.str, "shape": src.shape,
+                              "idx": idx_offset + n_buffers})
+                parts.append(_HDR.pack(arr.nbytes))
+                # empty leaves cannot be cast to a flat byte view
+                parts.append(memoryview(arr).cast("B") if arr.nbytes
+                             else b"")
+                n_buffers += 1
+            header["arrays"][key] = {"spec": spec, "leaves": descr}
+    if n_buffers:
+        CODEC_COUNTS["payload_encodes"] += 1
+    return header, parts, n_buffers
+
+
+def _freeze_parts(parts: List[Any]) -> bytearray:
+    """Materialize an ``_encode_params`` part list into one preallocated
+    buffer (the single copy per leaf)."""
+    total = sum(len(p) if isinstance(p, bytes) else p.nbytes for p in parts)
+    block = bytearray(total)
+    mv = memoryview(block)
+    off = 0
+    for p in parts:
+        n = len(p) if isinstance(p, bytes) else p.nbytes
+        mv[off:off + n] = p
+        off += n
+    return block
+
+
+def _parse_buffer_stream(mv: memoryview, buffers: List[memoryview]) -> None:
+    """Walk one ``[4-byte len][raw bytes]...`` stream, appending read-only
+    views.  Raises ``ValueError`` on a torn/truncated stream."""
+    offset, end = 0, len(mv)
+    while offset < end:
+        if offset + _HDR.size > end:
+            raise ValueError(
+                f"torn frame: {end - offset} trailing bytes where a "
+                f"{_HDR.size}-byte buffer length was expected")
+        (n,) = _HDR.unpack_from(mv, offset)
+        offset += _HDR.size
+        if offset + n > end:
+            raise ValueError(
+                f"truncated frame: buffer {len(buffers)} declares {n} "
+                f"bytes but only {end - offset} remain")
+        buffers.append(mv[offset:offset + n])
+        offset += n
+
+
+def _readonly(data) -> memoryview:
+    mv = data if isinstance(data, memoryview) else memoryview(data)
+    return mv if mv.readonly else mv.toreadonly()
 
 
 class Message:
@@ -53,6 +173,10 @@ class Message:
             self.ARG_SENDER: sender_id,
             self.ARG_RECEIVER: receiver_id,
         }
+        # encode-once fan-out: build_fanout() points every sibling of a
+        # broadcast at ONE SharedPayload, and to_bytes() reuses its
+        # already-serialized block instead of re-encoding the model bytes
+        self._shared: Optional["SharedPayload"] = None
 
     # -- accessors (reference message.py:26-60) ------------------------------
     @property
@@ -82,50 +206,229 @@ class Message:
 
     # -- binary codec --------------------------------------------------------
     def to_bytes(self) -> bytes:
-        header: Dict[str, Any] = {"plain": {}, "arrays": {}}
-        buffers = []
-        for key, value in self.params.items():
-            leaves, spec = _flatten_arrays(value)
-            if leaves is None:
-                header["plain"][key] = value
-            else:
-                descr = []
-                for leaf in leaves:
-                    src = np.asarray(leaf)
-                    arr = np.ascontiguousarray(src)
-                    # ascontiguousarray promotes 0-d to shape (1,) — record
-                    # the ORIGINAL shape so 0-d leaves round-trip exactly
-                    descr.append({"dtype": arr.dtype.str, "shape": src.shape,
-                                  "idx": len(buffers)})
-                    buffers.append(arr)
-                header["arrays"][key] = {"spec": spec, "leaves": descr}
+        """One frame: header + buffer stream (byte-identical to the
+        historical format — old/new nodes interoperate, and chaos-replay
+        seeds keyed on frame sizes stay valid).  Each contiguous array
+        leaf is copied exactly once, by the final join."""
+        shared = self._shared
+        if shared is not None:
+            return shared.frame_bytes(self)
+        t0 = time.perf_counter()
+        header, parts, n_buffers = _encode_params(self.params)
         hdr = json.dumps(header).encode()
-        parts = [_HDR.pack(len(hdr)), hdr]
-        for arr in buffers:
-            parts.append(_HDR.pack(arr.nbytes))
-            parts.append(arr.tobytes())
-        return b"".join(parts)
+        frame = b"".join([_HDR.pack(len(hdr)), hdr] + parts)
+        if n_buffers:
+            _observe_encode(time.perf_counter() - t0)
+        return frame
+
+    def frame_parts(self) -> List[Any]:
+        """The frame as a list of buffer segments (zero-copy where a
+        shared payload is attached) — for transports that can scatter
+        instead of joining.  ``b"".join(map(bytes, parts))`` is always
+        byte-identical to ``to_bytes()``."""
+        shared = self._shared
+        if shared is not None:
+            return shared.frame_parts(self)
+        return [self.to_bytes()]
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Message":
-        (hlen,) = _HDR.unpack_from(data, 0)
-        header = json.loads(data[_HDR.size:_HDR.size + hlen])
-        offset = _HDR.size + hlen
-        buffers = []
-        while offset < len(data):
-            (n,) = _HDR.unpack_from(data, offset)
-            offset += _HDR.size
-            buffers.append(data[offset:offset + n])
-            offset += n
+    def from_bytes(cls, data) -> "Message":
+        """Zero-copy decode: array leaves are read-only views into
+        ``data``.  Raises ``ValueError`` for any torn, truncated, or
+        structurally damaged frame — callers on receive threads catch it
+        and drop the frame (counting ``fedml_wire_torn_frames_total``)."""
+        mv = _readonly(data)
+        if len(mv) < _HDR.size:
+            raise ValueError(
+                f"truncated frame: {len(mv)} bytes is shorter than the "
+                f"{_HDR.size}-byte header length")
+        (hlen,) = _HDR.unpack_from(mv, 0)
+        if _HDR.size + hlen > len(mv):
+            raise ValueError(
+                f"truncated frame: header declares {hlen} bytes but only "
+                f"{len(mv) - _HDR.size} follow")
+        header = cls._parse_header(mv[_HDR.size:_HDR.size + hlen])
+        buffers: List[memoryview] = []
+        _parse_buffer_stream(mv[_HDR.size + hlen:], buffers)
+        return cls._from_header(header, buffers)
+
+    @classmethod
+    def from_frame_parts(cls, parts) -> "Message":
+        """Decode a `frame_parts` segment list without materializing one
+        contiguous frame: segment 0 is ``[hdr len][hdr][buffers...]``,
+        later segments are pure buffer streams."""
+        mv0 = _readonly(parts[0])
+        if len(mv0) < _HDR.size:
+            raise ValueError("truncated frame: empty header segment")
+        (hlen,) = _HDR.unpack_from(mv0, 0)
+        if _HDR.size + hlen > len(mv0):
+            raise ValueError("truncated frame: header crosses segments")
+        header = cls._parse_header(mv0[_HDR.size:_HDR.size + hlen])
+        buffers: List[memoryview] = []
+        _parse_buffer_stream(mv0[_HDR.size + hlen:], buffers)
+        for part in parts[1:]:
+            _parse_buffer_stream(_readonly(part), buffers)
+        return cls._from_header(header, buffers)
+
+    @staticmethod
+    def _parse_header(mv: memoryview) -> dict:
+        try:
+            header = json.loads(bytes(mv))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise ValueError(f"undecodable frame header: {exc}") from exc
+        if (not isinstance(header, dict)
+                or not isinstance(header.get("plain"), dict)
+                or not isinstance(header.get("arrays"), dict)):
+            raise ValueError("malformed frame header: expected "
+                             "{'plain': {...}, 'arrays': {...}}")
+        return header
+
+    @classmethod
+    def _from_header(cls, header: dict, buffers: List[memoryview]):
         msg = cls.__new__(cls)
+        msg._shared = None
         msg.params = dict(header["plain"])
+        decoded_payload = False
         for key, info in header["arrays"].items():
             leaves = []
-            for d in info["leaves"]:
-                arr = np.frombuffer(buffers[d["idx"]], dtype=np.dtype(d["dtype"]))
-                leaves.append(arr.reshape(d["shape"]))
-            msg.params[key] = _unflatten_arrays(info["spec"], leaves)
+            try:
+                descr = info["leaves"]
+            except (TypeError, KeyError) as exc:
+                raise ValueError(f"malformed array header for {key!r}") \
+                    from exc
+            for d in descr:
+                try:
+                    idx, dtype, shape = d["idx"], d["dtype"], d["shape"]
+                except (TypeError, KeyError) as exc:
+                    raise ValueError(
+                        f"malformed leaf descriptor for {key!r}") from exc
+                if not isinstance(idx, int) or not 0 <= idx < len(buffers):
+                    raise ValueError(
+                        f"frame header references buffer {idx!r} but only "
+                        f"{len(buffers)} arrived")
+                try:
+                    arr = np.frombuffer(buffers[idx], dtype=np.dtype(dtype))
+                    leaves.append(arr.reshape(shape))
+                except (TypeError, ValueError) as exc:
+                    raise ValueError(
+                        f"buffer {idx} does not match its declared "
+                        f"dtype/shape ({dtype}, {shape}): {exc}") from exc
+            decoded_payload = decoded_payload or bool(descr)
+            try:
+                msg.params[key] = _unflatten_arrays(info["spec"], leaves)
+            except (TypeError, KeyError, IndexError) as exc:
+                raise ValueError(
+                    f"malformed pytree spec for {key!r}") from exc
+        if decoded_payload:
+            CODEC_COUNTS["payload_decodes"] += 1
         return msg
+
+
+class SharedPayload:
+    """Encode-once payload for a transport fan-out (``send_many``).
+
+    The expensive serialization — flattening the pytree and copying every
+    array leaf — runs ONCE, here, into one immutable block.  Each
+    receiver's frame is then ``[hdr][shared block][own block]``: only the
+    small JSON header (and any receiver-private params, e.g. the trace
+    context or ``client_idx``) varies per receiver.  The shared block is
+    never mutated after construction, so a wrapper that damages one
+    receiver's payload (chaos ``corrupt``) must — and does — drop its
+    message's reference to this object and re-encode its own copy.
+    """
+
+    def __init__(self, params: Dict[str, Any]):
+        self.keys = frozenset(params)
+        self.params = dict(params)
+        t0 = time.perf_counter()
+        self._header, parts, self._n_buffers = _encode_params(params)
+        self._block = _freeze_parts(parts)
+        # the arrays section (one descriptor per leaf — the bulk of a big
+        # model's header) is identical for every receiver: serialize its
+        # JSON once so each receiver's header costs only its few plain
+        # keys, keeping fan-out cost flat in BOTH payload and leaf count
+        self._arrays_json = json.dumps(self._header["arrays"]).encode()
+        if self._n_buffers:
+            _observe_encode(time.perf_counter() - t0)
+
+    @property
+    def nbytes(self) -> int:
+        return len(self._block)
+
+    def _header_and_own(self, msg: Message):
+        own = {k: v for k, v in msg.params.items() if k not in self.keys}
+        hdr_own, own_parts, _ = _encode_params(own,
+                                               idx_offset=self._n_buffers)
+        plain = {**self._header["plain"], **hdr_own["plain"]}
+        if not hdr_own["arrays"]:
+            # splice the cached arrays JSON around this receiver's plain
+            # keys — same document shape json.dumps would produce
+            hdr = (b'{"plain": ' + json.dumps(plain).encode()
+                   + b', "arrays": ' + self._arrays_json + b'}')
+            return hdr, own_parts
+        header = {"plain": plain,
+                  "arrays": {**self._header["arrays"], **hdr_own["arrays"]}}
+        return json.dumps(header).encode(), own_parts
+
+    def frame_bytes(self, msg: Message) -> bytes:
+        """A standalone contiguous frame for single-buffer wires (gRPC,
+        MQTT): one memcpy of the already-encoded shared block, no
+        re-serialization."""
+        hdr, own_parts = self._header_and_own(msg)
+        return b"".join([_HDR.pack(len(hdr)), hdr, self._block] + own_parts)
+
+    def frame_parts(self, msg: Message) -> List[Any]:
+        """The zero-copy form: ``[prefix, shared-block view, own...]`` —
+        the shared block is not copied at all (the in-process hub decodes
+        straight from the view)."""
+        hdr, own_parts = self._header_and_own(msg)
+        parts: List[Any] = [_HDR.pack(len(hdr)) + hdr,
+                            memoryview(self._block).toreadonly()]
+        if own_parts:
+            parts.append(bytes(_freeze_parts(own_parts)))
+        return parts
+
+
+def build_fanout(msg_type, sender_id: int, receivers,
+                 shared_params: Optional[Dict[str, Any]] = None,
+                 per_receiver_params: Optional[Dict[int, Dict[str, Any]]]
+                 = None) -> List[Message]:
+    """Build one `Message` per receiver, all sharing ONE encoded payload.
+
+    ``shared_params`` (the model bytes, round tag, EF ack) serialize once;
+    ``per_receiver_params[r]`` (e.g. ``client_idx``) ride each receiver's
+    JSON header.  Every message also carries the shared params in
+    ``msg.params`` BY REFERENCE, so in-process delivery and wrappers that
+    inspect payloads (chaos corrupt, observers) see a normal message.
+
+    The two key sets must be disjoint: a per-receiver override of a
+    shared key would be honored by in-process delivery but dropped from
+    the wire frame (the shared block is immutable), a silent
+    backend-dependent divergence — so it is rejected here instead.
+    """
+    shared = SharedPayload(shared_params or {})
+    per_receiver_params = per_receiver_params or {}
+    for receiver, own in per_receiver_params.items():
+        clash = shared.keys & set(own)
+        if clash:
+            raise ValueError(
+                f"per-receiver params for {receiver} override shared "
+                f"keys {sorted(clash)}; shared-payload values cannot "
+                f"vary per receiver — send those keys per-receiver only")
+    out = []
+    for receiver in receivers:
+        msg = Message(msg_type, sender_id, receiver)
+        msg.params.update(shared.params)
+        msg.params.update(per_receiver_params.get(receiver, {}))
+        msg._shared = shared
+        out.append(msg)
+    return out
+
+
+def _observe_encode(seconds: float) -> None:
+    reg = telemetry.get_registry()
+    if reg.enabled:
+        reg.histogram("fedml_wire_encode_seconds").observe(seconds)
 
 
 def _is_array(x) -> bool:

@@ -23,10 +23,14 @@ only ever ran manually (mqtt_comm_manager.py has no test).
 
 from __future__ import annotations
 
+import logging
 import queue
 
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.transport import Transport
+from fedml_tpu.obs import telemetry
+
+log = logging.getLogger(__name__)
 
 try:
     import paho.mqtt.client as _mqtt
@@ -49,6 +53,8 @@ class MqttTransport(Transport):
         self.broker_port = broker_port
         self._inbox: "queue.Queue" = queue.Queue()
         self._stopped = False
+        self._m_torn = telemetry.get_registry().counter(
+            "fedml_wire_torn_frames_total")
         cid = f"{topic_prefix}_{node_id}"
         if not HAVE_MQTT:
             # no paho: the in-repo MQTT 3.1.1 client speaks the same wire
@@ -73,9 +79,21 @@ class MqttTransport(Transport):
         return f"{self.topic_prefix}/{node_id}"
 
     def _on_message(self, client, userdata, mqtt_msg) -> None:
-        self._inbox.put(Message.from_bytes(mqtt_msg.payload))
+        try:
+            msg = Message.from_bytes(mqtt_msg.payload)
+        except ValueError as exc:
+            # a torn frame must not kill the broker callback thread: drop
+            # it like a lost publish and let the round policy recover
+            self._m_torn.inc()
+            log.warning("node %d: dropping undecodable %d-byte frame from "
+                        "%s: %s", self.node_id, len(mqtt_msg.payload),
+                        mqtt_msg.topic, exc)
+            return
+        self._inbox.put(msg)
 
     def send_message(self, msg: Message) -> None:
+        # shared-aware: a send_many sibling reuses the fan-out's encoded
+        # block (one header encode + one memcpy per receiver)
         data = msg.to_bytes()
         self._obs_send(msg, len(data))
         self._client.publish(self._topic(msg.receiver_id), data, qos=1)

@@ -120,6 +120,12 @@ class ResilientTransport(Transport):
         self.inner.remove_observer(observer)
 
     # -- send path -----------------------------------------------------------
+    # send_many (inherited): each fan-out sibling is enqueued as its own
+    # message, so per-link retry/backoff/dead-letter semantics are exactly
+    # the single-send ones — one silo's flaky channel retries alone while
+    # its siblings proceed.  The shared payload rides every sibling as an
+    # already-encoded block, so retries never re-serialize the model bytes.
+
     def send_message(self, msg: Message) -> None:
         if self._stopped:
             # the sender thread is gone; an enqueue would vanish silently —

@@ -78,6 +78,23 @@ class Transport(abc.ABC):
     def send_message(self, msg: Message) -> None:
         """Deliver msg to msg.receiver_id (asynchronously)."""
 
+    def send_many(self, messages: list) -> None:
+        """Deliver a fan-out built by `message.build_fanout`: N messages
+        sharing ONE already-serialized payload (`SharedPayload`), so the
+        expensive model-bytes encode ran exactly once no matter how many
+        silos the broadcast reaches.
+
+        The default delegates to ``send_message`` per receiver — which is
+        the correct semantics for every flavor AND every wrapper:
+        `ResilientTransport` queues/retries each link independently,
+        `ChaosTransport` draws each link's fault schedule exactly as for
+        a single send (replay seeds stay valid), and wire transports'
+        ``to_bytes`` transparently reuses the shared block.  Override
+        only to exploit a wire that can address multiple receivers in
+        one operation."""
+        for msg in messages:
+            self.send_message(msg)
+
     @abc.abstractmethod
     def run(self) -> None:
         """Block dispatching inbound messages to observers until stopped."""

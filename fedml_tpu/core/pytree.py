@@ -104,3 +104,27 @@ def tree_weighted_psum_mean(local_tree: Pytree, local_weight: jax.Array,
         lambda x: jax.lax.psum(x * ratio.astype(x.dtype), axis_name),
         local_tree,
     )
+
+
+class HostMirror:
+    """Identity-keyed memo of a pytree's device→host copy.
+
+    The server actors read the global's host form several times per round
+    (broadcast payload, checkpoint state, staging refill, serve publish);
+    this keeps ONE ``np.asarray`` transfer per distinct params value —
+    the mirror invalidates when the params OBJECT is replaced, which is
+    how every aggregation path produces a new global.  Do not mutate a
+    mirrored tree's leaves in place.
+    """
+
+    __slots__ = ("_key", "_host")
+
+    def __init__(self):
+        self._key = self._host = None
+
+    def get(self, params: Pytree) -> Pytree:
+        if self._host is None or self._key is not params:
+            import numpy as np
+            self._key = params
+            self._host = jax.tree.map(np.asarray, params)
+        return self._host

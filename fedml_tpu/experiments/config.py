@@ -95,6 +95,12 @@ class ExperimentConfig:
     node_id: int = 0                     # grpc: 0=server, 1..N=silos
     ip_config: str = ""                  # grpc: rank→IP csv (reference fmt)
     base_port: int = 50000               # grpc: port = base_port + node_id
+    grpc_max_message_mb: int = 1000      # grpc: per-message size cap (sends
+    #                                      warn loudly at 80% of it instead
+    #                                      of a bare RESOURCE_EXHAUSTED)
+    grpc_workers: int = 4                # grpc: inbound RPC thread pool —
+    #                                      raise with the cohort on the
+    #                                      server node
     straggler_policy: str = "wait"       # wait | drop | abort
     round_timeout_s: float = 0.0         # 0 = no straggler timer
     min_silo_frac: float = 0.5           # drop-policy quorum

@@ -941,7 +941,9 @@ def run_cross_silo(cfg, data, mesh, sink):
                      else {i: "127.0.0.1" for i in range(n_silos + 1)})
             transport = GrpcTransport(cfg.node_id, table,
                                       base_port=cfg.base_port,
-                                      idle_timeout_s=cfg.silo_idle_timeout_s)
+                                      max_message_mb=cfg.grpc_max_message_mb,
+                                      idle_timeout_s=cfg.silo_idle_timeout_s,
+                                      workers=cfg.grpc_workers)
             if cfg.silo_retries > 0:
                 # production posture: retried, backed-off, dead-lettered
                 # sends with channel re-dial between attempts

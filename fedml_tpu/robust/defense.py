@@ -42,7 +42,7 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
                             byz_f: int = 0, krum_m: int = 1,
                             gm_iters: int = 8, gm_eps: float = 1e-6,
                             norm_clip: float = 0.0, noise_std: float = 0.0,
-                            seed: int = 0) -> Callable:
+                            seed: int = 0, donate="auto") -> Callable:
     """Build the jitted ``fn(global_params, stacked, weights, step) ->
     new_params`` the server actors call once per round/version.
 
@@ -54,6 +54,16 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
     scalar, so varying it never recompiles.  The returned function is a
     single jit — tests pin ``fn._cache_size() == 1`` after a full run
     (no per-round recompiles, the acceptance criterion).
+
+    ``donate``: donate the ``stacked`` cohort argument's device buffer to
+    XLA — the round's H2D transfer of the staged cohort is reused for the
+    aggregation's temporaries instead of allocating a second model-sized
+    HBM block every round.  The host staging buffer itself is unaffected
+    (a numpy argument is copied to the device before donation applies).
+    ``"auto"`` enables it off-CPU only: CPU backends warn-and-ignore
+    donation on every call, and the sync/async servers both pass numpy
+    cohorts, so there is nothing to reuse there anyway.  Donation never
+    adds a trace — the jit-once pin holds with it on or off.
     """
     if method not in ROBUST_AGG_METHODS:
         raise ValueError(f"unknown robust aggregation method {method!r}; "
@@ -80,4 +90,6 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
             out = add_gaussian_noise(out, key, noise_std)
         return out
 
-    return jax.jit(_aggregate)
+    if donate == "auto":
+        donate = jax.default_backend() != "cpu"
+    return jax.jit(_aggregate, donate_argnums=(1,) if donate else ())

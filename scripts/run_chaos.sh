@@ -11,7 +11,14 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# encode-once wire path under faults: the smoke bench drives a real
+# federation through dup/reorder/corrupt chaos with the admission screen
+# armed.  Smoke output goes to /tmp — the committed BENCH_wire.json is
+# the FULL bench's artifact and must not be overwritten by smoke numbers.
+env JAX_PLATFORMS=cpu python scripts/wire_bench.py --smoke \
+    --out /tmp/BENCH_wire_smoke.json
+
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_resilient.py tests/test_recovery.py \
-    tests/test_robust_round.py \
+    tests/test_robust_round.py tests/test_wire.py \
     -q -p no:cacheprovider "$@"

@@ -18,5 +18,6 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 [ -f tests/test_serve.py ]         # fast tier must include the serve suite
 [ -f tests/test_robust_round.py ]  # ...and the payload-defense suite
+[ -f tests/test_wire.py ]          # ...and the encode-once wire suite
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

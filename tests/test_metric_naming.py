@@ -59,6 +59,9 @@ EXPECTED = {
     "fedml_robust_update_norm_total", "fedml_robust_strikes_total",
     "fedml_robust_quarantine_events_total",
     "fedml_robust_quarantined_total",
+    # PR 5: the encode-once wire path (comm/message.py, actors, staging)
+    "fedml_wire_encode_seconds", "fedml_wire_fanout_total",
+    "fedml_wire_staged_uploads_total", "fedml_wire_torn_frames_total",
 }
 
 
