@@ -965,13 +965,17 @@ def _mfu(flops, seconds):
 
 
 def _max_mfu(details) -> float:
-    """Largest MFU anywhere in a details artifact (configs + scaling curve).
-    The promotion contract keys on this: mfu > 1.0 is physically impossible,
-    so such an artifact documents a timing failure, not performance."""
-    cfgs = list(details.get("configs", {}).values()) + list(
-        details.get("cohort_scaling", {}).values())
-    vals = [c.get("mfu", 0.0) or 0.0 for c in cfgs if isinstance(c, dict)]
-    return max(vals, default=0.0)
+    """Largest MFU anywhere in a details artifact.  The promotion contract
+    keys on this: mfu > 1.0 is physically impossible, so such an artifact
+    documents a timing failure, not performance.
+
+    Delegates to `fedml_tpu.obs.trend.max_mfu` — the same recursive scan
+    `scripts/perf_trend.py --lint_mfu` runs over committed artifacts — so
+    the promotion/carry refusal contract and the CI lint can never
+    disagree about what an artifact claims (a nested scaling-curve cell
+    counts in both or neither)."""
+    from fedml_tpu.obs.trend import max_mfu
+    return max_mfu(details)
 
 
 def _quarantine(reason: str):

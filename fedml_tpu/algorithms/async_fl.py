@@ -93,7 +93,8 @@ class AsyncFedServerActor(ServerManager):
                  retask_timeout_s: Optional[float] = None,
                  admission=None,
                  defended_aggregate: Optional[Callable] = None,
-                 encode_once: bool = True):
+                 encode_once: bool = True,
+                 perf=None):
         """``checkpointer``: a `RoundCheckpointer`; every applied version
         is saved per its ``save_every`` gating and ``start()`` resumes
         from the latest saved version — a crashed async server restarts
@@ -129,7 +130,13 @@ class AsyncFedServerActor(ServerManager):
         re-task of the consumed silos) ride the transport's ``send_many``
         — the global serializes once per wave instead of once per silo.
         Single-silo re-tasks (watchdog nudges, probation releases) keep
-        plain sends."""
+        plain sends.
+
+        ``perf``: a `fedml_tpu.obs.perf.PerfRecorder`; one ledger line
+        per applied VERSION (the async analog of a round): tasking-wave
+        serialize, admission, defended aggregate, checkpoint, publish
+        (the on_version hook), wire deltas, RSS watermark, recompile
+        sentry."""
         super().__init__(0, transport)
         if not 1 <= aggregation_goal <= n_silos:
             raise ValueError(
@@ -157,6 +164,7 @@ class AsyncFedServerActor(ServerManager):
         self.admission = admission
         self.defended_aggregate = defended_aggregate
         self.encode_once = encode_once
+        self.perf = perf
         # host mirror of the current global — a tasking wave re-tasks up
         # to ``goal`` silos against the SAME version, and each used to
         # pay its own device→host transfer
@@ -214,6 +222,8 @@ class AsyncFedServerActor(ServerManager):
         ids = sample_clients(0, self.client_num_in_total, self.n_silos)
         now = time.monotonic()
         self._version_t0 = now
+        if self.perf is not None:
+            self.perf.round_start(self.version)
         # one root span for the initial tasking wave, so version-0 silo
         # train/upload spans stitch into a single trace instead of N
         # disconnected fragments
@@ -227,7 +237,8 @@ class AsyncFedServerActor(ServerManager):
             # version-0 wave deliberately left idle
             for silo in assignments:
                 self._last_heard[silo] = now
-            self._task_wave(assignments, MsgType.S2C_INIT)
+            with self._perf_phase("broadcast_serialize"):
+                self._task_wave(assignments, MsgType.S2C_INIT)
         self._arm_retask_timer()
 
     # -- liveness watchdog --------------------------------------------------
@@ -357,8 +368,10 @@ class AsyncFedServerActor(ServerManager):
                 return
             # screen BEFORE buffering: a poisoned delta must never sit in
             # the buffer waiting to be applied
-            verdict = self.admission.admit(msg.sender_id, delta,
-                                           raw_samples, None, self.version)
+            with self._perf_phase("admission"):
+                verdict = self.admission.admit(msg.sender_id, delta,
+                                               raw_samples, None,
+                                               self.version)
             if not verdict.ok:
                 log.warning("rejecting version-%d upload from silo %d "
                             "(reason=%s)", base_version, msg.sender_id,
@@ -470,7 +483,10 @@ class AsyncFedServerActor(ServerManager):
         # traced as a child of whichever upload's handling tripped the
         # goal, so the async trace shows which silo closed each version
         with self._span("aggregate", version=self.version,
-                        buffered=len(deltas)):
+                        buffered=len(deltas)), \
+                self._perf_phase("defended_aggregate"
+                                 if self.defended_aggregate is not None
+                                 else "aggregate"):
             if self.defended_aggregate is not None:
                 # staleness-aware defended variant: the Byzantine rule
                 # sees the raw sample weights (staleness claims cannot
@@ -531,9 +547,19 @@ class AsyncFedServerActor(ServerManager):
                                    self._rejected_crcs.items()
                                    if p[1] >= horizon}
         if self.checkpointer is not None:
-            self.checkpointer.maybe_save(
-                self.version - 1, self._checkpoint_state(),
-                last_round=self.version >= self.num_versions)
+            with self._perf_phase("checkpoint"):
+                self.checkpointer.maybe_save(
+                    self.version - 1, self._checkpoint_state(),
+                    last_round=self.version >= self.num_versions)
+        if self.perf is not None:
+            # close the applied version's ledger line (strict-mode
+            # RecompileError raises here, on the event loop) BEFORE the
+            # on_version hook — the hook runs eval/logging on a cadence
+            # of its own (--frequency_of_the_test), and folding that into
+            # the line would make round_s medians swing with eval cadence
+            # and trip the trend gate on a non-regression (the sync
+            # server closes before its eval hook for the same reason)
+            self.perf.round_end(self.version - 1, buffered=len(silos))
         if self.on_version is not None:
             self.on_version(self.version, self.params)
         if self.version >= self.num_versions:
@@ -541,10 +567,16 @@ class AsyncFedServerActor(ServerManager):
                 self.send(MsgType.S2C_FINISH, silo)
             self.finish()
             return
+        if self.perf is not None:
+            # the next version's line opens AFTER the eval hook (its cost
+            # belongs to no line) and before the tasking wave, so the
+            # wave's serialize is its first phase
+            self.perf.round_start(self.version)
         # only the consumed silos need new work; assignments draw in
         # buffer order (the legacy per-silo RNG schedule), the wave then
         # serializes the new global once for all of them
-        self._task_wave({silo: self._next_client() for silo in silos})
+        with self._perf_phase("broadcast_serialize"):
+            self._task_wave({silo: self._next_client() for silo in silos})
         if self.admission is not None:
             # sweep trust states once per version: transitions expired
             # quarantines to probation and refreshes the

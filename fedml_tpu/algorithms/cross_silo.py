@@ -158,7 +158,8 @@ class FedAvgServerActor(ServerManager):
                  admission=None,
                  aggregate_fn: Optional[Callable] = None,
                  encode_once: bool = True,
-                 incremental_staging: bool = True):
+                 incremental_staging: bool = True,
+                 perf=None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -227,6 +228,14 @@ class FedAvgServerActor(ServerManager):
         restores the seed per-silo encode loop; `scripts/wire_bench.py`
         measures the two against each other.
 
+        ``perf``: a `fedml_tpu.obs.perf.PerfRecorder`; when set, every
+        round writes one ledger line — phase wall-times
+        (broadcast_serialize / staging / admission / straggler_wait /
+        defended_aggregate / checkpoint / publish), wire-byte deltas,
+        the round's peak host RSS, and the recompile-sentry verdict.
+        The actor only drives the round lifecycle; the recorder's owner
+        (the runner) registers hot jits and closes it.
+
         ``incremental_staging``: with an ``aggregate_fn`` set, each
         admitted upload is copied into its slot of a persistent
         ``[cohort, ...]`` host staging buffer AT ARRIVAL TIME — staging
@@ -261,6 +270,7 @@ class FedAvgServerActor(ServerManager):
         self.aggregate_fn = aggregate_fn
         self.encode_once = encode_once
         self.incremental_staging = incremental_staging
+        self.perf = perf
         self.dropped_silos: Dict[int, list] = {}  # round -> missing silo ids
         self._received: Dict[int, tuple] = {}
         # per-round host mirror of self.params: the broadcast, checkpoint,
@@ -423,6 +433,10 @@ class FedAvgServerActor(ServerManager):
                 sorted(dead))
         self._round_t0 = time.monotonic()
         self._first_upload_t = None
+        if self.perf is not None:
+            # the ledger round opens HERE: broadcast serialize is its
+            # first phase, round_end closes it after publish
+            self.perf.round_start(self.round_idx)
         if self._tracer is not None:
             # one trace per round, rooted here: broadcast/recv/train/
             # upload/aggregate all stitch under this trace id
@@ -440,7 +454,8 @@ class FedAvgServerActor(ServerManager):
         extra = ({} if self._last_accepted is None
                  else {Message.ARG_ACCEPTED: self._last_accepted})
         with self._span("broadcast", parent=self._round_span,
-                        round=self.round_idx):
+                        round=self.round_idx), \
+                self._perf_phase("broadcast_serialize"):
             if self.encode_once:
                 # one payload serialization for the whole cohort: only
                 # the per-silo client assignment varies per frame
@@ -613,9 +628,10 @@ class FedAvgServerActor(ServerManager):
             self._first_upload_t = time.monotonic()
         entry = (upload, msg.get(Message.ARG_NUM_SAMPLES))
         if self.admission is not None:
-            verdict = self.admission.admit(
-                msg.sender_id, upload, msg.get(Message.ARG_NUM_SAMPLES),
-                self.params, self.round_idx)
+            with self._perf_phase("admission"):
+                verdict = self.admission.admit(
+                    msg.sender_id, upload, msg.get(Message.ARG_NUM_SAMPLES),
+                    self.params, self.round_idx)
             if verdict.ok:
                 entry = (upload, verdict.num_samples)
             else:
@@ -642,7 +658,8 @@ class FedAvgServerActor(ServerManager):
         still waiting on stragglers — so the barrier-close does no
         per-leaf stacking at all."""
         if entry is not None and self._staging_active():
-            self._stage(silo, entry[0])
+            with self._perf_phase("staging"):
+                self._stage(silo, entry[0])
             entry = (self._STAGED, entry[1])
         self._received[silo] = entry
         if self._expected:
@@ -743,6 +760,9 @@ class FedAvgServerActor(ServerManager):
             # tail wait: how long the round's LAST accepted upload (or the
             # drop-policy timeout) trailed the first one
             self._h_straggler.observe(now - self._first_upload_t)
+            if self.perf is not None:
+                self.perf.add_phase("straggler_wait",
+                                    now - self._first_upload_t)
         if self.round_idx in self.dropped_silos:  # normalize the drop log
             self.dropped_silos[self.round_idx] = sorted(
                 set(self.dropped_silos[self.round_idx]))
@@ -755,7 +775,10 @@ class FedAvgServerActor(ServerManager):
         self._last_accepted = np.asarray(sorted(admitted), np.int32)
         self._received.clear()
         with self._span("aggregate", parent=self._round_span,
-                        round=self.round_idx, quorum=len(admitted)):
+                        round=self.round_idx, quorum=len(admitted)), \
+                self._perf_phase("defended_aggregate"
+                                 if self.aggregate_fn is not None
+                                 else "aggregate"):
             if not admitted:
                 log.warning("round %d: no admissible uploads; the global "
                             "model is unchanged this round", self.round_idx)
@@ -785,16 +808,26 @@ class FedAvgServerActor(ServerManager):
             # thunk: rounds the save_every gate skips pay no device→host
             # copy and no EF serialization (_host_params memoizes the
             # transfer, and the next broadcast reuses the same copy)
-            self.checkpointer.maybe_save(
-                self.round_idx,
-                lambda: self._checkpoint_state(
-                    self.round_idx, host_params=self._host_params()),
-                last_round=self.round_idx + 1 >= self.num_rounds)
+            with self._perf_phase("checkpoint"):
+                self.checkpointer.maybe_save(
+                    self.round_idx,
+                    lambda: self._checkpoint_state(
+                        self.round_idx, host_params=self._host_params()),
+                    last_round=self.round_idx + 1 >= self.num_rounds)
         if self.publish is not None:
             # serve-while-train: hand the registry a HOST copy so the
             # serving path never holds references into device buffers the
             # next round's aggregation will donate/overwrite
-            self.publish(self._host_params(), self.round_idx)
+            with self._perf_phase("publish"):
+                self.publish(self._host_params(), self.round_idx)
+        if self.perf is not None:
+            # ledger line closes BEFORE the eval hook: round_s measures
+            # the server's own round costs, not the eval cadence.  A
+            # strict-mode RecompileError raises here, on the event loop,
+            # and fails the run loudly (the test-mode contract).
+            self.perf.round_end(self.round_idx, quorum=len(admitted),
+                                dropped=len(self.dropped_silos.get(
+                                    self.round_idx, [])))
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.params)
         self.round_idx += 1

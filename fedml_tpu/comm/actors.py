@@ -194,3 +194,13 @@ class ClientManager(NodeManager):
 
 class ServerManager(NodeManager):
     """Cross-silo server actor (reference ServerManager, server_manager.py:13)."""
+
+    #: optional `fedml_tpu.obs.perf.PerfRecorder` — subclasses accepting a
+    #: ``perf=`` parameter assign it; `_perf_phase` is the shared span helper
+    perf = None
+
+    def _perf_phase(self, name: str):
+        """Flight-recorder phase span (null context when no recorder)."""
+        if self.perf is not None:
+            return self.perf.phase(name)
+        return contextlib.nullcontext()

@@ -230,6 +230,25 @@ class ExperimentConfig:
     #                                      run_dir/telemetry.{json,prom}
     prom_port: int = 0                   # >0: serve live Prometheus text at
     #                                      :port/metrics (implies telemetry)
+    perf: bool = False                   # performance flight recorder
+    #                                      (obs/perf.py): one perf.jsonl
+    #                                      ledger line per round/version —
+    #                                      phase wall-times, wire bytes,
+    #                                      peak host RSS, recompile sentry
+    #                                      (cross_silo / async_fl server)
+    perf_ledger: Optional[str] = None    # explicit ledger path (implies
+    #                                      --perf; default run_dir/perf.jsonl)
+    perf_strict: bool = False            # recompile sentry raises
+    #                                      RecompileError instead of
+    #                                      warning — the test/CI mode that
+    #                                      makes a retracing hot function
+    #                                      fail the run loudly (implies
+    #                                      --perf)
+    slo: str = ""                        # SLO threshold overrides for the
+    #                                      serve deep health check, e.g.
+    #                                      "round_duration_p95_seconds=10,
+    #                                      serve_shed_rate=0.01" (names:
+    #                                      obs/perf.DEFAULT_SLOS)
     log_stdout: bool = True
     # ---- chaos injection (comm/chaos.py over the local silo backend) ---
     # seeded per-message fault probabilities for --algo cross_silo

@@ -104,6 +104,44 @@ def _make_checkpointer(cfg: ExperimentConfig):
                              keep_last_n=cfg.checkpoint_keep_last_n)
 
 
+def _make_perf(cfg: ExperimentConfig):
+    """Performance flight recorder (obs/perf.py) for the live actor
+    modes: a per-round ``perf.jsonl`` ledger at ``--perf_ledger`` (or
+    ``run_dir/perf.jsonl`` under ``--perf``).  Only the SERVER node
+    records — silo processes return None.  The runner owns ``close()``
+    (stops the RSS sampler thread)."""
+    # perf_strict implies the recorder: a strict sentry with no recorder
+    # to own it would be the exact "flag parses then silently never
+    # enforces" condition the algo gate in main() rejects
+    if not (cfg.perf or cfg.perf_ledger or cfg.perf_strict):
+        return None
+    if cfg.silo_backend != "local" and cfg.node_id != 0:
+        return None  # a gRPC silo has no round lifecycle to ledger
+    import os
+    from fedml_tpu.obs import PerfRecorder
+    path = cfg.perf_ledger or os.path.join(
+        cfg.metrics_dir or cfg.run_dir or ".", "perf.jsonl")
+    return PerfRecorder(path, node=f"node{cfg.node_id}",
+                        strict_recompiles=cfg.perf_strict)
+
+
+def _make_slo(cfg: ExperimentConfig):
+    """SLO evaluator over the telemetry registry (obs/perf.py) backing
+    the serve frontend's ``/healthz?deep=1``; ``--slo`` overrides the
+    default objectives.  Needs live telemetry — with the registry
+    disabled every objective would read vacuously healthy, so return
+    None (the frontend then answers ``deep: unconfigured``)."""
+    from fedml_tpu.obs import telemetry as _tel
+    if not _tel.get_registry().enabled:
+        if cfg.slo:
+            logger.warning("--slo given but telemetry is disabled; the "
+                           "deep health check needs --telemetry true")
+        return None
+    from fedml_tpu.obs.perf import SloEvaluator, parse_slo_spec
+    thresholds = parse_slo_spec(cfg.slo) if cfg.slo else None
+    return SloEvaluator(thresholds=thresholds)
+
+
 def _eval_global(workload, params, data) -> Dict[str, float]:
     """Train/test accuracy over all clients (the per-runner summary for
     algorithms that don't track their own history)."""
@@ -474,7 +512,7 @@ def _pp_workload(cfg, data):
     return make_pp_nwp_workload(plm, mesh, n_micro=n_micro)
 
 
-def _silo_training_setup(cfg, data, wl):
+def _silo_training_setup(cfg, data, wl, perf=None):
     """Shared silo-side machinery for the sync (cross_silo) and async
     (async_fl) actor modes: the initial global params and the per-silo
     ``train_fn(params, client_idx, round_idx)`` factory.
@@ -495,6 +533,11 @@ def _silo_training_setup(cfg, data, wl):
     local = instrument_train_fn(jax.jit(make_local_trainer(
         wl, make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd),
         cfg.epochs)), epochs=cfg.epochs)
+    if perf is not None:
+        # flight recorder: the local trainer jit is a registered hot
+        # function — the sentry counts any round that grows its cache
+        # (instrument_train_fn forwards the jit's _cache_size probe)
+        perf.register_jit("train_fn", local)
     import threading
     _chain = {"next_round": 0,
               "rng": jax.random.split(jax.random.key(cfg.seed))[0]}
@@ -537,12 +580,14 @@ def _silo_training_setup(cfg, data, wl):
     return wl.init(init_rng, sample), make_train_fn
 
 
-def _robust_setup(cfg: ExperimentConfig, template, kind: str):
+def _robust_setup(cfg: ExperimentConfig, template, kind: str, sentry=None):
     """Payload-defense wiring shared by the sync and async actor modes
     (fedml_tpu/robust): the admission pipeline (``--admission`` — 'auto'
     arms it whenever any defense flag is set) and the jit-once defended
     aggregate (``--robust_agg/--norm_clip/--agg_noise_std``).  Returns
-    ``(admission, defended_aggregate)``, either possibly None."""
+    ``(admission, defended_aggregate)``, either possibly None.
+    ``sentry``: the flight recorder's RecompileSentry — the defended
+    aggregate registers with it so a retracing round is counted/failed."""
     if cfg.admission not in ("auto", "on", "off"):
         raise ValueError(f"--admission must be auto|on|off, "
                          f"got {cfg.admission!r}")
@@ -571,7 +616,7 @@ def _robust_setup(cfg: ExperimentConfig, template, kind: str):
             cfg.robust_agg, trim_frac=cfg.trim_frac, byz_f=cfg.byz_f,
             krum_m=cfg.krum_m, gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps,
             norm_clip=cfg.norm_clip, noise_std=cfg.agg_noise_std,
-            seed=cfg.seed)
+            seed=cfg.seed, sentry=sentry)
     return admission, defended
 
 
@@ -638,19 +683,26 @@ def run_async_fl(cfg, data, mesh, sink):
             f"--silo_backend {cfg.silo_backend!r} would silently be "
             "ignored (the actors are transport-agnostic — the gRPC "
             "wiring mirrors cross_silo's when needed)")
+    perf = _make_perf(cfg)
+    # async has no serve frontend, but `--slo` must still evaluate: the
+    # rolling objectives ride on_version below (gauges + breach counters)
+    slo = _make_slo(cfg)
     wl = _make_workload(cfg, data)
-    init, make_train_fn = _silo_training_setup(cfg, data, wl)
+    init, make_train_fn = _silo_training_setup(cfg, data, wl, perf=perf)
     n_silos = min(cfg.client_num_per_round, data.client_num)
     goal = cfg.async_goal or max(1, n_silos // 2)
     make_train_fn = _adversary_train_fns(cfg, data, make_train_fn, n_silos)
     # async uploads are deltas — the admission screen fingerprints them
     # against the params template (same treedef/shapes/dtypes) and
     # screens the raw delta norm
-    admission, defended = _robust_setup(cfg, init, kind="delta")
+    admission, defended = _robust_setup(
+        cfg, init, kind="delta", sentry=perf.sentry if perf else None)
 
     history = []
 
     def on_version(version, params):
+        if slo is not None:
+            slo.evaluate()  # rolling: gauges update, breaches count
         if (version % cfg.frequency_of_the_test == 0
                 or version == cfg.comm_round):
             stats = _eval_global(wl, params, data)
@@ -666,15 +718,19 @@ def run_async_fl(cfg, data, mesh, sink):
         server_lr=cfg.async_server_lr, on_version=on_version,
         seed=cfg.seed, checkpointer=_make_checkpointer(cfg),
         retask_timeout_s=cfg.retask_timeout_s or None,
-        admission=admission, defended_aggregate=defended)
+        admission=admission, defended_aggregate=defended, perf=perf)
     server.register_handlers()
     silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
                                encode_upload=delta_encoder)
              for i in range(1, n_silos + 1)]
     for s in silos:
         s.register_handlers()
-    server.start()
-    hub.pump()
+    try:
+        server.start()
+        hub.pump()
+    finally:
+        if perf is not None:
+            perf.close()  # join the RSS sampler thread
     out = dict(history[-1]) if history else {}
     if server.staleness_seen:
         out["mean_staleness"] = float(np.mean(server.staleness_seen))
@@ -704,13 +760,20 @@ def run_cross_silo(cfg, data, mesh, sink):
                          "actor mode (each silo trains single-chip); drop "
                          "the flag or use --algo fedavg for on-pod sharding")
 
+    perf = _make_perf(cfg)
+    # built once per run, evaluated EVERY round below — not only behind
+    # the serve frontend, so `--slo` without --serve_port still exports
+    # the fedml_slo_* gauges and ticks breach counters instead of
+    # silently never evaluating the configured objectives
+    slo = _make_slo(cfg)
     wl = (_pp_workload(cfg, data) if cfg.mesh_stages > 0
           else _make_workload(cfg, data))
-    init, make_train_fn = _silo_training_setup(cfg, data, wl)
+    init, make_train_fn = _silo_training_setup(cfg, data, wl, perf=perf)
     n_silos = min(cfg.client_num_per_round, data.client_num)
     timeout = cfg.round_timeout_s or None
     make_train_fn = _adversary_train_fns(cfg, data, make_train_fn, n_silos)
-    admission, defended = _robust_setup(cfg, init, kind="params")
+    admission, defended = _robust_setup(
+        cfg, init, kind="params", sentry=perf.sentry if perf else None)
 
     # optional lossy upload compression (comm/compress.py): silos send the
     # compressed DELTA to the global model; the server reconstructs.  The
@@ -807,6 +870,8 @@ def run_cross_silo(cfg, data, mesh, sink):
     history = []
 
     def on_round_done(r, params):
+        if slo is not None:
+            slo.evaluate()  # rolling: gauges update, breaches count
         if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
             stats = _eval_global(wl, params, data)
             stats["round"] = r
@@ -841,8 +906,12 @@ def run_cross_silo(cfg, data, mesh, sink):
             max_delay_s=cfg.serve_batch_delay_ms / 1e3,
             queue_depth=cfg.serve_queue_depth,
             default_deadline_s=cfg.serve_deadline_ms / 1e3)
+        # deep health check: /healthz?deep=1 evaluates the rolling SLOs
+        # (round p95, shed rate, torn frames, quarantines) and answers
+        # 503 on breach so an LB can rotate out a violating instance
         frontend = ServeFrontend(registry, batcher,
-                                 port=cfg.serve_port).start()
+                                 port=cfg.serve_port,
+                                 slo=slo).start()
         _sample_x = np.asarray(data.train["x"][0, 0, 0])
         _warmed = []
 
@@ -867,7 +936,7 @@ def run_cross_silo(cfg, data, mesh, sink):
             decode_upload=decode, failure_detector=detector,
             checkpointer=_make_checkpointer(cfg),
             publish=publish, extra_state=ef_extra,
-            admission=admission, aggregate_fn=defended)
+            admission=admission, aggregate_fn=defended, perf=perf)
         s.register_handlers()
         return s
 
@@ -970,6 +1039,8 @@ def run_cross_silo(cfg, data, mesh, sink):
         raise ValueError(f"unknown silo_backend {cfg.silo_backend!r}; "
                          f"available: ('local', 'grpc')")
     finally:
+        if perf is not None:
+            perf.close()  # join the RSS sampler thread
         if frontend is not None:
             # drain-on-shutdown: queued requests still answer, then the
             # listener closes — training's end never drops live traffic
@@ -1310,6 +1381,17 @@ def main(argv=None) -> Dict[str, Any]:
             "silently train without serving.  To serve a finished "
             "checkpoint directory, use scripts/serve_bench.py "
             "--ckpt_dir instead.")
+    # the flight recorder and the SLO evaluator hook the live actors'
+    # round lifecycle; on the cohort-simulation algorithms the flags
+    # would parse and then never record/evaluate anything — an empty
+    # ledger and un-evaluated objectives masquerading as a healthy run
+    if cfg.algo not in ("cross_silo", "async_fl") and (
+            cfg.perf or cfg.perf_ledger or cfg.perf_strict or cfg.slo):
+        raise ValueError(
+            f"--perf/--perf_ledger/--perf_strict/--slo instrument the "
+            f"live actor modes' round lifecycle and apply to --algo "
+            f"cross_silo/async_fl only; --algo {cfg.algo} would silently "
+            f"write no ledger and never evaluate the objectives.")
     # decentralized_online consumes a streaming dataset (UCI SUSY/RO or a
     # synthetic stream) that the registry doesn't serve — its runner builds
     # it; loading here would KeyError on --dataset SUSY
@@ -1340,7 +1422,9 @@ def main(argv=None) -> Dict[str, Any]:
         if cfg.prom_port > 0:
             prom_server = _telemetry.start_http_server(cfg.prom_port,
                                                        registry)
-            logger.info("telemetry: serving /metrics on :%d", cfg.prom_port)
+            if prom_server is not None:  # bind failure warned + returned None
+                logger.info("telemetry: serving /metrics on :%d",
+                            cfg.prom_port)
     if cfg.trace_dir:
         tracer = _trace.enable(node=f"node{cfg.node_id}")
 

@@ -15,11 +15,24 @@ Both trace and telemetry are process-global opt-ins (``enable()``);
 disabled they are a null tracer / null registry and instrumented hot
 paths pay a single branch per event.  Enable BEFORE constructing
 transports/actors — instrumented constructors cache their metric handles.
+
+Two further pillars ride on those:
+
+    fedml_tpu.obs.perf       performance flight recorder: per-round
+                             perf.jsonl ledger (phase wall-times, RSS
+                             watermark, recompile sentry) + SLO
+                             evaluator over the telemetry registry
+    fedml_tpu.obs.trend      perf regression gate + mfu<=1.0 timing-
+                             trust lint (CLI: scripts/perf_trend.py)
 """
 
+from fedml_tpu.obs.perf import (PerfRecorder, RecompileError,
+                                RecompileSentry, RssSampler, SloEvaluator)
 from fedml_tpu.obs.telemetry import (NullRegistry, TelemetryRegistry,
                                      start_http_server)
 from fedml_tpu.obs.trace import Span, SpanContext, SpanTracer
 
 __all__ = ["NullRegistry", "TelemetryRegistry", "start_http_server",
-           "Span", "SpanContext", "SpanTracer"]
+           "Span", "SpanContext", "SpanTracer",
+           "PerfRecorder", "RecompileError", "RecompileSentry",
+           "RssSampler", "SloEvaluator"]

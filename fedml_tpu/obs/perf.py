@@ -1,0 +1,541 @@
+"""Performance flight recorder: the per-round cost breakdown the perf
+trajectory has been missing (ROADMAP item 5b).
+
+Three instruments, stdlib-only like the rest of `obs/`:
+
+* **`PerfRecorder`** — one structured ``perf.jsonl`` line per completed
+  round/version: phase wall-times (broadcast serialize, straggler wait,
+  admission, defended aggregate, checkpoint, publish), wire bytes
+  in/out (deltas of the PR 2 comm counters), the round's **peak host
+  RSS watermark**, and the recompile count.  Each line is formatted
+  fully before ONE ``write()`` call on an O_APPEND descriptor, so a
+  crash can tear at most the final line — which every reader here
+  (`trend.load_ledger`, `report.load_jsonl`) already tolerates.
+* **`RssSampler`** — a daemon thread sampling ``VmRSS`` from
+  ``/proc/self/status`` (no new deps); ``reset_peak()`` gives per-round
+  watermarks.  This is the exact instrument ROADMAP item 2's "server
+  RSS flat in cohort size" success criterion needs.
+* **`RecompileSentry`** — tracks the jit cache sizes of registered hot
+  functions (`make_defended_aggregate` products, the instrumented
+  train fn).  Cache growth after the first check is a RECOMPILE:
+  counted in ``fedml_perf_recompiles_total``, warned in production,
+  and raised as `RecompileError` under ``strict`` (test mode) — the
+  PR 5 double-compile class of bug (round-0 numpy globals vs later jax
+  outputs keying two cache entries) can never land silently again.
+
+`SloEvaluator` sits on top of the telemetry registry: rolling SLO
+values (round-duration p95, serve shed rate, torn-frame rate,
+quarantine events per round) exported as ``fedml_slo_*`` gauges with a
+per-SLO breach counter; it backs the serve frontend's
+``/healthz?deep=1`` mode (200 while every SLO holds, 503 on breach).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from fedml_tpu.obs import telemetry
+
+log = logging.getLogger(__name__)
+
+# the canonical phase vocabulary (a ledger line may carry a subset —
+# e.g. no checkpoint phase on rounds the save_every gate skips; the
+# aggregate span is named by what ran: "defended_aggregate" only when a
+# make_defended_aggregate product is wired, plain "aggregate" otherwise,
+# so a defended run never compares against an undefended baseline under
+# one label)
+PHASES = ("broadcast_serialize", "straggler_wait", "staging", "admission",
+          "aggregate", "defended_aggregate", "checkpoint", "publish")
+
+
+# ---------------------------------------------------------------------------
+# RSS watermark sampler
+# ---------------------------------------------------------------------------
+
+def read_rss_bytes() -> Optional[int]:
+    """Current resident set size from ``/proc/self/status`` (VmRSS).
+    Returns None where /proc is unavailable (non-Linux) — the recorder
+    then ledgers ``rss: null`` instead of guessing."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024  # kB -> bytes
+    except OSError:
+        return None
+    return None
+
+
+class RssSampler:
+    """Daemon thread tracking the peak of ``read_rss_bytes()``.
+
+    ``reset_peak()`` returns the watermark since the previous reset and
+    restarts it from the CURRENT value — the per-round watermark
+    protocol.  ``start``/``stop`` are idempotent and ``stop`` joins the
+    thread, so owners can assert no thread leaks."""
+
+    def __init__(self, interval_s: float = 0.05):
+        self.interval_s = interval_s
+        self._lock = threading.Lock()
+        self._peak: Optional[int] = None
+        self._current: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample(self) -> Optional[int]:
+        rss = read_rss_bytes()
+        if rss is not None:
+            with self._lock:
+                self._current = rss
+                if self._peak is None or rss > self._peak:
+                    self._peak = rss
+        return rss
+
+    @property
+    def peak_bytes(self) -> Optional[int]:
+        with self._lock:
+            return self._peak
+
+    def reset_peak(self) -> Optional[int]:
+        """Return the watermark since the last reset; restart it from a
+        fresh sample (never carry a stale peak into the next round)."""
+        rss = read_rss_bytes()
+        with self._lock:
+            out = self._peak
+            self._peak = self._current = rss
+        return out
+
+    def start(self) -> "RssSampler":
+        if self._thread is not None or read_rss_bytes() is None:
+            return self
+        self._stop.clear()
+        self.sample()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="perf-rss-sampler")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# recompile sentry
+# ---------------------------------------------------------------------------
+
+class RecompileError(RuntimeError):
+    """Strict-mode verdict: a registered hot function recompiled after
+    its baseline round — a silent perf regression, not a crash."""
+
+
+class RecompileSentry:
+    """Track jit cache sizes of registered hot functions.
+
+    The FIRST ``check()`` per function records its baseline (round-0
+    compiles are expected); later checks count any GROWTH as recompiles:
+    ``fedml_perf_recompiles_total`` ticks, production warns, ``strict``
+    raises `RecompileError`.  A shrunk cache (explicit clear) re-baselines
+    silently."""
+
+    def __init__(self, strict: bool = False, registry=None):
+        self.strict = strict
+        self._fns: Dict[str, Callable] = {}
+        self._baseline: Dict[str, int] = {}
+        reg = registry if registry is not None else telemetry.get_registry()
+        self._c_recompiles = reg.counter("fedml_perf_recompiles_total")
+
+    def register(self, name: str, fn) -> bool:
+        """Register a hot function; returns False (and stays silent at
+        check time) when it exposes no ``_cache_size`` probe."""
+        if getattr(fn, "_cache_size", None) is None:
+            log.debug("recompile sentry: %r has no _cache_size; skipped",
+                      name)
+            return False
+        self._fns[name] = fn
+        return True
+
+    def names(self):
+        return sorted(self._fns)
+
+    def cache_sizes(self) -> Dict[str, int]:
+        out = {}
+        for name, fn in self._fns.items():
+            try:
+                out[name] = int(fn._cache_size())
+            except Exception:  # noqa: BLE001 — fn mid-teardown
+                continue
+        return out
+
+    def check(self, round_idx) -> Dict[str, int]:
+        """Returns ``{fn_name: new_entries}`` for functions that
+        recompiled since the last check (empty on a clean round)."""
+        events: Dict[str, int] = {}
+        for name, size in self.cache_sizes().items():
+            prev = self._baseline.get(name)
+            self._baseline[name] = size
+            if prev is None or prev == 0 or size <= prev:
+                # baseline round; an empty-cache baseline (the fn was
+                # registered but not yet CALLED — e.g. round 0 closed
+                # with no admissible uploads, so its first compile lands
+                # later and is not a REcompile); or an explicit clear
+                continue
+            events[name] = size - prev
+        total = sum(events.values())
+        if total:
+            self._c_recompiles.inc(total)
+            detail = ", ".join(f"{k}:+{v}" for k, v in sorted(events.items()))
+            msg = (f"recompile sentry: round {round_idx}: {total} new jit "
+                   f"cache entr{'y' if total == 1 else 'ies'} after the "
+                   f"baseline round ({detail}) — a hot function is "
+                   f"retracing every round")
+            if self.strict:
+                raise RecompileError(msg)
+            log.warning(msg)
+        return events
+
+
+# ---------------------------------------------------------------------------
+# the per-round ledger
+# ---------------------------------------------------------------------------
+
+class _PhaseTimer:
+    __slots__ = ("_rec", "_name", "_t0")
+
+    def __init__(self, rec: "PerfRecorder", name: str):
+        self._rec = rec
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._rec.add_phase(self._name, time.perf_counter() - self._t0)
+        return False
+
+
+# wire accounting: both byte-counter families carry ``link="src->dst"``
+# labels (gRPC/MQTT count send_bytes, the codec-roundtrip hub counts
+# wire_bytes), so the ledger splits them by DIRECTION relative to the
+# recording node: out = links leaving it, in = links entering it.  The
+# split is honest per process — a registry only holds what its own
+# transports counted, so on multi-process wires (gRPC) inbound bytes
+# read 0 until a receive path counts them; the in-process hub sees both
+# directions of every link.
+_BYTE_FAMILIES = ("fedml_comm_send_bytes_total",
+                  "fedml_comm_wire_bytes_total")
+_LINK_RE = re.compile(r'link="([^"]*)->([^"]*)"')
+
+
+class PerfRecorder:
+    """Own the round lifecycle: ``round_start`` → ``phase(...)`` spans /
+    ``add_phase`` accumulations → ``round_end`` writes one ledger line.
+
+    Thread-safety: phase accumulation may run on receive threads
+    (admission screens in `_on_model`) while the round closes on the
+    event loop — the accumulator dict is lock-guarded.  The ledger file
+    is opened per line in append mode and written with ONE ``write()``
+    call, so concurrent writers (a sync server and an async server
+    sharing a run dir would be a misconfiguration anyway) can interleave
+    lines but never interleave bytes of a line on POSIX O_APPEND."""
+
+    def __init__(self, path: str, node: str = "server",
+                 rss_interval_s: float = 0.05, strict_recompiles: bool = False,
+                 registry=None, node_index: int = 0):
+        self.path = path
+        self.node = node
+        self.node_index = node_index  # wire-byte direction split anchor
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        # one ledger == one run: a leftover file from a previous run at
+        # the same path would splice two runs together — the second
+        # run's compile-paying round 0 lands mid-file, poisoning the
+        # trend gate's skip-first-round medians and the recompile gate's
+        # baseline-row forgiveness.  Rotate it aside instead of
+        # appending (or silently destroying a crashed run's evidence).
+        if os.path.exists(path):
+            os.replace(path, path + ".prev")
+        reg = registry if registry is not None else telemetry.get_registry()
+        self._registry = reg
+        self.sentry = RecompileSentry(strict=strict_recompiles, registry=reg)
+        self.rss = RssSampler(interval_s=rss_interval_s)
+        self._lock = threading.Lock()
+        self._phases: Dict[str, float] = {}
+        self._round: Optional[int] = None
+        self._round_t0: Optional[float] = None
+        self._wire0 = (0.0, 0.0)
+        self._g_rss = reg.gauge("fedml_perf_rss_peak_bytes")
+        self._c_rounds = reg.counter("fedml_perf_rounds_total")
+        self._h_phase: Dict[str, object] = {}
+        self._closed = False
+
+    # -- registration --------------------------------------------------------
+    def register_jit(self, name: str, fn) -> bool:
+        """Register a hot function with the recompile sentry."""
+        return self.sentry.register(name, fn)
+
+    # -- wire accounting -----------------------------------------------------
+    def _wire_totals(self):
+        counters = self._registry.snapshot().get("counters", {})
+        me = str(self.node_index)
+        out = inn = 0.0
+        for series, v in counters.items():
+            if not series.startswith(_BYTE_FAMILIES):
+                continue
+            m = _LINK_RE.search(series)
+            if m is None:
+                continue  # unlabeled byte series: direction unknowable
+            if m.group(1) == me:
+                out += v
+            elif m.group(2) == me:
+                inn += v
+        return out, inn
+
+    # -- round lifecycle -----------------------------------------------------
+    def round_start(self, round_idx) -> None:
+        if self._round is None:
+            self.rss.start()
+        with self._lock:
+            self._phases = {}
+        self._round = round_idx
+        self._round_t0 = time.perf_counter()
+        self.rss.reset_peak()
+        self._wire0 = self._wire_totals()
+
+    def phase(self, name: str) -> _PhaseTimer:
+        """Context manager accumulating wall time into the current
+        round's ``name`` phase (re-entering the same phase ADDS — the
+        admission screen runs once per upload)."""
+        return _PhaseTimer(self, name)
+
+    def add_phase(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._phases[name] = self._phases.get(name, 0.0) + float(seconds)
+
+    def round_end(self, round_idx, **extra) -> Optional[dict]:
+        """Close the round: sentry check, RSS watermark, wire deltas,
+        one ledger line.  Returns the line dict (None when no round was
+        open).  ``extra`` lands verbatim in the line (quorum size,
+        version tags, ...)."""
+        if self._round is None:
+            return None
+        # the sentry runs FIRST so a strict-mode RecompileError fires
+        # before a misleading clean line could be written
+        recompile_events = self.sentry.check(round_idx)
+        rss_peak = self.rss.reset_peak()
+        self.rss.sample()
+        rss_now = self.rss.peak_bytes
+        wire1 = self._wire_totals()
+        with self._lock:
+            phases = dict(self._phases)
+            self._phases = {}
+        round_s = (time.perf_counter() - self._round_t0
+                   if self._round_t0 is not None else None)
+        self._round = None
+        line = {
+            "round": round_idx,
+            "ts": time.time(),
+            "node": self.node,
+            "round_s": round_s,
+            "phases": {k: round(v, 6) for k, v in sorted(phases.items())},
+            "wire": {"bytes_out": int(wire1[0] - self._wire0[0]),
+                     "bytes_in": int(wire1[1] - self._wire0[1])},
+            "rss": (None if rss_peak is None else
+                    {"peak_bytes": int(rss_peak),
+                     "current_bytes": None if rss_now is None
+                     else int(rss_now)}),
+            "recompiles": sum(recompile_events.values()),
+            "jit_cache_sizes": self.sentry.cache_sizes(),
+        }
+        if recompile_events:
+            line["recompiled"] = recompile_events
+        line.update(extra)
+        self._write(line)
+        self._c_rounds.inc()
+        if rss_peak is not None:
+            self._g_rss.set(rss_peak)
+        for name, dt in phases.items():
+            h = self._h_phase.get(name)
+            if h is None:
+                h = self._registry.histogram("fedml_perf_phase_seconds",
+                                             phase=name)
+                self._h_phase[name] = h
+            h.observe(dt)
+        return line
+
+    def _write(self, line: dict) -> None:
+        data = json.dumps(line, sort_keys=True) + "\n"
+        # one write() on an O_APPEND fd: a crash tears at most the tail
+        with open(self.path, "a") as f:
+            f.write(data)
+            f.flush()
+
+    def close(self) -> None:
+        """Stop the sampler thread; safe to call twice.  An open round
+        is NOT flushed — a half-measured round would ledger as a
+        misleadingly fast one."""
+        if self._closed:
+            return
+        self._closed = True
+        self.rss.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO evaluator
+# ---------------------------------------------------------------------------
+
+def histogram_quantile(stats: dict, q: float) -> Optional[float]:
+    """Upper-bound quantile estimate from a snapshot histogram dict
+    (``{"count": n, "buckets": {bound: count, "+Inf": n_inf}}``): the
+    smallest bucket bound whose cumulative count covers ``q`` of the
+    observations.  +Inf-bucket answers fall back to the observed max
+    (the histogram knows nothing finer).  None on an empty histogram."""
+    count = stats.get("count") or 0
+    if not count:
+        return None
+    buckets = stats.get("buckets") or {}
+    finite = sorted(((float(b), c) for b, c in buckets.items()
+                     if b != "+Inf"), key=lambda x: x[0])
+    need = q * count
+    cum = 0
+    for bound, c in finite:
+        cum += c
+        if cum >= need:
+            return bound
+    return stats.get("max")
+
+
+# default objectives; override per-deployment via the ``--slo`` spec
+# ("name=value,...") or the constructor's thresholds dict
+DEFAULT_SLOS = {
+    "round_duration_p95_seconds": 60.0,   # p95 round wall time
+    "serve_shed_rate": 0.05,              # shed / submitted requests
+    "torn_frame_rate": 0.01,              # torn frames / received msgs
+    "quarantine_rate": 0.5,               # quarantine events / round
+}
+
+
+def parse_slo_spec(spec: str) -> Dict[str, float]:
+    """Parse ``"round_duration_p95_seconds=10,serve_shed_rate=0.01"``;
+    unknown SLO names fail loudly (a typo'd objective silently never
+    evaluating is the exact blindness this module exists to end)."""
+    out: Dict[str, float] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"--slo entries are name=value, got {part!r}")
+        name, _, value = part.partition("=")
+        name = name.strip()
+        if name not in DEFAULT_SLOS:
+            raise ValueError(f"unknown SLO {name!r}; available: "
+                             f"{sorted(DEFAULT_SLOS)}")
+        out[name] = float(value)
+    return out
+
+
+class SloEvaluator:
+    """Rolling SLO evaluation over a telemetry registry snapshot.
+
+    ``evaluate()`` computes each objective, exports it as a
+    ``fedml_slo_*`` gauge, bumps the per-SLO breach counter when the
+    objective is violated, and returns the full verdict dict.  Breach
+    counting belongs to the ROUND cadence (the runners' per-round/
+    per-version call): query paths — ``healthy()``, the serve frontend's
+    ``/healthz?deep=1`` — pass ``count_breaches=False`` so one sustained
+    breach counts per round, not per LB probe (a 1 s prober would
+    otherwise inflate ``fedml_slo_breaches_total`` ~60x and break any
+    "breaches > N" alert threshold)."""
+
+    def __init__(self, registry=None, thresholds: Optional[dict] = None):
+        reg = (registry if registry is not None
+               else telemetry.get_registry())
+        self._registry = reg
+        unknown = set(thresholds or {}) - set(DEFAULT_SLOS)
+        if unknown:
+            raise ValueError(f"unknown SLOs {sorted(unknown)}; available: "
+                             f"{sorted(DEFAULT_SLOS)}")
+        self.thresholds = {**DEFAULT_SLOS, **(thresholds or {})}
+        # literal names: the source-scan metric lint
+        # (tests/test_metric_naming.py) pins these series.  The rate
+        # gauges wear _ratio, not _total — they go down as well as up
+        self._gauges = {
+            "round_duration_p95_seconds":
+                reg.gauge("fedml_slo_round_duration_p95_seconds"),
+            "serve_shed_rate": reg.gauge("fedml_slo_serve_shed_ratio"),
+            "torn_frame_rate": reg.gauge("fedml_slo_torn_frame_ratio"),
+            "quarantine_rate":
+                reg.gauge("fedml_slo_quarantine_per_round_ratio"),
+        }
+        self._breaches = {name: reg.counter(
+            "fedml_slo_breaches_total", slo=name)
+            for name in self._gauges}
+
+    @staticmethod
+    def _sum_family(counters: dict, family: str) -> float:
+        return sum(v for k, v in counters.items() if k.startswith(family))
+
+    def _values(self, snap: dict) -> Dict[str, Optional[float]]:
+        counters = snap.get("counters", {})
+        hists = snap.get("histograms", {})
+
+        p95 = None
+        for series, stats in hists.items():
+            if series.startswith(("fedml_round_duration_seconds",
+                                  "fedml_async_version_duration_seconds")):
+                q = histogram_quantile(stats, 0.95)
+                if q is not None:
+                    p95 = q if p95 is None else max(p95, q)
+
+        submitted = self._sum_family(counters, "fedml_serve_requests_total")
+        shed = self._sum_family(counters, "fedml_serve_shed_total")
+        shed_rate = (shed / submitted) if submitted else 0.0
+
+        recv = self._sum_family(counters, "fedml_comm_recv_total")
+        torn = self._sum_family(counters, "fedml_wire_torn_frames_total")
+        torn_rate = (torn / recv) if recv else 0.0
+
+        rounds = sum(h.get("count", 0) for s, h in hists.items()
+                     if s.startswith(("fedml_round_duration_seconds",
+                                      "fedml_async_version_duration_"
+                                      "seconds")))
+        quarantines = self._sum_family(
+            counters, "fedml_robust_quarantine_events_total")
+        quarantine_rate = (quarantines / rounds) if rounds else 0.0
+
+        return {"round_duration_p95_seconds": p95,
+                "serve_shed_rate": shed_rate,
+                "torn_frame_rate": torn_rate,
+                "quarantine_rate": quarantine_rate}
+
+    def evaluate(self, count_breaches: bool = True) -> Dict[str, dict]:
+        values = self._values(self._registry.snapshot())
+        out: Dict[str, dict] = {}
+        for name, threshold in sorted(self.thresholds.items()):
+            value = values.get(name)
+            ok = value is None or value <= threshold
+            if value is not None:
+                self._gauges[name].set(value)
+            if not ok and count_breaches:
+                self._breaches[name].inc()
+            out[name] = {"value": value, "threshold": threshold, "ok": ok}
+        return out
+
+    def healthy(self) -> bool:
+        return all(v["ok"]
+                   for v in self.evaluate(count_breaches=False).values())

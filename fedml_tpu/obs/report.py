@@ -86,10 +86,15 @@ def load_trace_events(trace_dir: Optional[str],
     return uniq
 
 
-def merge_traces(trace_dir: str, out_path: str) -> int:
+def merge_traces(trace_dir: str, out_path: str) -> Optional[int]:
     """Write one combined Perfetto file from all per-process exports;
-    returns the span count (load it at ui.perfetto.dev)."""
+    returns the span count (load it at ui.perfetto.dev).  A missing or
+    empty trace dir returns None WITHOUT writing: a zero-span merged
+    file would read as "traced, and nothing happened" when the truth is
+    "nothing was traced"."""
     events = load_trace_events(trace_dir, include_meta=True)
+    if not any(e["ph"] == "X" for e in events):
+        return None
     with open(out_path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return sum(1 for e in events if e["ph"] == "X")
@@ -147,6 +152,44 @@ def _timeline_lines(trace: dict) -> List[str]:
     return lines
 
 
+# -- perf ledger section -----------------------------------------------------
+
+
+def _perf_lines(rows: List[dict]) -> List[str]:
+    """Per-round flight-recorder table from ``perf.jsonl`` rows (phase
+    breakdown in ms + RSS watermark + recompile count), plus a summary
+    line.  Phases are columns, union across rounds — a round missing a
+    phase (checkpoint gated off) renders '-'."""
+    phases = sorted({p for r in rows for p in (r.get("phases") or {})})
+    out = ["  " + "  ".join(
+        [f"{'round':>6s}", f"{'total_ms':>9s}"]
+        + [f"{p[:14]:>14s}" for p in phases]
+        + [f"{'rss_peak_mb':>11s}", f"{'recomp':>6s}"])]
+    for r in rows:
+        ph = r.get("phases") or {}
+        rss = (r.get("rss") or {}).get("peak_bytes")
+        cells = [f"{str(r.get('round', '?')):>6s}",
+                 f"{r['round_s'] * 1e3:9.1f}" if r.get("round_s") is not None
+                 else f"{'-':>9s}"]
+        cells += [f"{ph[p] * 1e3:14.2f}" if p in ph else f"{'-':>14s}"
+                  for p in phases]
+        cells.append(f"{rss / 2 ** 20:11.1f}" if rss is not None
+                     else f"{'-':>11s}")
+        cells.append(f"{r.get('recompiles', 0):>6d}")
+        out.append("  " + "  ".join(cells))
+    late = [r for r in rows[1:] if r.get("recompiles")]
+    rss_peaks = [(r.get("rss") or {}).get("peak_bytes") for r in rows]
+    rss_peaks = [b for b in rss_peaks if b is not None]
+    out.append(
+        f"  {len(rows)} round(s); "
+        + (f"peak RSS {max(rss_peaks) / 2 ** 20:.1f} MiB; "
+           if rss_peaks else "no RSS watermark (no /proc); ")
+        + (f"RECOMPILES after the baseline round in "
+           f"{len(late)} round(s) — a hot function is retracing"
+           if late else "recompiles after the baseline round: 0"))
+    return out
+
+
 # -- renderer ----------------------------------------------------------------
 
 _ROUND_KEYS = ("round", "version", "step")
@@ -159,7 +202,11 @@ def _fmt(v) -> str:
 
 
 def render_report(run_dir: Optional[str] = None,
-                  trace_dir: Optional[str] = None) -> str:
+                  trace_dir: Optional[str] = None,
+                  perf_ledger: Optional[str] = None) -> str:
+    """``perf_ledger``: explicit ``perf.jsonl`` path for runs that wrote
+    it outside ``run_dir`` (the ``--perf_ledger`` flag); defaults to
+    ``run_dir/perf.jsonl``."""
     out: List[str] = ["=" * 64, "fedml_tpu run report", "=" * 64]
     summary = load_json(os.path.join(run_dir, "summary.json")) \
         if run_dir else None
@@ -195,6 +242,19 @@ def render_report(run_dir: Optional[str] = None,
         for e in round_rows:
             out.append("  " + "  ".join(
                 f"{_fmt(e[c]) if c in e else '-':>12s}" for c in cols))
+
+    perf_path = perf_ledger or (os.path.join(run_dir, "perf.jsonl")
+                                if run_dir else None)
+    perf_rows = load_jsonl(perf_path) if perf_path else []
+    if perf_rows:
+        out += ["", "-- perf ledger (perf.jsonl, phase ms) " + "-" * 25]
+        out += _perf_lines(perf_rows)
+    elif perf_ledger:
+        # an EXPLICITLY named ledger that renders nothing must say so —
+        # an instrumented run silently reporting as uninstrumented is
+        # the blindness this subsystem exists to end
+        out += ["", f"-- perf ledger: no rows at {perf_ledger} "
+                    f"(missing or empty)"]
 
     traces = group_round_traces(load_trace_events(trace_dir))
     if traces:
@@ -251,11 +311,23 @@ def main(argv=None) -> int:
                    help="directory holding per-process *.json span exports")
     p.add_argument("--merge_trace", default=None, metavar="OUT",
                    help="also write one combined Perfetto JSON here")
+    p.add_argument("--perf_ledger", default=None,
+                   help="explicit perf.jsonl path for runs that wrote it "
+                        "outside --run_dir (default: run_dir/perf.jsonl)")
     args = p.parse_args(argv)
-    if args.merge_trace and args.trace_dir:
-        n = merge_traces(args.trace_dir, args.merge_trace)
-        print(f"merged {n} span events -> {args.merge_trace}")
-    print(render_report(args.run_dir, args.trace_dir), end="")
+    if args.merge_trace:
+        if not args.trace_dir:
+            print("--merge_trace: no --trace_dir given; nothing to merge")
+        else:
+            n = merge_traces(args.trace_dir, args.merge_trace)
+            if n is None:
+                print(f"--merge_trace: no span exports under "
+                      f"{args.trace_dir!r} (missing or empty trace dir); "
+                      f"nothing written")
+            else:
+                print(f"merged {n} span events -> {args.merge_trace}")
+    print(render_report(args.run_dir, args.trace_dir,
+                        perf_ledger=args.perf_ledger), end="")
     return 0
 
 

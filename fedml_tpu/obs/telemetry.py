@@ -16,8 +16,11 @@ Design:
   hot-path event and allocates nothing per message.
 * **naming contract** — every metric name must match
   ``fedml_[a-z0-9_]+`` and end in a unit suffix ``_total`` / ``_seconds``
-  / ``_bytes`` (enforced at registration; linted by
+  / ``_bytes`` / ``_ratio`` (enforced at registration; linted by
   tests/test_metric_naming.py) so dashboards never chase renames.
+  ``_ratio`` exists for non-monotonic rate gauges — Prometheus tooling
+  treats ``*_total`` as counter-by-convention, so a gauge that goes up
+  AND down must not wear it.
 * **exposition** — ``render_prometheus()`` emits the text format; an
   optional ``start_http_server(port)`` serves it at ``/metrics`` from a
   stdlib ThreadingHTTPServer daemon thread; ``snapshot()``/``save()``
@@ -28,13 +31,16 @@ from __future__ import annotations
 
 import bisect
 import json
+import logging
 import os
 import re
 import threading
 import time
 from typing import Dict, Optional, Tuple
 
-NAME_RE = re.compile(r"^fedml_[a-z0-9_]+(_total|_seconds|_bytes)$")
+log = logging.getLogger(__name__)
+
+NAME_RE = re.compile(r"^fedml_[a-z0-9_]+(_total|_seconds|_bytes|_ratio)$")
 
 # wall-clock-latency buckets (seconds); callers pass their own for
 # count-valued histograms (quorum size, staleness)
@@ -193,7 +199,8 @@ class TelemetryRegistry:
         if not NAME_RE.match(name):
             raise ValueError(
                 f"telemetry metric {name!r} violates the naming contract "
-                f"fedml_[a-z0-9_]+ with a _total/_seconds/_bytes suffix")
+                f"fedml_[a-z0-9_]+ with a _total/_seconds/_bytes/_ratio "
+                f"suffix")
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             have = self._kinds.get(name)
@@ -324,21 +331,36 @@ def disable() -> None:
 
 
 def start_http_server(port: int, registry=None, host: str = ""):
-    """Serve ``GET /metrics`` (Prometheus text) on ``port`` from a daemon
-    thread.  Returns the server; call ``.shutdown()`` to stop it."""
+    """Serve ``GET /metrics`` (Prometheus text) and ``GET /healthz`` on
+    ``port`` from a daemon thread.  Returns the server — or **None when
+    the bind fails** (port already taken by a sibling run): a training
+    job must never crash over its scrape endpoint, so the failure warns
+    and the run continues unexported.  Call ``.shutdown()`` to stop it."""
     import http.server
 
     reg = registry if registry is not None else get_registry()
 
     class _Handler(http.server.BaseHTTPRequestHandler):
+        # socket read timeout (StreamRequestHandler applies it to the
+        # connection): a scraper that connects and then never sends its
+        # request line times out and closes instead of pinning a
+        # handler thread forever
+        timeout = 5
+
         def do_GET(self):
-            if self.path.rstrip("/") not in ("", "/metrics"):
+            # drop query strings: probes append cache-busters
+            path = self.path.split("?", 1)[0].rstrip("/")
+            if path == "/healthz":
+                body = b'{"status": "ok"}'
+                ctype = "application/json"
+            elif path in ("", "/metrics"):
+                body = reg.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            else:
                 self.send_error(404)
                 return
-            body = reg.render_prometheus().encode()
             self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -346,7 +368,12 @@ def start_http_server(port: int, registry=None, host: str = ""):
         def log_message(self, *args):  # quiet: no per-scrape stderr spam
             pass
 
-    server = http.server.ThreadingHTTPServer((host, port), _Handler)
+    try:
+        server = http.server.ThreadingHTTPServer((host, port), _Handler)
+    except OSError as e:
+        log.warning("telemetry: cannot serve /metrics on port %d (%s) — "
+                    "continuing without the HTTP endpoint", port, e)
+        return None
     server.daemon_threads = True
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name=f"telemetry-http-{port}")

@@ -1,0 +1,278 @@
+"""Perf trend gate over flight-recorder ledgers + the timing-trust lint
+(CLI: ``scripts/perf_trend.py``).
+
+Three checks, each CI-usable (non-zero exit on failure, every verdict
+names the phase/artifact that tripped it):
+
+* **phase regression** — per-phase medians of the current ``perf.jsonl``
+  vs a baseline ledger; a phase beyond ``noise_frac`` AND ``min_abs_s``
+  (both must trip — a 2ms phase doubling is noise, a 2s phase doubling
+  is not) is a named regression.
+* **recompile gate** — any ledger round after the first with
+  ``recompiles > 0`` fails: the flight recorder's sentry counted a hot
+  function retracing (the PR 5 double-compile class).
+* **mfu lint** — every mfu value in every given JSON artifact must be
+  ≤ 1.0 *or explicitly retracted* (a ``timing_untrusted`` mark on the
+  artifact, or an ``mfu_retracted`` key beside the offending cell).
+  The BENCH_DETAILS mfu-1.57 retraction becomes an automatic check,
+  not an archaeology finding.
+
+``max_mfu`` here is the single source of truth for "largest MFU
+anywhere in an artifact" (recursive — nested scaling curves included);
+``bench._max_mfu`` delegates to it, so the promotion/carry refusal
+contract and this lint can never disagree about what an artifact
+claims.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import statistics
+from typing import Dict, Iterator, List, Optional, Tuple
+
+# markers that make an mfu > 1.0 value an acknowledged retraction
+# instead of a lint violation: artifact-level timing_untrusted (the
+# bench quarantine path writes it), or a sibling mfu_retracted note on
+# the offending cell/any enclosing dict
+RETRACTION_KEYS = ("timing_untrusted", "mfu_retracted")
+
+
+# ---------------------------------------------------------------------------
+# mfu lint
+# ---------------------------------------------------------------------------
+
+def iter_mfu(obj, path: str = "",
+             retracted: bool = False) -> Iterator[Tuple[str, float, bool]]:
+    """Yield ``(json_path, value, retracted)`` for every numeric ``mfu``
+    key anywhere in ``obj``.  ``retracted`` is sticky downward: a
+    retraction marker on any enclosing dict covers its whole subtree."""
+    if isinstance(obj, dict):
+        here = retracted or any(obj.get(k) for k in RETRACTION_KEYS)
+        for k, v in obj.items():
+            if k == "mfu" and isinstance(v, (int, float)):
+                yield f"{path}/mfu", float(v), here
+            else:
+                yield from iter_mfu(v, f"{path}/{k}", here)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            yield from iter_mfu(v, f"{path}[{i}]", retracted)
+
+
+def max_mfu(details) -> float:
+    """Largest MFU anywhere in an artifact (recursive; retraction
+    markers do NOT hide values here — an artifact carrying an impossible
+    number stays refusable as evidence even after it owns up to it)."""
+    return max((v for _, v, _ in iter_mfu(details)), default=0.0)
+
+
+def lint_mfu_artifacts(paths: List[str]) -> List[str]:
+    """Violations: unreadable artifacts and unretracted mfu > 1.0 cells.
+    Empty list == lint green."""
+    violations: List[str] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            violations.append(f"{path}: unreadable ({e})")
+            continue
+        for jpath, value, retracted in iter_mfu(data):
+            if value > 1.0 and not retracted:
+                violations.append(
+                    f"{path}:{jpath} = {value:.3g} > 1.0 — physically "
+                    f"impossible and not marked retracted (add "
+                    f"timing_untrusted or mfu_retracted, or re-capture)")
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# ledger loading + phase statistics
+# ---------------------------------------------------------------------------
+
+def load_ledger(path: str) -> List[dict]:
+    """Read a ``perf.jsonl`` ledger; a torn final line (crashed run) is
+    skipped, any other malformed line fails loudly."""
+    rows: List[dict] = []
+    with open(path) as f:
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                continue  # torn tail of a crashed run
+            raise ValueError(f"{path}:{i + 1}: malformed ledger line")
+    return rows
+
+
+def validate_ledger(rows: List[dict]) -> List[str]:
+    """Schema check: every line carries round/phases/recompiles (and an
+    RSS watermark where the platform provides one)."""
+    problems = []
+    if not rows:
+        return ["ledger is empty"]
+    for i, row in enumerate(rows):
+        for key in ("round", "phases", "recompiles", "wire"):
+            if key not in row:
+                problems.append(f"line {i + 1}: missing {key!r}")
+        if "rss" in row and row["rss"] is not None \
+                and "peak_bytes" not in row["rss"]:
+            problems.append(f"line {i + 1}: rss without peak_bytes")
+    return problems
+
+
+def phase_medians(rows: List[dict],
+                  skip_first: bool = True) -> Dict[str, float]:
+    """Median per-phase seconds across the ledger (plus ``round_s``).
+    The first round is skipped by default: it pays the jit compiles and
+    would poison both sides of a comparison — even (especially) when it
+    is the ONLY round, since a one-round smoke gated against a
+    steady-state baseline would read its compile cost as a regression.
+    A single-round ledger therefore yields no medians."""
+    if skip_first:
+        rows = rows[1:]
+    acc: Dict[str, List[float]] = {}
+    for row in rows:
+        for name, dt in (row.get("phases") or {}).items():
+            acc.setdefault(name, []).append(float(dt))
+        if row.get("round_s") is not None:
+            acc.setdefault("round_s", []).append(float(row["round_s"]))
+    return {name: statistics.median(vals) for name, vals in acc.items()}
+
+
+def check_recompiles(rows: List[dict]) -> List[str]:
+    """Rounds after the ledger's first line with recompiles > 0."""
+    return [f"round {row.get('round')}: {row['recompiles']} recompile(s) "
+            f"after the baseline round "
+            f"({row.get('recompiled', {})})"
+            for row in rows[1:] if row.get("recompiles")]
+
+
+def compare_ledgers(current: List[dict], baseline: List[dict],
+                    noise_frac: float = 0.25,
+                    min_abs_s: float = 0.005) -> List[dict]:
+    """Per-phase regressions of ``current`` vs ``baseline`` medians.
+    A phase regresses when it exceeds the baseline by BOTH the relative
+    noise band and the absolute floor."""
+    cur = phase_medians(current)
+    base = phase_medians(baseline)
+    out = []
+    for name in sorted(base):
+        b, c = base[name], cur.get(name)
+        if c is None:
+            continue  # phase absent this run (e.g. checkpointing off)
+        if c > b * (1.0 + noise_frac) and (c - b) > min_abs_s:
+            out.append({"phase": name, "baseline_s": b, "current_s": c,
+                        "ratio": (c / b) if b else float("inf")})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _expand(patterns: List[str]) -> List[str]:
+    paths: List[str] = []
+    for pat in patterns:
+        # a pattern matching nothing passes through verbatim — the lint
+        # then reports it unreadable, loudly
+        paths.extend(sorted(_glob.glob(pat)) or [pat])
+    return paths
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="perf_trend",
+        description="Perf regression gate over flight-recorder ledgers "
+                    "(+ the mfu<=1.0 timing-trust lint). Exit 0 = pass, "
+                    "1 = regression/lint failure, 2 = missing inputs.")
+    p.add_argument("--ledger", default=None,
+                   help="current run's perf.jsonl")
+    p.add_argument("--baseline", default=None,
+                   help="baseline perf.jsonl to gate against (optional: "
+                        "without it only schema + recompile checks run)")
+    p.add_argument("--noise", type=float, default=0.25,
+                   help="relative noise band a phase must exceed to count "
+                        "as a regression (default 0.25 = +25%%)")
+    p.add_argument("--min_abs_ms", type=float, default=5.0,
+                   help="absolute floor (ms) a regression must also exceed")
+    p.add_argument("--lint_mfu", nargs="*", default=None, metavar="GLOB",
+                   help="JSON artifacts (globs ok) to lint for "
+                        "unretracted mfu > 1.0")
+    p.add_argument("--no_recompile_gate", action="store_true",
+                   help="skip the recompiles-after-round-0 gate")
+    args = p.parse_args(argv)
+    if args.ledger is None and not args.lint_mfu:
+        p.print_usage()
+        print("perf_trend: nothing to do (pass --ledger and/or --lint_mfu)")
+        return 2
+
+    failures: List[str] = []
+
+    if args.ledger is not None:
+        try:
+            rows = load_ledger(args.ledger)
+        except (OSError, ValueError) as e:
+            print(f"perf_trend: cannot read ledger: {e}")
+            return 2
+        problems = validate_ledger(rows)
+        failures += [f"ledger schema: {x}" for x in problems]
+        if not problems:
+            print(f"ledger: {len(rows)} rounds, phases "
+                  f"{sorted({k for r in rows for k in r['phases']})}")
+        if not args.no_recompile_gate:
+            failures += [f"recompile gate: {x}"
+                         for x in check_recompiles(rows)]
+        if args.baseline is not None:
+            try:
+                base = load_ledger(args.baseline)
+            except (OSError, ValueError) as e:
+                print(f"perf_trend: cannot read baseline: {e}")
+                return 2
+            if len(rows) < 2:
+                # the only round pays the jit compiles; gating it against
+                # a steady-state baseline would flag compile cost as a
+                # regression — say so instead of a hollow "no regression"
+                print("phase gate: ledger has no steady-state rounds "
+                      "after the compile-paying first round — nothing "
+                      "to compare (run >= 2 rounds for a gateable "
+                      "ledger)")
+            else:
+                regressions = compare_ledgers(
+                    rows, base, noise_frac=args.noise,
+                    min_abs_s=args.min_abs_ms / 1e3)
+                for r in regressions:
+                    failures.append(
+                        f"phase regression: {r['phase']} "
+                        f"{r['baseline_s'] * 1e3:.1f}ms -> "
+                        f"{r['current_s'] * 1e3:.1f}ms "
+                        f"({r['ratio']:.2f}x, band +{args.noise:.0%})")
+                if not regressions:
+                    print(f"phase gate: no regression vs {args.baseline} "
+                          f"(band +{args.noise:.0%}, floor "
+                          f"{args.min_abs_ms:.1f}ms)")
+
+    if args.lint_mfu:
+        paths = _expand(args.lint_mfu)
+        violations = lint_mfu_artifacts(paths)
+        failures += [f"mfu lint: {v}" for v in violations]
+        if not violations:
+            print(f"mfu lint: {len(paths)} artifact(s) green "
+                  f"(every mfu <= 1.0 or explicitly retracted)")
+
+    if failures:
+        for f_ in failures:
+            print(f"FAIL {f_}")
+        print(f"perf_trend: {len(failures)} failure(s)")
+        return 1
+    print("perf_trend: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

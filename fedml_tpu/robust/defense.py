@@ -42,7 +42,8 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
                             byz_f: int = 0, krum_m: int = 1,
                             gm_iters: int = 8, gm_eps: float = 1e-6,
                             norm_clip: float = 0.0, noise_std: float = 0.0,
-                            seed: int = 0, donate="auto") -> Callable:
+                            seed: int = 0, donate="auto",
+                            sentry=None) -> Callable:
     """Build the jitted ``fn(global_params, stacked, weights, step) ->
     new_params`` the server actors call once per round/version.
 
@@ -64,6 +65,12 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
     donation on every call, and the sync/async servers both pass numpy
     cohorts, so there is nothing to reuse there anyway.  Donation never
     adds a trace — the jit-once pin holds with it on or off.
+
+    ``sentry``: a `fedml_tpu.obs.perf.RecompileSentry`; when set, the
+    returned jit registers itself, so the flight recorder counts (and
+    under strict mode fails) any round that grows its cache — the
+    ``_cache_size() == 1`` acceptance criterion, enforced live instead
+    of only in tests.
     """
     if method not in ROBUST_AGG_METHODS:
         raise ValueError(f"unknown robust aggregation method {method!r}; "
@@ -92,4 +99,7 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
 
     if donate == "auto":
         donate = jax.default_backend() != "cpu"
-    return jax.jit(_aggregate, donate_argnums=(1,) if donate else ())
+    fn = jax.jit(_aggregate, donate_argnums=(1,) if donate else ())
+    if sentry is not None:
+        sentry.register(f"defended_aggregate[{method}]", fn)
+    return fn

@@ -15,6 +15,11 @@ with a real LB).  Endpoints:
 * ``GET /healthz`` — 200 with ``{"status": "ok", "version": ...,
   "queue_depth": ...}`` once a model is live, 503 before (a load
   balancer keeps the instance out of rotation until the first publish).
+  ``GET /healthz?deep=1`` additionally runs the SLO evaluator
+  (`obs/perf.SloEvaluator` — round-duration p95, shed rate, torn-frame
+  rate, quarantine rate over the telemetry registry): 200 while every
+  SLO holds, **503 with the per-SLO verdict** on breach, so an LB can
+  rotate out an instance that is up but violating its objectives.
 * ``GET /version`` — the live/pinned version and known history (the
   bench asserts this ADVANCES across hot swaps).
 * ``GET /metrics`` — Prometheus text from the process telemetry
@@ -52,9 +57,13 @@ class ServeFrontend:
     batcher — in-flight requests still answer."""
 
     def __init__(self, registry: ModelRegistry, batcher: MicroBatcher,
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1", slo=None):
+        """``slo``: a `fedml_tpu.obs.perf.SloEvaluator`; when set,
+        ``/healthz?deep=1`` evaluates it (deep probes without one answer
+        the shallow payload plus ``"deep": "unconfigured"``)."""
         self.registry = registry
         self.batcher = batcher
+        self.slo = slo
         self._host = host
         self._requested_port = port
         self._server: Optional[http.server.ThreadingHTTPServer] = None
@@ -69,7 +78,7 @@ class ServeFrontend:
     def start(self) -> "ServeFrontend":
         if self._server is not None:
             return self
-        handler = _make_handler(self.registry, self.batcher)
+        handler = _make_handler(self.registry, self.batcher, self.slo)
         self._server = http.server.ThreadingHTTPServer(
             (self._host, self._requested_port), handler)
         self._server.daemon_threads = True
@@ -90,7 +99,8 @@ class ServeFrontend:
         self.batcher.stop(drain=drain)
 
 
-def _make_handler(registry: ModelRegistry, batcher: MicroBatcher):
+def _make_handler(registry: ModelRegistry, batcher: MicroBatcher,
+                  slo=None):
     class _Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"  # keep-alive: the load generator
         # reuses connections, without this every request pays a TCP dial
@@ -107,16 +117,34 @@ def _make_handler(registry: ModelRegistry, batcher: MicroBatcher):
             self.wfile.write(body)
 
         def do_GET(self):
-            # drop any query string before matching: LB health probes
-            # commonly append cache-busting params (/healthz?probe=1)
-            path = self.path.split("?", 1)[0].rstrip("/")
+            # split the query off before matching: LB health probes
+            # commonly append cache-busting params (/healthz?probe=1);
+            # the one query parameter that IS meaningful is healthz's
+            # deep=1
+            path, _, query = self.path.partition("?")
+            path = path.rstrip("/")
             if path == "/healthz":
                 m = registry.current()
                 if m is None:
                     self._reply(503, {"status": "no_model"})
-                else:
-                    self._reply(200, {"status": "ok", "version": m.version,
-                                      "queue_depth": batcher.depth()})
+                    return
+                body = {"status": "ok", "version": m.version,
+                        "queue_depth": batcher.depth()}
+                deep = "deep=1" in query.split("&")
+                if deep and slo is None:
+                    body["deep"] = "unconfigured"
+                elif deep:
+                    # query path: read the objectives without ticking the
+                    # breach counters — those count once per round (the
+                    # runner's evaluate()), not once per LB probe
+                    results = slo.evaluate(count_breaches=False)
+                    ok = all(v["ok"] for v in results.values())
+                    body["slo"] = results
+                    if not ok:
+                        body["status"] = "slo_breach"
+                        self._reply(503, body)
+                        return
+                self._reply(200, body)
             elif path == "/version":
                 self._reply(200, {"version": registry.version,
                                   "pinned": registry.pinned,

@@ -44,6 +44,10 @@ def instrument_train_fn(train_fn, epochs: int = 1, registry=None):
       ``epochs``: the scan revisits every batch each epoch, so one call
       consumes ``epochs * mask.sum()`` examples.
 
+    The wrapper forwards the underlying jit's ``_cache_size`` probe, so
+    the flight recorder's `RecompileSentry` (obs/perf.py) can register
+    the instrumented function directly and catch a retracing trainer.
+
     With telemetry disabled this returns ``train_fn`` unchanged — zero
     wrapper, zero cost."""
     reg = registry if registry is not None else telemetry.get_registry()
@@ -75,6 +79,9 @@ def instrument_train_fn(train_fn, epochs: int = 1, registry=None):
             c_examples.inc(epochs * float(np.asarray(mask).sum()))
         return out
 
+    cache_size = getattr(train_fn, "_cache_size", None)
+    if cache_size is not None:
+        instrumented._cache_size = cache_size
     return instrumented
 
 

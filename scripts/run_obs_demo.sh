@@ -7,7 +7,10 @@
 #     broadcast -> train -> upload -> aggregate,
 #   * a Prometheus text snapshot with link/chaos counters and
 #     failure-detector gauges,
-#   * an obs_report per-round timeline.
+#   * an obs_report per-round timeline,
+#   * a perf.jsonl flight-recorder ledger (ISSUE 6) that passes the
+#     perf_trend gate honestly and FAILS it on a seeded regression,
+#     with the mfu<=1.0 lint green over every committed BENCH artifact.
 #
 # Usage: scripts/run_obs_demo.sh [workdir]  (default: a fresh mktemp dir)
 set -euo pipefail
@@ -25,7 +28,8 @@ env JAX_PLATFORMS=cpu python -m fedml_tpu \
     --chaos_drop 0.05 --chaos_delay 0.3 --chaos_dup 0.1 \
     --chaos_reorder 0.1 --chaos_seed 7 \
     --heartbeat_s 0.2 --dead_after_s 5 \
-    --run_dir "$RUN" --trace_dir "$TRACE" --telemetry true
+    --run_dir "$RUN" --trace_dir "$TRACE" --telemetry true \
+    --perf true --perf_strict true
 
 REPORT="$DIR/report.txt"
 env JAX_PLATFORMS=cpu python scripts/obs_report.py \
@@ -53,4 +57,34 @@ names = {e["name"] for e in events}
 assert {"round", "broadcast", "train", "upload", "aggregate"} <= names, names
 print(f"merged trace OK: {len(events)} spans, phases {sorted(names)}")
 EOF
+
+echo "== asserting the flight recorder (perf.jsonl + trend gate)"
+[ -s "$RUN/perf.jsonl" ]
+# the report renders the ledger section
+grep -q "perf ledger" "$REPORT"
+# honest ledger: schema + recompile gate + mfu lint over every
+# committed BENCH artifact all green (exit 0)
+env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
+    --ledger "$RUN/perf.jsonl" --baseline "$RUN/perf.jsonl" \
+    --lint_mfu 'BENCH_*.json' 'MULTICHIP_*.json' SCALE_PROOF.json
+# seeded +60% regression on the aggregate phase MUST fail the gate
+# (non-zero exit, naming the phase) — proving the gate can actually
+# catch what it exists to catch
+python - "$RUN/perf.jsonl" "$DIR/perf_regressed.jsonl" <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+for r in rows:
+    for k in r.get("phases", {}):
+        if k in ("aggregate", "defended_aggregate", "broadcast_serialize"):
+            r["phases"][k] = r["phases"][k] * 1.6 + 0.05
+with open(sys.argv[2], "w") as f:
+    f.writelines(json.dumps(r) + "\n" for r in rows)
+EOF
+if env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
+    --ledger "$DIR/perf_regressed.jsonl" --baseline "$RUN/perf.jsonl" \
+    > "$DIR/trend_fail.txt"; then
+    echo "ERROR: trend gate passed a seeded +60% regression"; exit 1
+fi
+grep -q "phase regression" "$DIR/trend_fail.txt"
+echo "trend gate OK: honest ledger passes, seeded regression fails"
 echo "== obs demo OK ($DIR)"

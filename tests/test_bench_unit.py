@@ -7,6 +7,7 @@ the host-side logic that never touches an accelerator.)"""
 
 import json
 import os
+import time
 
 import numpy as np
 import pytest
@@ -360,11 +361,23 @@ def test_quarantine_spares_previous_runs_partial(tmp_path):
 def test_timing_sanity_on_cpu_backend():
     """The gate itself, end-to-end on the CPU backend: a synchronous
     backend must pass all three checks (linearity, sync, checksum) and
-    report a finite verified throughput.  Retried like main() does: this
-    1-core container's background probes can blur one timing run."""
+    report a finite verified throughput.  Retried like main() does — but
+    each retry GROWS the workload: under a full pytest run this 1-core
+    container's background load makes the smallest (n=512, iters=4)
+    measurement overhead-dominated, which no number of same-size retries
+    fixes.  More work per timed loop shrinks the overhead fraction, so
+    the linearity ratio converges to 2 exactly when the timer is honest —
+    and a wall-clock flake here would erode trust in the gate it pins."""
     out = bench.bench_timing_sanity(n=512, iters=4)
-    if not out["trusted"]:
-        out = bench.bench_timing_sanity(n=512, iters=4)
+    for settle_s, (n, iters) in ((1, (512, 8)), (2, (768, 8)),
+                                 (4, (1024, 8))):
+        if out["trusted"]:
+            break
+        # let straggling daemon threads from earlier suites drain: the
+        # linearity ratio is only meaningful when both sides of the
+        # t(2R)/t(R) comparison see the same background load
+        time.sleep(settle_s)
+        out = bench.bench_timing_sanity(n=n, iters=iters)
     assert out["trusted"], out["failures"]
     assert np.isfinite(out["checksum"])
     assert out["tflops_readback_verified"] > 0
@@ -488,3 +501,39 @@ def test_emit_skipped_refusal_names_the_actual_cause(tmp_path, monkeypatch,
     (reason,) = line["committed_artifacts_refused"]
     assert "linearity ratio 1.02" in reason
     assert "mfu" not in reason.split("—")[0]
+
+
+def test_emit_skipped_embeds_cpu_fallback(tmp_path, monkeypatch, capsys):
+    """A wedged-tunnel BENCH line must still carry a REAL measured number
+    — the CPU wire/aggregation microbench, labeled backend "cpu" — while
+    the headline metric stays honestly null/stale (never a CPU figure
+    dressed as a TPU one)."""
+    import fedml_tpu.utils.wirebench as wirebench
+    monkeypatch.setattr(
+        wirebench, "cpu_fallback_bench",
+        lambda: {"backend": "cpu", "broadcast_encode_ms": 1.25})
+    line = _emit_skipped_line(tmp_path, monkeypatch, capsys, {
+        "BENCH_DETAILS.json": {
+            "platform": "tpu",
+            "configs": {"femnist_cnn_c10_scan20": {"rounds_per_s": 300.0}}}})
+    assert line["cpu_fallback"]["backend"] == "cpu"
+    assert line["cpu_fallback"]["broadcast_encode_ms"] == 1.25
+    # the embedding changes NOTHING about the headline honesty contract
+    assert line["stale"] is True and "vs_baseline" not in line
+    assert line["value"] == pytest.approx(300.0)
+
+
+def test_emit_skipped_cpu_fallback_failure_never_masks(tmp_path,
+                                                       monkeypatch, capsys):
+    """A crashing fallback bench must not take the skip line down with it
+    — the error lands in the artifact, clearly labeled."""
+    import fedml_tpu.utils.wirebench as wirebench
+
+    def boom():
+        raise RuntimeError("wirebench exploded")
+
+    monkeypatch.setattr(wirebench, "cpu_fallback_bench", boom)
+    line = _emit_skipped_line(tmp_path, monkeypatch, capsys, {})
+    assert line["cpu_fallback"]["backend"] == "cpu"
+    assert "wirebench exploded" in line["cpu_fallback"]["error"]
+    assert line["value"] is None

@@ -62,6 +62,12 @@ EXPECTED = {
     # PR 5: the encode-once wire path (comm/message.py, actors, staging)
     "fedml_wire_encode_seconds", "fedml_wire_fanout_total",
     "fedml_wire_staged_uploads_total", "fedml_wire_torn_frames_total",
+    # PR 6: the performance flight recorder + SLO evaluator (obs/perf.py)
+    "fedml_perf_recompiles_total", "fedml_perf_rounds_total",
+    "fedml_perf_rss_peak_bytes", "fedml_perf_phase_seconds",
+    "fedml_slo_round_duration_p95_seconds",
+    "fedml_slo_serve_shed_ratio", "fedml_slo_torn_frame_ratio",
+    "fedml_slo_quarantine_per_round_ratio", "fedml_slo_breaches_total",
 }
 
 
