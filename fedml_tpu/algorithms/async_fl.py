@@ -31,6 +31,7 @@ FedAvgClientActor choreography — INIT/SYNC in, MODEL out.
 
 from __future__ import annotations
 
+import collections
 import logging
 import math
 import time
@@ -93,6 +94,7 @@ class AsyncFedServerActor(ServerManager):
                  retask_timeout_s: Optional[float] = None,
                  admission=None,
                  defended_aggregate: Optional[Callable] = None,
+                 stream_agg=None,
                  encode_once: bool = True,
                  perf=None):
         """``checkpointer``: a `RoundCheckpointer`; every applied version
@@ -126,6 +128,16 @@ class AsyncFedServerActor(ServerManager):
         through staleness claims.  When None, the exact legacy
         sample+discount weighted mean is used.
 
+        ``stream_agg``: a `fedml_tpu.core.stream_agg.StreamingAggregator`
+        built with ``kind="delta"`` (``--agg_mode stream``) — each
+        admitted delta FOLDS into O(model) running state at arrival (the
+        ledger's ``fold`` phase) and the buffer keeps only metadata
+        tuples, so the server never holds ``goal`` model-sized deltas at
+        once.  The version-close semantics mirror the defended stack
+        path exactly: the rule sees raw sample weights, and the buffer's
+        sample-weighted MEAN staleness discount scales the applied step
+        afterwards.  Mutually exclusive with ``defended_aggregate``.
+
         ``encode_once``: the tasking fan-outs (initial wave, post-version
         re-task of the consumed silos) ride the transport's ``send_many``
         — the global serializes once per wave instead of once per silo.
@@ -151,7 +163,13 @@ class AsyncFedServerActor(ServerManager):
         self.server_lr = server_lr
         self.on_version = on_version
         self.version = 0
-        self.staleness_seen: List[int] = []  # per consumed upload
+        # per consumed upload, BOUNDED at insert (newest 4096): one
+        # entry per upload forever is O(cohort * versions) host memory
+        # at mega-cohort scale — the cap-at-insert discipline every
+        # per-upload history on the live path follows (admission's
+        # norm/event windows, the dedupe ledger's pruning)
+        self.staleness_seen: collections.deque = collections.deque(
+            maxlen=4096)
         self._buffer: List[Tuple[object, float, float, int]] = []
         self._task_rng = np.random.RandomState(seed)
         self.checkpointer = checkpointer
@@ -162,7 +180,12 @@ class AsyncFedServerActor(ServerManager):
         # guard must survive buffer flushes, not just scan the live buffer
         self._consumed: set = set()
         self.admission = admission
+        if defended_aggregate is not None and stream_agg is not None:
+            raise ValueError("defended_aggregate (stack mode) and "
+                             "stream_agg (stream mode) are mutually "
+                             "exclusive; pick one --agg_mode")
         self.defended_aggregate = defended_aggregate
+        self.stream_agg = stream_agg
         self.encode_once = encode_once
         self.perf = perf
         # host mirror of the current global — a tasking wave re-tasks up
@@ -222,6 +245,10 @@ class AsyncFedServerActor(ServerManager):
         ids = sample_clients(0, self.client_num_in_total, self.n_silos)
         now = time.monotonic()
         self._version_t0 = now
+        if self.stream_agg is not None:
+            # stream mode: open the first version's fold state (later
+            # versions reset at each _apply_buffer close)
+            self.stream_agg.reset(self.params)
         if self.perf is not None:
             self.perf.round_start(self.version)
         # one root span for the initial tasking wave, so version-0 silo
@@ -406,6 +433,13 @@ class AsyncFedServerActor(ServerManager):
         discount = float(1.0 + staleness) ** (-self.alpha)
         self.staleness_seen.append(staleness)
         self._h_staleness.observe(staleness)
+        if self.stream_agg is not None:
+            # fold at arrival: the buffer keeps only the metadata tuple
+            # (weights/discounts/at-most-once bookkeeping) — the delta's
+            # bytes never wait for the version to close
+            with self._perf_phase("fold"):
+                self.stream_agg.fold(delta, num_samples)
+            delta = None
         self._buffer.append(
             (delta, num_samples, discount, msg.sender_id, base_version))
         if len(self._buffer) >= self._effective_goal():
@@ -480,14 +514,35 @@ class AsyncFedServerActor(ServerManager):
                              np.float64)
         discounts = np.asarray([c for _, _, c, _, _ in self._buffer],
                                np.float64)
+        defended = (self.defended_aggregate is not None
+                    or (self.stream_agg is not None
+                        and self.stream_agg.defended))
         # traced as a child of whichever upload's handling tripped the
         # goal, so the async trace shows which silo closed each version
         with self._span("aggregate", version=self.version,
                         buffered=len(deltas)), \
-                self._perf_phase("defended_aggregate"
-                                 if self.defended_aggregate is not None
+                self._perf_phase("defended_aggregate" if defended
                                  else "aggregate"):
-            if self.defended_aggregate is not None:
+            def _apply_discounted(robust):
+                # shared defended/stream apply step: the rule (or the
+                # streamed mean) saw raw sample weights, and the
+                # buffer's sample-weighted MEAN staleness discount
+                # scales the applied step afterwards — one copy, so the
+                # two modes' bit-identity cannot silently fork
+                davg = float((discounts * samples).sum()
+                             / max(samples.sum(), 1e-12))
+                self.params = jax.tree.map(
+                    lambda p, d: (np.asarray(p, np.float64)
+                                  + self.server_lr * davg
+                                  * np.asarray(d, np.float64)).astype(
+                                      np.asarray(p).dtype),
+                    self.params, robust)
+
+            if self.stream_agg is not None:
+                # stream mode: the buffered deltas already folded at
+                # arrival — the version close is one finalize
+                _apply_discounted(self.stream_agg.finalize(self.version))
+            elif self.defended_aggregate is not None:
                 # staleness-aware defended variant: the Byzantine rule
                 # sees the raw sample weights (staleness claims cannot
                 # steer the selection), and the buffer's sample-weighted
@@ -509,16 +564,8 @@ class AsyncFedServerActor(ServerManager):
                 if self._stacked_zeros is None:
                     self._stacked_zeros = jax.tree.map(
                         lambda x: np.zeros(x.shape[1:], x.dtype), stacked)
-                robust = self.defended_aggregate(
-                    self._stacked_zeros, stacked, w, self.version)
-                davg = float((discounts * samples).sum()
-                             / max(samples.sum(), 1e-12))
-                self.params = jax.tree.map(
-                    lambda p, d: (np.asarray(p, np.float64)
-                                  + self.server_lr * davg
-                                  * np.asarray(d, np.float64)).astype(
-                                      np.asarray(p).dtype),
-                    self.params, robust)
+                _apply_discounted(self.defended_aggregate(
+                    self._stacked_zeros, stacked, w, self.version))
             else:
                 # sample ratios sum to 1; the staleness discount
                 # multiplies each term so stale buffers shrink the
@@ -536,6 +583,10 @@ class AsyncFedServerActor(ServerManager):
         silos = [s for _, _, _, s, _ in self._buffer]
         self._consumed.update((s, b) for _, _, _, s, b in self._buffer)
         self._buffer.clear()
+        if self.stream_agg is not None:
+            # the next version's fold state opens here, before the event
+            # loop can hand us another upload
+            self.stream_agg.reset(self.params)
         self.version += 1
         if self._rejected_crcs:
             # prune the dedupe ledger: a duplicate of a frame 64+

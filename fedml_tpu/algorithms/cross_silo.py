@@ -157,6 +157,7 @@ class FedAvgServerActor(ServerManager):
                  extra_state: Optional[tuple] = None,
                  admission=None,
                  aggregate_fn: Optional[Callable] = None,
+                 stream_agg=None,
                  encode_once: bool = True,
                  incremental_staging: bool = True,
                  perf=None):
@@ -237,13 +238,27 @@ class FedAvgServerActor(ServerManager):
         (the runner) registers hot jits and closes it.
 
         ``incremental_staging``: with an ``aggregate_fn`` set, each
-        admitted upload is copied into its slot of a persistent
-        ``[cohort, ...]`` host staging buffer AT ARRIVAL TIME — staging
-        overlaps the straggler wait, so closing the round does only the
-        H2D transfer + the defended jit instead of a serial O(cohort)
-        ``np.stack`` per leaf at the barrier.  False restores the seed
+        admitted upload is copied into its slot of a ``[cohort, ...]``
+        host staging buffer AT ARRIVAL TIME — staging overlaps the
+        straggler wait, so closing the round does only the H2D transfer
+        + the defended jit instead of a serial O(cohort) ``np.stack``
+        per leaf at the barrier.  The buffer is RELEASED at round close
+        (reallocated next round), so stack-mode RSS returns to baseline
+        between rounds instead of pinning the cohort watermark for the
+        life of the federation.  False restores the seed
         stack-at-the-barrier path (bit-identical results either way;
         tests/test_wire.py pins the equivalence).
+
+        ``stream_agg``: a `fedml_tpu.core.stream_agg.StreamingAggregator`
+        — the O(model)-memory replacement for the ``[cohort, ...]``
+        buffer entirely (``--agg_mode stream``).  Each admitted upload
+        FOLDS into running state on the receive path (the ledger's
+        ``fold`` phase) and the barrier-close runs one ``finalize``; no
+        cohort-sized host buffer ever exists, so server peak RSS is
+        flat in cohort size (BENCH_stream.json).  Mutually exclusive
+        with ``aggregate_fn`` — the stack path stays behind
+        ``--agg_mode stack`` for equivalence pinning (the ``mean``
+        results are bit-identical; tests/test_stream_agg.py).
         """
         super().__init__(0, transport)
         if straggler_policy not in ("wait", "drop", "abort"):
@@ -267,7 +282,12 @@ class FedAvgServerActor(ServerManager):
         self.publish = publish
         self.extra_state = extra_state
         self.admission = admission
+        if aggregate_fn is not None and stream_agg is not None:
+            raise ValueError("aggregate_fn (stack mode) and stream_agg "
+                             "(stream mode) are mutually exclusive; pick "
+                             "one --agg_mode")
         self.aggregate_fn = aggregate_fn
+        self.stream_agg = stream_agg
         self.encode_once = encode_once
         self.incremental_staging = incremental_staging
         self.perf = perf
@@ -284,6 +304,10 @@ class FedAvgServerActor(ServerManager):
         self._staging_leaves: Optional[list] = None
         self._staging_def = None
         self._staged: Set[int] = set()
+        self._staged_seen = 0  # lifetime staged uploads (buffer is
+        #                        released each round close — see
+        #                        _complete_round — so this is the only
+        #                        cross-round evidence staging ran)
         self._num_silos = 0  # silos contacted this round (= sampled cohort)
         self._expected: Set[int] = set()  # silos the barrier waits on
         self._timer = SelfMessageTimer()
@@ -445,11 +469,10 @@ class FedAvgServerActor(ServerManager):
                 trace_id=self._tracer.new_trace_id(
                     f"round{self.round_idx}"),
                 round=self.round_idx)
-        # the new round owns the staging buffer from here: slots will be
-        # rewritten by this round's arrivals (or refilled with the global
-        # at completion), so last round's contents are dead weight now
-        self._staged.clear()
-        self._g_staged.set(0)
+        if self.stream_agg is not None:
+            # stream mode: open the fold state against the new global
+            # (the round's clip reference)
+            self.stream_agg.reset(self.params)
         host_params = self._host_params()
         extra = ({} if self._last_accepted is None
                  else {Message.ARG_ACCEPTED: self._last_accepted})
@@ -656,8 +679,14 @@ class FedAvgServerActor(ServerManager):
         With incremental staging on, an admitted upload is written into
         its cohort slot HERE — on the receive path, while the round is
         still waiting on stragglers — so the barrier-close does no
-        per-leaf stacking at all."""
-        if entry is not None and self._staging_active():
+        per-leaf stacking at all.  In stream mode the upload FOLDS into
+        the O(model) running aggregate here instead, and nothing
+        model-sized survives the fold."""
+        if entry is not None and self.stream_agg is not None:
+            with self._perf_phase("fold"):
+                self.stream_agg.fold(entry[0], entry[1])
+            entry = (self._STAGED, entry[1])
+        elif entry is not None and self._staging_active():
             with self._perf_phase("staging"):
                 self._stage(silo, entry[0])
             entry = (self._STAGED, entry[1])
@@ -701,6 +730,7 @@ class FedAvgServerActor(ServerManager):
                     f"match the global template ({buf.dtype})")
             buf[silo - 1] = arr
         self._staged.add(silo)
+        self._staged_seen += 1
         self._g_staged.set(len(self._staged))
 
     def _stack_cohort(self, admitted: Dict[int, tuple]):
@@ -728,9 +758,10 @@ class FedAvgServerActor(ServerManager):
         admitted uploads were already written into their slots at arrival
         time, so the barrier-close only refills the ABSENT slots (dropped,
         quarantined, rejected) with the current global — weight 0, the
-        same zero diff every defense masks out.  The buffer keeps the
-        static ``[cohort, ...]`` shape across rounds, so the defended jit
-        still compiles exactly once."""
+        same zero diff every defense masks out.  The buffer is released
+        at round close and reallocated per round with the SAME static
+        ``[cohort, ...]`` shapes/dtypes, so the defended jit still
+        compiles exactly once."""
         n = self._num_silos
         if self._staging is None:
             # every upload this round was rejected before staging; the
@@ -774,14 +805,20 @@ class FedAvgServerActor(ServerManager):
         # assume the rejected uploads were aggregated
         self._last_accepted = np.asarray(sorted(admitted), np.int32)
         self._received.clear()
+        defended = (self.aggregate_fn is not None
+                    or (self.stream_agg is not None
+                        and self.stream_agg.defended))
         with self._span("aggregate", parent=self._round_span,
                         round=self.round_idx, quorum=len(admitted)), \
-                self._perf_phase("defended_aggregate"
-                                 if self.aggregate_fn is not None
+                self._perf_phase("defended_aggregate" if defended
                                  else "aggregate"):
             if not admitted:
                 log.warning("round %d: no admissible uploads; the global "
                             "model is unchanged this round", self.round_idx)
+            elif self.stream_agg is not None:
+                # stream mode: every admitted upload already folded at
+                # arrival — the barrier-close is one finalize, O(model)
+                self.params = self.stream_agg.finalize(self.round_idx)
             elif self.aggregate_fn is not None:
                 if self._staging_active():
                     stacked, w = self._staged_cohort(admitted)
@@ -800,6 +837,16 @@ class FedAvgServerActor(ServerManager):
                 weights = np.array([admitted[s][1] for s in sorted(admitted)],
                                    dtype=np.float32)
                 self.params = tree_weighted_mean(trees, weights)
+        # release the staged cohort at round close: the defended jit
+        # already copied the host buffer to the device, so holding the
+        # [cohort, ...] block between rounds keeps server RSS at the
+        # cohort watermark for no benefit — dropped here, the allocator
+        # returns to baseline between rounds (pinned with the PR 6 RSS
+        # sampler's per-round reset) and the next round reallocates on
+        # its first staged arrival
+        self._staging = self._staging_leaves = self._staging_def = None
+        self._staged.clear()
+        self._g_staged.set(0)
         if self._round_span is not None:
             self._round_span.end()
             self._round_span = None
@@ -852,14 +899,22 @@ class FedAvgClientActor(ClientManager):
     interval while the actor runs — the signal the server's
     `FailureDetector` uses to tell a slow silo from a dead one between
     uploads.  The thread stops with ``finish()``.
+
+    ``server_id``: where uploads and heartbeats go.  The flat topology
+    keeps the default root (0); under the multi-level aggregator
+    topology (`algorithms/hierarchical.EdgeAggregatorActor`) a silo
+    reports to its EDGE, which folds locally and ships one pre-reduced
+    update to the root.
     """
 
     def __init__(self, node_id: int, transport: Transport,
                  train_fn: SiloTrainFn,
                  encode_upload: Optional[Callable] = None,
                  on_accepted: Optional[Callable] = None,
-                 heartbeat_interval_s: Optional[float] = None):
+                 heartbeat_interval_s: Optional[float] = None,
+                 server_id: int = 0):
         super().__init__(node_id, transport)
+        self.server_id = server_id
         self.train_fn = train_fn
         # optional wire compression: encode_upload(new_params,
         # global_params) -> payload (comm/compress.py)
@@ -889,7 +944,7 @@ class FedAvgClientActor(ClientManager):
     def _heartbeat_loop(self) -> None:
         while not self._hb_stop.wait(self.heartbeat_interval_s):
             try:
-                self.send(MsgType.C2S_HEARTBEAT, 0,
+                self.send(MsgType.C2S_HEARTBEAT, self.server_id,
                           **({} if self._round is None
                              else {Message.ARG_ROUND: self._round}))
             except Exception:  # noqa: BLE001 — transport mid-shutdown
@@ -919,7 +974,7 @@ class FedAvgClientActor(ClientManager):
         if self.encode_upload is not None:
             upload = self.encode_upload(upload, params)
         with self._span("upload", deterministic=True, round=round_idx):
-            self.send(MsgType.C2S_MODEL, 0,
+            self.send(MsgType.C2S_MODEL, self.server_id,
                       **{Message.ARG_MODEL_PARAMS: upload,
                          Message.ARG_NUM_SAMPLES: int(num_samples),
                          Message.ARG_ROUND: round_idx})

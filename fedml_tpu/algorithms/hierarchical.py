@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import jax
 import numpy as np
@@ -36,6 +36,10 @@ from fedml_tpu.data.stacking import gather_cohort
 from fedml_tpu.parallel.cohort import train_cohort
 
 logger = logging.getLogger(__name__)
+
+# edge straggler timer self-message (continues the MsgType numbering of
+# algorithms/cross_silo.py (1-6) and async_fl's MSG_RETASK_TICK (7))
+MSG_EDGE_TIMEOUT = 8
 
 
 def make_grouped_round(local_train, group_comm_round: int):
@@ -268,3 +272,220 @@ class HierarchicalFedAvg(FedAvg):
         if checkpointer is not None:
             checkpointer.flush()  # final async write durable before return
         return params
+
+
+# ---------------------------------------------------------------------------
+# live multi-level aggregator topology (edge aggregators -> root)
+# ---------------------------------------------------------------------------
+
+class EdgeAggregatorActor:
+    """The live-transport promotion of this module's two-tier averaging:
+    an intermediate aggregator that folds its silos' uploads LOCALLY and
+    ships one pre-reduced update to the root (ROADMAP item 2's
+    "hierarchical.py becomes a live multi-level aggregator topology").
+
+    Wire choreography (all over the real transport, PR 5 encode-once
+    frames end to end):
+
+    * root ``S2C_INIT/SYNC`` -> edge: the edge re-broadcasts the global
+      to its silos with ``send_many`` (one payload serialization per
+      wave) and derives each silo's client assignment itself — the
+      cohort sampler is deterministic in ``(round, client_num_in_total,
+      cohort_total)``, so no assignment table ever rides the wire;
+    * silo ``C2S_MODEL`` -> edge: screened by the edge's own
+      `AdmissionPipeline` (PR 4 composes per-upload at the edge; the
+      root's norm screen then sees the edge MEAN — screens compose
+      across tiers), admitted uploads fold into the edge's
+      `StreamingAggregator` at arrival (O(model) standing state);
+    * edge ``C2S_MODEL`` -> root: ONE frame carrying the pre-reduced
+      ``(sum / weight, weight, count)`` — the weighted mean as
+      ``model_params``, the folded weight total as ``num_samples``, the
+      fold count as ``edge_count`` (diagnostic-only wire field: the
+      root aggregates by ``num_samples``).  ``mean(edge means, edge weights)
+      == mean(all uploads, all weights)`` exactly, so the ROOT is an
+      unmodified `FedAvgServerActor` whose "silos" are the edges: its
+      straggler policies, admission screen, trust tracker, flight
+      recorder, and both agg modes all apply per edge unchanged.  An
+      edge with zero admissible uploads stays silent and the root's
+      drop policy closes over it like any straggler (the chaos-dropped
+      edge case, pinned by test).
+
+    The downstream protocol equals the upstream one, so edges nest: an
+    edge whose "silos" are themselves edges is a deeper tree with no new
+    code.  ``silos`` maps transport node id -> 1-based GLOBAL cohort
+    slot (the flat deployment's silo index, which seeds each silo's rng
+    stream and client assignment — a silo trains identically under any
+    topology).
+
+    ``timeout_s``: edge-local straggler bound — after it, the edge
+    flushes whatever folded (>= 1 upload) instead of wedging the root
+    barrier on one lost silo upload.
+    """
+
+    def __init__(self, node_id: int, transport, silos: Dict[int, int],
+                 cohort_total: int, client_num_in_total: int,
+                 stream_agg, admission=None, root_id: int = 0,
+                 timeout_s: Optional[float] = None):
+        from fedml_tpu.comm.actors import ClientManager, SelfMessageTimer
+        from fedml_tpu.obs import telemetry
+
+        # composition over inheritance for the manager plumbing: the
+        # actor IS a ClientManager to the root and a server to its silos
+        class _Mgr(ClientManager):
+            def register_handlers(mgr) -> None:  # noqa: N805
+                from fedml_tpu.algorithms.cross_silo import MsgType
+                mgr.register_handler(MsgType.S2C_INIT, self._on_sync)
+                mgr.register_handler(MsgType.S2C_SYNC, self._on_sync)
+                mgr.register_handler(MsgType.C2S_MODEL, self._on_upload)
+                mgr.register_handler(MsgType.C2S_HEARTBEAT, lambda m: None)
+                mgr.register_handler(MSG_EDGE_TIMEOUT, self._on_timeout)
+                mgr.register_handler(MsgType.S2C_FINISH, self._on_finish)
+
+        self._mgr = _Mgr(node_id, transport)
+        self.node_id = node_id
+        self.silos = dict(silos)
+        self.cohort_total = cohort_total
+        self.client_num_in_total = client_num_in_total
+        self.stream_agg = stream_agg
+        self.admission = admission
+        self.root_id = root_id
+        self.timeout_s = timeout_s
+        self.round_idx: Optional[int] = None
+        self._round_params = None
+        self._received: set = set()
+        self._weights: Dict[int, float] = {}
+        self._timer = SelfMessageTimer()
+        self._flushed = False
+        self._c_flush = telemetry.get_registry().counter(
+            "fedml_stream_edge_flush_total")
+
+    # -- lifecycle -----------------------------------------------------------
+    def register_handlers(self) -> None:
+        self._mgr.register_handlers()
+
+    def run(self) -> None:
+        self._mgr.run()
+
+    def finish(self) -> None:
+        self._timer.cancel(join=True)
+        self._mgr.finish()
+
+    @property
+    def transport(self):
+        return self._mgr.transport
+
+    # -- root-facing side ----------------------------------------------------
+    def _on_finish(self, msg) -> None:
+        from fedml_tpu.algorithms.cross_silo import MsgType
+        for silo in sorted(self.silos):
+            self._mgr.send(MsgType.S2C_FINISH, silo)
+        self.finish()
+
+    def _on_sync(self, msg) -> None:
+        from fedml_tpu.comm.message import Message
+        round_idx = msg.get(Message.ARG_ROUND)
+        params = msg.get(Message.ARG_MODEL_PARAMS)
+        self.round_idx = round_idx
+        self._received.clear()
+        self._weights.clear()
+        self._flushed = False
+        # the round's reference global, kept for the admission screen —
+        # the edge's own handle, not a reach into stream_agg internals
+        self._round_params = params
+        self.stream_agg.reset(params)
+        # the deterministic sampler replays the FLAT deployment's
+        # round-cohort assignment, so silo slot g trains client ids[g-1]
+        # under any topology (parity with FedAvgServerActor._broadcast)
+        ids = sample_clients(round_idx, self.client_num_in_total,
+                             self.cohort_total)
+        per_silo = {
+            silo: {Message.ARG_CLIENT_INDEX: int(ids[g - 1])}
+            for silo, g in sorted(self.silos.items()) if g - 1 < len(ids)}
+        self._mgr.send_many(
+            msg.type, sorted(per_silo),
+            shared_params={Message.ARG_MODEL_PARAMS: params,
+                           Message.ARG_ROUND: round_idx},
+            per_receiver_params=per_silo)
+        self._arm_timer()
+
+    # -- silo-facing side ----------------------------------------------------
+    def _arm_timer(self) -> None:
+        if self.timeout_s is None:
+            return
+        round_at_arm = self.round_idx
+        from fedml_tpu.comm.message import Message
+        self._timer.arm(
+            self.timeout_s,
+            lambda: self._mgr.send(MSG_EDGE_TIMEOUT, self.node_id,
+                                   **{Message.ARG_ROUND: round_at_arm}))
+
+    def _on_timeout(self, msg) -> None:
+        from fedml_tpu.comm.message import Message
+        if msg.get(Message.ARG_ROUND) != self.round_idx or self._flushed:
+            return
+        missing = sorted(set(self.silos) - self._received)
+        logger.warning("edge %d round %s: silos %s missing after %.1fs; "
+                    "flushing the partial fold", self.node_id,
+                    self.round_idx, missing, self.timeout_s)
+        self._flush()
+
+    def _on_upload(self, msg) -> None:
+        from fedml_tpu.comm.message import Message
+        if msg.sender_id not in self.silos:
+            logger.warning("edge %d: upload from foreign silo %d dropped",
+                        self.node_id, msg.sender_id)
+            return
+        upload_round = msg.get(Message.ARG_ROUND)
+        if upload_round != self.round_idx or self._flushed:
+            logger.warning("edge %d: discarding round-%s upload from silo %d "
+                        "(current round %s%s)", self.node_id, upload_round,
+                        msg.sender_id, self.round_idx,
+                        ", already flushed" if self._flushed else "")
+            return
+        if msg.sender_id in self._received:
+            logger.info("edge %d: ignoring duplicate round-%s upload from "
+                     "silo %d", self.node_id, upload_round, msg.sender_id)
+            return
+        self._received.add(msg.sender_id)
+        upload = msg.get(Message.ARG_MODEL_PARAMS)
+        num_samples = msg.get(Message.ARG_NUM_SAMPLES)
+        if self.admission is not None:
+            verdict = self.admission.admit(
+                msg.sender_id, upload, num_samples,
+                self._round_params, self.round_idx)
+            if not verdict.ok:
+                logger.warning("edge %d round %s: rejecting upload from silo "
+                            "%d (reason=%s)", self.node_id, self.round_idx,
+                            msg.sender_id, verdict.reason)
+                num_samples = None
+            else:
+                num_samples = verdict.num_samples
+        if num_samples is not None:
+            self.stream_agg.fold(upload, float(num_samples))
+            self._weights[msg.sender_id] = float(num_samples)
+        if self._received >= set(self.silos):
+            self._flush()
+
+    def _flush(self) -> None:
+        """Ship the pre-reduced edge update: the fold's weighted mean,
+        its weight total, and the fold count — one model-sized frame per
+        round no matter how many silos fed it."""
+        from fedml_tpu.algorithms.cross_silo import MsgType
+        from fedml_tpu.comm.message import Message
+        self._timer.cancel()
+        self._flushed = True
+        if self.stream_agg.count == 0:
+            # nothing admissible: stay silent; the root's straggler
+            # policy closes over this edge like any dropped silo
+            logger.warning("edge %d round %s: no admissible uploads; not "
+                        "reporting", self.node_id, self.round_idx)
+            return
+        mean = jax.tree.map(np.asarray,
+                            self.stream_agg.finalize(self.round_idx))
+        self._c_flush.inc()
+        self._mgr.send(
+            MsgType.C2S_MODEL, self.root_id,
+            **{Message.ARG_MODEL_PARAMS: mean,
+               Message.ARG_NUM_SAMPLES: float(sum(self._weights.values())),
+               Message.ARG_ROUND: self.round_idx,
+               Message.ARG_EDGE_COUNT: int(self.stream_agg.count)})

@@ -161,6 +161,13 @@ class Message:
     ARG_CLIENT_INDEX = "client_idx"
     ARG_ROUND = "round_idx"
     ARG_ACCEPTED = "accepted_silos"  # silo ids aggregated last round (EF ack)
+    ARG_EDGE_COUNT = "edge_count"    # uploads folded into a pre-reduced
+    #                                  edge update (multi-level topology).
+    #                                  DIAGNOSTIC-ONLY: the root's
+    #                                  aggregation weights ride
+    #                                  ARG_NUM_SAMPLES; this field exists
+    #                                  for wire-level observability and
+    #                                  tests, nothing load-bearing reads it
     # span context (obs/trace.py CTX_KEY): a {"t","s"} dict riding the
     # plain JSON header, so one federated round stitches into a single
     # cross-process trace

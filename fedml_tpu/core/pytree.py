@@ -56,6 +56,16 @@ def tree_vector_norm(a: Pytree, b: Pytree) -> jax.Array:
     return tree_global_norm(tree_sub(a, b))
 
 
+def acc_dtype(dtype):
+    """The weighted-mean accumulator dtype contract: float leaves
+    accumulate in their own dtype, ints in f32 (exact for step
+    counters).  Shared by `tree_weighted_mean`, the stack-mode scan
+    mean (`robust/defense.py`), and the streaming fold
+    (`core/stream_agg.py`) — all three must agree or stream-vs-stack
+    bit-identity breaks."""
+    return dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.float32
+
+
 def tree_weighted_mean(trees: Sequence[Pytree] | Pytree, weights: jax.Array) -> Pytree:
     """Sample-weighted average of client parameter pytrees.
 
@@ -80,9 +90,9 @@ def tree_weighted_mean(trees: Sequence[Pytree] | Pytree, weights: jax.Array) -> 
         # full-precision normalization for bf16 params), cast back at the end
         # — matching the reference where float-averaged int tensors are cast
         # back on load_state_dict
-        acc_dtype = x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32
+        acc = acc_dtype(x.dtype)
         w = norm.reshape((-1,) + (1,) * (x.ndim - 1)).astype(jnp.float32)
-        out = jnp.sum(x.astype(acc_dtype) * w.astype(acc_dtype), axis=0)
+        out = jnp.sum(x.astype(acc) * w.astype(acc), axis=0)
         return out.astype(x.dtype)
 
     return jax.tree.map(_avg, stacked)

@@ -198,6 +198,32 @@ class ExperimentConfig:
     #                                   probation
     probation_rounds: int = 2         # TrustTracker: clean rounds to
     #                                   restore full trust
+    # ---- streaming aggregation (core/stream_agg.py, ROADMAP item 2) ----
+    agg_mode: str = "stack"           # cross_silo/async_fl aggregation
+    #                                   memory regime: stack (the
+    #                                   [cohort,...] staged buffer — exact
+    #                                   reference semantics, RSS linear in
+    #                                   cohort) | stream (fold each
+    #                                   admitted upload at arrival —
+    #                                   O(model) state, RSS flat in
+    #                                   cohort; mean is bit-identical to
+    #                                   stack's DEFENDED-mean path; an
+    #                                   undefended stack run differs in
+    #                                   last-ulp summation order (sync)
+    #                                   or per-delta staleness discounts
+    #                                   (async) — README "Streaming
+    #                                   aggregation"; robust rules see a
+    #                                   bounded reservoir sample)
+    stream_reservoir: int = 64        # stream + a robust rule: reservoir
+    #                                   slots the rule sees at finalize
+    #                                   (size to the adversary count, not
+    #                                   the cohort; exact when cohort<=K)
+    edge_aggregators: int = 0         # >0: multi-level topology — this
+    #                                   many EdgeAggregatorActor tiers
+    #                                   between silos and the root; each
+    #                                   edge folds its silos locally and
+    #                                   ships ONE pre-reduced update per
+    #                                   round (cross_silo local backend)
     adversary: str = ""               # seeded per-silo attacks over the
     #                                   real message path, e.g.
     #                                   "2:scale:20,3:sign_flip" (kinds:

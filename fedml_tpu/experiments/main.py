@@ -583,14 +583,23 @@ def _silo_training_setup(cfg, data, wl, perf=None):
 def _robust_setup(cfg: ExperimentConfig, template, kind: str, sentry=None):
     """Payload-defense wiring shared by the sync and async actor modes
     (fedml_tpu/robust): the admission pipeline (``--admission`` — 'auto'
-    arms it whenever any defense flag is set) and the jit-once defended
-    aggregate (``--robust_agg/--norm_clip/--agg_noise_std``).  Returns
-    ``(admission, defended_aggregate)``, either possibly None.
-    ``sentry``: the flight recorder's RecompileSentry — the defended
-    aggregate registers with it so a retracing round is counted/failed."""
+    arms it whenever any defense flag is set) and the aggregation
+    regime.  Returns ``(admission, defended_aggregate, stream_agg)``:
+    ``--agg_mode stack`` yields the jit-once defended aggregate over the
+    staged ``[cohort, ...]`` buffer (``defended_aggregate``; None when
+    every defense flag is off — the legacy exact weighted mean runs);
+    ``--agg_mode stream`` yields a `StreamingAggregator` instead
+    (``stream_agg``, ALWAYS set — plain mean streams too; that is the
+    O(model)-memory point), and ``defended_aggregate`` stays None.
+    ``sentry``: the flight recorder's RecompileSentry — the hot
+    aggregation jit registers so a retracing round is counted/failed."""
     if cfg.admission not in ("auto", "on", "off"):
         raise ValueError(f"--admission must be auto|on|off, "
                          f"got {cfg.admission!r}")
+    from fedml_tpu.core.stream_agg import STREAM_MODES
+    if cfg.agg_mode not in STREAM_MODES:
+        raise ValueError(f"--agg_mode must be one of {STREAM_MODES}, "
+                         f"got {cfg.agg_mode!r}")
     robust_on = (cfg.robust_agg != "mean" or cfg.norm_clip > 0
                  or cfg.agg_noise_std > 0)
     # 'auto' also arms the screen under payload corruption: a corrupted
@@ -610,6 +619,15 @@ def _robust_setup(cfg: ExperimentConfig, template, kind: str, sentry=None):
                 strikes_to_quarantine=cfg.strikes_to_quarantine,
                 quarantine_rounds=cfg.quarantine_rounds,
                 probation_rounds=cfg.probation_rounds))
+    if cfg.agg_mode == "stream":
+        from fedml_tpu.core.stream_agg import StreamingAggregator
+        stream = StreamingAggregator(
+            template, method=cfg.robust_agg, kind=kind,
+            norm_clip=cfg.norm_clip, noise_std=cfg.agg_noise_std,
+            seed=cfg.seed, reservoir_k=cfg.stream_reservoir,
+            trim_frac=cfg.trim_frac, byz_f=cfg.byz_f, krum_m=cfg.krum_m,
+            gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps, sentry=sentry)
+        return admission, None, stream
     if robust_on:
         from fedml_tpu.robust import make_defended_aggregate
         defended = make_defended_aggregate(
@@ -617,7 +635,7 @@ def _robust_setup(cfg: ExperimentConfig, template, kind: str, sentry=None):
             krum_m=cfg.krum_m, gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps,
             norm_clip=cfg.norm_clip, noise_std=cfg.agg_noise_std,
             seed=cfg.seed, sentry=sentry)
-    return admission, defended
+    return admission, defended, None
 
 
 def _adversary_train_fns(cfg: ExperimentConfig, data, make_train_fn,
@@ -692,10 +710,14 @@ def run_async_fl(cfg, data, mesh, sink):
     n_silos = min(cfg.client_num_per_round, data.client_num)
     goal = cfg.async_goal or max(1, n_silos // 2)
     make_train_fn = _adversary_train_fns(cfg, data, make_train_fn, n_silos)
+    if cfg.edge_aggregators > 0:
+        raise ValueError("--edge_aggregators is a cross_silo (sync barrier) "
+                         "topology; the async server consumes per-silo "
+                         "deltas directly")
     # async uploads are deltas — the admission screen fingerprints them
     # against the params template (same treedef/shapes/dtypes) and
     # screens the raw delta norm
-    admission, defended = _robust_setup(
+    admission, defended, stream = _robust_setup(
         cfg, init, kind="delta", sentry=perf.sentry if perf else None)
 
     history = []
@@ -718,7 +740,8 @@ def run_async_fl(cfg, data, mesh, sink):
         server_lr=cfg.async_server_lr, on_version=on_version,
         seed=cfg.seed, checkpointer=_make_checkpointer(cfg),
         retask_timeout_s=cfg.retask_timeout_s or None,
-        admission=admission, defended_aggregate=defended, perf=perf)
+        admission=admission, defended_aggregate=defended,
+        stream_agg=stream, perf=perf)
     server.register_handlers()
     silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
                                encode_upload=delta_encoder)
@@ -772,8 +795,38 @@ def run_cross_silo(cfg, data, mesh, sink):
     n_silos = min(cfg.client_num_per_round, data.client_num)
     timeout = cfg.round_timeout_s or None
     make_train_fn = _adversary_train_fns(cfg, data, make_train_fn, n_silos)
-    admission, defended = _robust_setup(
+    admission, defended, stream = _robust_setup(
         cfg, init, kind="params", sentry=perf.sentry if perf else None)
+
+    # multi-level aggregator topology (--edge_aggregators E): E edge
+    # actors sit between the silos and the root, each folding its block
+    # of silos' uploads at arrival and shipping ONE pre-reduced
+    # (mean, weight, count) update per round — the root is this same
+    # FedAvgServerActor whose "silos" are the edges
+    n_edges = cfg.edge_aggregators
+    if n_edges > 0:
+        if cfg.silo_backend != "local":
+            raise ValueError("--edge_aggregators deploys over the local "
+                             "hub only for now (the actors are transport-"
+                             "agnostic; gRPC wiring mirrors the flat one)")
+        if not 1 <= n_edges <= n_silos:
+            raise ValueError(f"--edge_aggregators {n_edges} must be in "
+                             f"1..{n_silos} (every edge needs a silo)")
+        if cfg.wire_compression != "none" or cfg.error_feedback:
+            raise ValueError("--wire_compression/--error_feedback are not "
+                             "wired through the edge tier (the root would "
+                             "try to decompress an edge's raw mean)")
+        if cfg.dead_after_s > 0:
+            raise ValueError("--dead_after_s: silo heartbeats terminate at "
+                             "their edge; the root failure detector would "
+                             "declare every edge dead")
+        if admission is not None and admission.max_num_samples > 0:
+            # the per-UPLOAD sample cap screens silo claims at the edge
+            # tier; the root sees pre-reduced edges whose num_samples is
+            # the SUM over their block — scale the root's cap by the
+            # largest block so an honest edge is never struck as weight
+            # inflation (the edge pipelines below keep the per-silo cap)
+            admission.max_num_samples *= -(-n_silos // n_edges)
 
     # optional lossy upload compression (comm/compress.py): silos send the
     # compressed DELTA to the global model; the server reconstructs.  The
@@ -928,15 +981,20 @@ def run_cross_silo(cfg, data, mesh, sink):
                            daemon=True, name="serve-warmup").start()
 
     def make_server(transport):
+        # under the edge topology the root's cohort IS the edge tier:
+        # straggler policy, admission, trust, and both agg modes apply
+        # per edge unchanged
         s = FedAvgServerActor(
-            transport, init, data.client_num, n_silos, cfg.comm_round,
+            transport, init, data.client_num,
+            n_edges if n_edges > 0 else n_silos, cfg.comm_round,
             on_round_done=on_round_done,
             straggler_policy=cfg.straggler_policy,
             round_timeout_s=timeout, min_silo_frac=cfg.min_silo_frac,
             decode_upload=decode, failure_detector=detector,
             checkpointer=_make_checkpointer(cfg),
             publish=publish, extra_state=ef_extra,
-            admission=admission, aggregate_fn=defended, perf=perf)
+            admission=admission, aggregate_fn=defended,
+            stream_agg=stream, perf=perf)
         s.register_handlers()
         return s
 
@@ -977,25 +1035,76 @@ def run_cross_silo(cfg, data, mesh, sink):
                     immune_types=(MsgType.S2C_FINISH, MsgType.ROUND_TIMEOUT))
                 wrap = lambda t: ChaosTransport(t, plan)  # noqa: E731
             server = make_server(wrap(hub.transport(0)))
+            # hub address plan: root 0; edges 1..E (the root's "silos");
+            # flat silos at E+g, where g is the 1-based GLOBAL cohort
+            # slot that seeds the silo's rng stream and client assignment
+            # — a silo trains identically under any topology
+            edges, edge_of = [], {}
+            if n_edges > 0:
+                from fedml_tpu.algorithms.hierarchical import (
+                    EdgeAggregatorActor)
+                from fedml_tpu.core.stream_agg import StreamingAggregator
+                blocks = np.array_split(np.arange(1, n_silos + 1), n_edges)
+                for e, block in enumerate(blocks, start=1):
+                    edge_admission = None
+                    if admission is not None:
+                        # each edge screens ITS silos with its own
+                        # pipeline/trust ledger (PR 4 composes per-upload
+                        # at the edge; the root's screen then sees the
+                        # edge means)
+                        from fedml_tpu.robust import (AdmissionPipeline,
+                                                      TrustTracker)
+                        edge_admission = AdmissionPipeline(
+                            init, kind="params",
+                            max_num_samples=cfg.max_num_samples,
+                            norm_k=cfg.norm_screen_k,
+                            norm_window=cfg.norm_screen_window,
+                            norm_min_history=cfg.norm_screen_min_history,
+                            trust=TrustTracker(
+                                strikes_to_quarantine=(
+                                    cfg.strikes_to_quarantine),
+                                quarantine_rounds=cfg.quarantine_rounds,
+                                probation_rounds=cfg.probation_rounds))
+                    # edge folds are plain clipped means — the robust
+                    # rule and the DP noise run ONCE, at the root, over
+                    # the edge means
+                    edges.append(EdgeAggregatorActor(
+                        e, wrap(hub.transport(e)),
+                        {n_edges + int(g): int(g) for g in block},
+                        cohort_total=n_silos,
+                        client_num_in_total=data.client_num,
+                        stream_agg=StreamingAggregator(
+                            init, method="mean", kind="params",
+                            norm_clip=cfg.norm_clip, seed=cfg.seed),
+                        admission=edge_admission,
+                        # the edge must flush its partial fold BEFORE
+                        # the root's round timer fires, or an on-time
+                        # block is discarded with its one straggler —
+                        # half the root timeout leaves the flush margin
+                        timeout_s=timeout / 2 if timeout else None))
+                    for g in block:
+                        edge_of[int(g)] = e
             silos = [FedAvgClientActor(
-                         i, wrap(hub.transport(i)), make_train_fn(i),
-                         encode_upload=make_encode(i),
-                         on_accepted=make_on_accepted(i),
+                         n_edges + g, wrap(hub.transport(n_edges + g)),
+                         make_train_fn(g),
+                         encode_upload=make_encode(g),
+                         on_accepted=make_on_accepted(g),
                          heartbeat_interval_s=(cfg.heartbeat_s or None)
-                         if chaos_on else None)
-                     for i in range(1, n_silos + 1)]
+                         if chaos_on else None,
+                         server_id=edge_of.get(g, 0))
+                     for g in range(1, n_silos + 1)]
             if not chaos_on:
-                for s in silos:
-                    s.register_handlers()
+                for a in edges + silos:
+                    a.register_handlers()
                 server.start()
                 hub.pump()
                 return history[-1] if history else {}
             # chaos delivers delayed/reordered frames on wall-clock timers,
             # which the synchronous pump cannot wait for — drive each actor
             # on its own thread like a real deployment
-            threads = [threading.Thread(target=s.run, daemon=True,
-                                        name=f"silo-{s.node_id}")
-                       for s in silos]
+            threads = [threading.Thread(target=a.run, daemon=True,
+                                        name=f"node-{a.node_id}")
+                       for a in edges + silos]
             for th in threads:
                 th.start()
             server.start()

@@ -50,8 +50,9 @@ log = logging.getLogger(__name__)
 # make_defended_aggregate product is wired, plain "aggregate" otherwise,
 # so a defended run never compares against an undefended baseline under
 # one label)
-PHASES = ("broadcast_serialize", "straggler_wait", "staging", "admission",
-          "aggregate", "defended_aggregate", "checkpoint", "publish")
+PHASES = ("broadcast_serialize", "straggler_wait", "staging", "fold",
+          "admission", "aggregate", "defended_aggregate", "checkpoint",
+          "publish")
 
 
 # ---------------------------------------------------------------------------

@@ -139,8 +139,14 @@ class TrustTracker:
       silos with occasional wire corruption never ratchet into
       quarantine.
 
-    ``events`` keeps an append-only ``(round, silo, event)`` log —
-    the audit trail tests and the run_byzantine demo assert on.
+    ``events`` keeps a ``(round, silo, event)`` audit log — the trail
+    tests and the run_byzantine demo assert on.  It is BOUNDED at
+    insert time (``events_window`` newest entries): at mega-cohort
+    scale a seeded adversary fleet strikes O(cohort) times per round,
+    and an append-only log would grow without bound for the life of
+    the federation — the same cap-at-insert discipline as the norm
+    screen's ``norm_window`` deque, so the whole admission subsystem
+    holds O(window + silos) state regardless of cohort size.
 
     Trust is SOFT state, deliberately not checkpointed — exactly like
     the `FailureDetector` health registry it mirrors: a crash-resumed
@@ -155,7 +161,11 @@ class TrustTracker:
     PROBATION = "probation"
 
     def __init__(self, strikes_to_quarantine: int = 3,
-                 quarantine_rounds: int = 4, probation_rounds: int = 2):
+                 quarantine_rounds: int = 4, probation_rounds: int = 2,
+                 events_window: int = 4096):
+        if events_window < 1:
+            raise ValueError(f"events_window must be >= 1, got "
+                             f"{events_window}")
         if strikes_to_quarantine < 1:
             raise ValueError(f"strikes_to_quarantine must be >= 1, got "
                              f"{strikes_to_quarantine}")
@@ -171,7 +181,8 @@ class TrustTracker:
         self._strikes: Dict[int, int] = {}
         self._quarantine_until: Dict[int, int] = {}   # silo -> first free round
         self._probation_left: Dict[int, int] = {}
-        self.events: List[Tuple[int, int, str]] = []
+        self.events: Deque[Tuple[int, int, str]] = collections.deque(
+            maxlen=events_window)
         reg = telemetry.get_registry()
         self._c_strikes = reg.counter("fedml_robust_strikes_total")
         self._c_quarantines = reg.counter(

@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from fedml_tpu.core.byzantine import METHODS, make_byzantine_aggregate
-from fedml_tpu.core.pytree import tree_weighted_mean
+from fedml_tpu.core.pytree import acc_dtype
 from fedml_tpu.core.robust import add_gaussian_noise, clip_update
 
 ROBUST_AGG_METHODS = ("mean",) + METHODS
@@ -79,18 +79,52 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
         raise ValueError(f"norm_clip/noise_std must be >= 0, got "
                          f"{norm_clip}/{noise_std}")
     if method == "mean":
-        base = tree_weighted_mean
+        base = None  # fused clip + sequential fold below
     else:
         base = make_byzantine_aggregate(method, trim_frac=trim_frac,
                                         byz_f=byz_f, krum_m=krum_m,
                                         gm_iters=gm_iters, gm_eps=gm_eps)
 
+    def _scan_mean(global_params, stacked, weights):
+        """Clip + weighted mean as a sequential cohort-order `lax.scan`
+        — arithmetically the SAME per-slot fold
+        `core.stream_agg.StreamingAggregator` runs at upload arrival,
+        so stream and stack modes agree BIT FOR BIT when uploads fold
+        in slot order (weight-0 slots hold the reference and contribute
+        an exact ``+0.0``).  fp addition is order-sensitive, so this is
+        deliberately NOT the fused ``jnp.sum`` of `tree_weighted_mean`:
+        a vectorized reduce uses a different summation tree and the two
+        modes would differ in the last ulp forever."""
+        acc0 = jax.tree.map(
+            lambda r: jnp.zeros(jnp.shape(r), acc_dtype(jnp.asarray(r).dtype)),
+            global_params)
+
+        def body(carry, slot):
+            acc, tot = carry
+            upd, w = slot
+            if norm_clip > 0:
+                upd = clip_update(upd, global_params, norm_clip)
+            acc = jax.tree.map(
+                lambda a, u: a + u.astype(a.dtype) * w.astype(a.dtype),
+                acc, upd)
+            return (acc, tot + w), None
+
+        (acc, tot), _ = jax.lax.scan(body, (acc0, jnp.float32(0.0)),
+                                     (stacked, weights))
+        return jax.tree.map(
+            lambda a, r: (a / tot.astype(a.dtype)).astype(
+                jnp.asarray(r).dtype), acc, global_params)
+
     def _aggregate(global_params, stacked, weights, step):
         weights = jnp.asarray(weights, jnp.float32)
-        if norm_clip > 0:
-            stacked = jax.vmap(
-                lambda c: clip_update(c, global_params, norm_clip))(stacked)
-        out = base(stacked, weights)
+        if base is None:
+            out = _scan_mean(global_params, stacked, weights)
+        else:
+            if norm_clip > 0:
+                stacked = jax.vmap(
+                    lambda c: clip_update(c, global_params,
+                                          norm_clip))(stacked)
+            out = base(stacked, weights)
         if noise_std > 0:
             key = jax.random.fold_in(jax.random.key(seed),
                                      jnp.asarray(step, jnp.uint32))

@@ -87,4 +87,30 @@ if env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
 fi
 grep -q "phase regression" "$DIR/trend_fail.txt"
 echo "trend gate OK: honest ledger passes, seeded regression fails"
+
+echo "== streaming aggregation: one --agg_mode stream round, fold phase"
+# the O(1)-memory fold path (ISSUE 7): uploads fold at arrival, so the
+# ledger gains a 'fold' phase and never records a 'staging' one — and
+# the same trend gate covers the new ledger shape
+STREAM_RUN="$DIR/stream_run"
+env JAX_PLATFORMS=cpu python -m fedml_tpu \
+    --algo cross_silo --model lr --dataset mnist \
+    --client_num_in_total 4 --client_num_per_round 2 --comm_round 3 \
+    --frequency_of_the_test 1 --batch_size 4 --log_stdout false \
+    --agg_mode stream --norm_clip 5.0 \
+    --run_dir "$STREAM_RUN" --perf true --perf_strict true
+python - "$STREAM_RUN/perf.jsonl" <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+assert rows, "stream run wrote no ledger lines"
+for r in rows:
+    assert r["phases"].get("fold", 0) > 0, \
+        f"round {r['round']} ledger is missing the fold phase: {r['phases']}"
+    assert "staging" not in r["phases"], \
+        "stream mode must not stage a cohort buffer"
+print(f"fold phase present in all {len(rows)} stream-round ledger lines")
+EOF
+env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
+    --ledger "$STREAM_RUN/perf.jsonl" --baseline "$STREAM_RUN/perf.jsonl"
+echo "stream ledger OK: fold phase recorded, trend gate green"
 echo "== obs demo OK ($DIR)"

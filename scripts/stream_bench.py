@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Streaming-vs-stack aggregation memory/time bench (ISSUE 7 acceptance).
+
+Simulates one server aggregating N admitted uploads per round at
+N ∈ {64, 256, 1024} under both ``--agg_mode`` regimes:
+
+* **stack** — the staged ``[cohort, ...]`` host buffer + one defended
+  jit (the PR 5 path, buffer released at round close);
+* **stream** — `core.stream_agg.StreamingAggregator`: each upload folds
+  into O(model) running state at arrival, finalize is one division;
+* **stream_reservoir** — the robust-rule regime: a bounded K-slot
+  reservoir feeds ``trimmed_mean`` (memory O(K * model), flat in N).
+
+Each (mode, N) arm runs in a FRESH SUBPROCESS so peak RSS is the arm's
+own, not an artifact of allocator history: round 1 pays the compiles
+(warmup), then the measured round tracks VmRSS with the PR 6
+`RssSampler` plus an explicit sample after every arrival, against a
+post-gc baseline taken between the rounds.
+
+CPU-honest contract (bench.py / wirebench): numbers are host wall-clock
+on whatever ``jax.default_backend()`` reports — labeled, never dressed
+as accelerator throughput.  Upload *generation* time is excluded from
+``round_s`` (a server receives uploads; it does not synthesize them).
+
+Acceptance (parent process, exit 1 on failure):
+  * stream peak RSS flat in N: peak(N=1024) <= 1.15 x peak(N=64);
+  * stack marginal RSS ~linear in N (delta grows >= 4x from 64 to 1024
+    at these sizes — the cohort buffer dominates);
+  * ``mean`` checksums bit-identical between stream and stack arms.
+
+  python scripts/stream_bench.py             # full: ~2MB model, writes
+                                             # BENCH_stream.json
+  python scripts/stream_bench.py --smoke     # CI-sized, /tmp output
+"""
+
+import argparse
+import gc
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+MB = 1024 * 1024
+
+
+def _template(model_mb: float):
+    import numpy as np
+    n = int(model_mb * MB / 4)
+    return {"dense": {"kernel": np.ones((n // 2,), np.float32),
+                      "bias": np.zeros((n - n // 2,), np.float32)},
+            "step": np.int32(0)}
+
+
+def _upload(tmpl, i: int):
+    """Deterministic per-index upload — both arms regenerate the SAME
+    stream, so a matching checksum proves the aggregates match."""
+    import numpy as np
+    rng = np.random.RandomState(1000 + i)
+    return {"dense": {"kernel": tmpl["dense"]["kernel"]
+                      + rng.standard_normal(
+                          tmpl["dense"]["kernel"].shape).astype(np.float32),
+                      "bias": tmpl["dense"]["bias"]
+                      + rng.standard_normal(
+                          tmpl["dense"]["bias"].shape).astype(np.float32)},
+            "step": np.int32(i)}
+
+
+def _weight(i: int) -> float:
+    return float(10 * (i % 7 + 1))
+
+
+def _checksum(tree) -> float:
+    import jax
+    import numpy as np
+    return float(sum(np.abs(np.asarray(l)).astype(np.float64).sum()
+                     for l in jax.tree.leaves(tree)))
+
+
+def _run_child(mode: str, n: int, model_mb: float,
+               reservoir_k: int) -> dict:
+    """One arm: warmup round, then the measured round. Prints one JSON
+    line on stdout."""
+    import jax
+    import numpy as np
+
+    from fedml_tpu.obs.perf import RssSampler, read_rss_bytes
+
+    tmpl = _template(model_mb)
+    norm_clip = 0.0  # pure mean: the checksum-identity arm
+
+    if mode in ("stream", "stream_reservoir"):
+        from fedml_tpu.core.stream_agg import StreamingAggregator
+        agg = StreamingAggregator(
+            tmpl,
+            method="trimmed_mean" if mode == "stream_reservoir" else "mean",
+            norm_clip=norm_clip, reservoir_k=reservoir_k, trim_frac=0.1)
+
+        def round_fn(sample):
+            agg.reset(tmpl)
+            t_arr = 0.0
+            for i in range(n):
+                u = _upload(tmpl, i)
+                t0 = time.perf_counter()
+                agg.fold(u, _weight(i))
+                t_arr += time.perf_counter() - t0
+                del u
+                sample()
+            t0 = time.perf_counter()
+            out = agg.finalize(0)
+            jax.block_until_ready(out)
+            t_fin = time.perf_counter() - t0
+            sample()
+            return out, t_arr, t_fin
+    else:
+        from fedml_tpu.robust.defense import make_defended_aggregate
+        fn = make_defended_aggregate("mean", norm_clip=norm_clip)
+
+        def round_fn(sample):
+            # the live server's staging path: the [cohort, ...] buffer
+            # fills at arrival, one defended jit at the barrier, buffer
+            # released at round close (PR 7's stack-mode contract)
+            staging = jax.tree.map(
+                lambda l: np.empty((n,) + np.shape(l),
+                                   np.asarray(l).dtype), tmpl)
+            leaves = jax.tree.leaves(staging)
+            w = np.zeros(n, np.float32)
+            t_arr = 0.0
+            for i in range(n):
+                u = _upload(tmpl, i)
+                t0 = time.perf_counter()
+                for buf, leaf in zip(leaves, jax.tree.leaves(u)):
+                    buf[i] = np.asarray(leaf)
+                w[i] = _weight(i)
+                t_arr += time.perf_counter() - t0
+                del u
+                sample()
+            t0 = time.perf_counter()
+            out = fn(tmpl, staging, w, 0)
+            jax.block_until_ready(out)
+            t_fin = time.perf_counter() - t0
+            sample()
+            del staging, leaves
+            return out, t_arr, t_fin
+
+    # round 1: compiles + allocator warmup — never measured
+    out, _, _ = round_fn(lambda: None)
+    del out
+    gc.collect()
+    baseline = read_rss_bytes()
+    sampler = RssSampler(interval_s=0.002).start()
+    out, t_arr, t_fin = round_fn(sampler.sample)
+    peak = sampler.peak_bytes
+    sampler.stop()
+    checksum = _checksum(out)
+    cache = None
+    if mode in ("stream", "stream_reservoir"):
+        cache = agg._cache_size()
+        assert cache == 1, f"fold jit recompiled: cache={cache}"
+    return {
+        "mode": mode, "n": n, "model_mb": model_mb,
+        "backend": jax.default_backend(),
+        "reservoir_k": reservoir_k if mode == "stream_reservoir" else None,
+        "baseline_rss_mb": round(baseline / MB, 1),
+        "peak_rss_mb": round(peak / MB, 1),
+        "peak_delta_mb": round((peak - baseline) / MB, 1),
+        "arrival_s": round(t_arr, 4),
+        "finalize_s": round(t_fin, 4),
+        "round_s": round(t_arr + t_fin, 4),
+        "checksum": checksum,
+        "fold_jit_cache_size": cache,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI-sized: tiny model, N in {8, 32}, /tmp out")
+    ap.add_argument("--out", default=None,
+                    help="artifact path ('' skips writing); default "
+                         "BENCH_stream.json, /tmp for --smoke")
+    ap.add_argument("--model_mb", type=float, default=None)
+    ap.add_argument("--reservoir_k", type=int, default=64)
+    ap.add_argument("--child", nargs=2, metavar=("MODE", "N"),
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    model_mb = args.model_mb or (0.25 if args.smoke else 2.0)
+    if args.child:
+        mode, n = args.child[0], int(args.child[1])
+        print(json.dumps(_run_child(mode, n, model_mb, args.reservoir_k)))
+        return 0
+
+    if args.out is None:
+        args.out = ("/tmp/BENCH_stream_smoke.json" if args.smoke
+                    else "BENCH_stream.json")
+    sizes = [8, 32] if args.smoke else [64, 256, 1024]
+    arms = {}
+    for mode in ("stack", "stream", "stream_reservoir"):
+        for n in sizes:
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--child", mode, str(n),
+                   "--model_mb", str(model_mb),
+                   "--reservoir_k", str(args.reservoir_k)]
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=1800)
+            if out.returncode != 0:
+                print(out.stdout, out.stderr, file=sys.stderr)
+                raise RuntimeError(f"arm {mode}/N={n} failed")
+            arms[(mode, n)] = json.loads(out.stdout.strip().splitlines()[-1])
+            a = arms[(mode, n)]
+            print(f"  {mode:>17} N={n:<5} peak {a['peak_rss_mb']:>8.1f}MB "
+                  f"(Δ {a['peak_delta_mb']:>7.1f}MB)  round "
+                  f"{a['round_s']:.3f}s", file=sys.stderr)
+
+    lo, hi = sizes[0], sizes[-1]
+    stream_flat = (arms[("stream", hi)]["peak_rss_mb"]
+                   / max(arms[("stream", lo)]["peak_rss_mb"], 1e-9))
+    reservoir_flat = (arms[("stream_reservoir", hi)]["peak_rss_mb"]
+                      / max(arms[("stream_reservoir", lo)]["peak_rss_mb"],
+                            1e-9))
+    stack_delta_growth = (arms[("stack", hi)]["peak_delta_mb"]
+                          / max(arms[("stack", lo)]["peak_delta_mb"], 1e-9))
+    checksums_equal = all(
+        arms[("stream", n)]["checksum"] == arms[("stack", n)]["checksum"]
+        for n in sizes)
+    acceptance = {
+        "stream_peak_ratio_hi_over_lo": round(stream_flat, 3),
+        "stream_flat_leq_1_15x": stream_flat <= 1.15,
+        "reservoir_peak_ratio_hi_over_lo": round(reservoir_flat, 3),
+        "stack_peak_delta_growth": round(stack_delta_growth, 2),
+        "stack_grows_with_cohort": stack_delta_growth >= (2.0 if args.smoke
+                                                          else 4.0),
+        "mean_checksums_identical_stream_vs_stack": checksums_equal,
+    }
+    details = {
+        "backend": arms[("stream", lo)]["backend"],
+        "note": ("CPU-container wall-clock + VmRSS watermark bench (host "
+                 "perf_counter, /proc polling; no accelerator) — server "
+                 "aggregation memory/time only, upload generation "
+                 "excluded, not a training-throughput claim"),
+        "smoke": bool(args.smoke),
+        "model_mb": model_mb,
+        "cohort_sizes": sizes,
+        "arms": {f"{m}_n{n}": arms[(m, n)]
+                 for (m, n) in arms},
+        "acceptance": acceptance,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(details, f, indent=1)
+            f.write("\n")
+    print(json.dumps({"bench": "stream_agg", "out": args.out or None,
+                      **acceptance}))
+    ok = (acceptance["stream_flat_leq_1_15x"]
+          and acceptance["stack_grows_with_cohort"]
+          and checksums_equal)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,5 +20,6 @@ cd "$(dirname "$0")/.."
 [ -f tests/test_robust_round.py ]  # ...and the payload-defense suite
 [ -f tests/test_wire.py ]          # ...and the encode-once wire suite
 [ -f tests/test_perf_obs.py ]      # ...and the flight-recorder suite
+[ -f tests/test_stream_agg.py ]    # ...and the streaming-aggregation suite
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

@@ -109,7 +109,7 @@ def test_staleness_discount_weighting():
     upload(2, 9.0, 0)   # stale s=1, alpha=1: ratio 0.5, discount 0.5
     # applied = 0.5*1*3 + 0.5*0.5*9 = 3.75  (old relative-only scheme: 5.0)
     np.testing.assert_allclose(server.params["w"], 3.75)
-    assert server.staleness_seen == [0, 1]
+    assert list(server.staleness_seen) == [0, 1]
 
 
 def test_uniformly_stale_buffer_is_damped_absolutely():

@@ -68,6 +68,11 @@ EXPECTED = {
     "fedml_slo_round_duration_p95_seconds",
     "fedml_slo_serve_shed_ratio", "fedml_slo_torn_frame_ratio",
     "fedml_slo_quarantine_per_round_ratio", "fedml_slo_breaches_total",
+    # PR 7: streaming O(1)-memory aggregation (core/stream_agg.py) and
+    # the multi-level edge topology (algorithms/hierarchical.py)
+    "fedml_stream_folds_total", "fedml_stream_evictions_total",
+    "fedml_stream_reservoir_fill_total", "fedml_stream_finalize_seconds",
+    "fedml_stream_edge_flush_total",
 }
 
 

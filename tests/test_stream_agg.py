@@ -1,0 +1,546 @@
+"""Streaming O(1)-memory aggregation (core/stream_agg.py) and the live
+multi-level aggregator topology (hierarchical.EdgeAggregatorActor).
+
+The load-bearing pins:
+
+* ``mean`` stream-vs-stack BIT-IDENTITY — the stream fold and the stack
+  path's `lax.scan` mean are the same sequential reduction, so the two
+  `--agg_mode`s agree bit for bit, including dropped-straggler refill
+  and quarantined weight-0 slots;
+* reservoir regime: exact (up to slot order) when the cohort fits the
+  reservoir, bounded O(K * model) beyond it, result inside the honest
+  envelope;
+* the fold jit compiles ONCE across rounds (`_cache_size() == 1`);
+* stream mode never allocates the ``[cohort, ...]`` staging buffer, and
+  stack mode RELEASES it at round close;
+* edge→root topology over the real transport: flat parity clean, and a
+  chaos-dropped edge degrades to the root's straggler policy instead of
+  wedging the federation.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.algorithms.async_fl import AsyncFedServerActor, delta_encoder
+from fedml_tpu.algorithms.cross_silo import (FedAvgClientActor,
+                                             FedAvgServerActor, MsgType)
+from fedml_tpu.algorithms.hierarchical import EdgeAggregatorActor
+from fedml_tpu.comm.chaos import ChaosPlan, ChaosTransport, LinkChaos
+from fedml_tpu.comm.local import LocalHub
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.stream_agg import StreamingAggregator
+from fedml_tpu.robust import (AdmissionPipeline, Attack, TrustTracker,
+                              make_defended_aggregate,
+                              make_malicious_train_fn)
+
+
+def _params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"dense": {"kernel": rng.randn(4, 3).astype(np.float32),
+                      "bias": rng.randn(3).astype(np.float32)}}
+
+
+def _uploads(n, seed=7):
+    rng = np.random.RandomState(seed)
+    ups, ws = [], []
+    for i in range(n):
+        ups.append(jax.tree.map(
+            lambda v: np.asarray(v) + rng.randn(*np.shape(v)).astype(
+                np.float32), _params()))
+        ws.append(float(10 * (i + 1)))
+    return ups, ws
+
+
+def _stack(trees):
+    return jax.tree.map(lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                        *trees)
+
+
+def _assert_trees_equal(a, b):
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+        np.asarray(x), np.asarray(y)), a, b)
+
+
+# ---------------------------------------------------------------------------
+# the fold itself: stream == stack, bit for bit
+# ---------------------------------------------------------------------------
+
+class TestMeanFold:
+    @pytest.mark.parametrize("norm_clip,noise_std", [(0.0, 0.0),
+                                                     (5.0, 0.0),
+                                                     (5.0, 0.01)])
+    def test_fold_matches_stack_scan_bitwise(self, norm_clip, noise_std):
+        tmpl = _params()
+        ups, ws = _uploads(6)
+        agg = StreamingAggregator(tmpl, method="mean", norm_clip=norm_clip,
+                                  noise_std=noise_std, seed=3)
+        agg.reset(tmpl)
+        for u, w in zip(ups, ws):
+            agg.fold(u, w)
+        streamed = agg.finalize(2)
+        fn = make_defended_aggregate("mean", norm_clip=norm_clip,
+                                     noise_std=noise_std, seed=3)
+        stacked = fn(tmpl, _stack(ups), np.asarray(ws, np.float32), 2)
+        _assert_trees_equal(streamed, stacked)
+
+    def test_weight_zero_slots_are_exactly_absent(self):
+        """A stack whose slot holds the reference at weight 0 (dropped /
+        quarantined / rejected) contributes an exact +0.0 to the scan —
+        bit-identical to never folding that slot at all."""
+        tmpl = _params()
+        ups, ws = _uploads(5)
+        agg = StreamingAggregator(tmpl, method="mean", norm_clip=5.0)
+        agg.reset(tmpl)
+        for i, (u, w) in enumerate(zip(ups, ws)):
+            if i != 2:  # slot 2 never arrives
+                agg.fold(u, w)
+        streamed = agg.finalize(0)
+        fn = make_defended_aggregate("mean", norm_clip=5.0)
+        padded = list(ups)
+        padded[2] = tmpl  # the refill the stack path does at round close
+        w = np.asarray(ws, np.float32)
+        w[2] = 0.0
+        _assert_trees_equal(streamed, fn(tmpl, _stack(padded), w, 0))
+
+    def test_int_leaves_accumulate_exactly(self):
+        """acc_dtype contract: int leaves (step counters) ride an f32
+        accumulator in BOTH modes — same helper, same result."""
+        tmpl = {"w": np.ones(3, np.float32), "step": np.int32(4)}
+        ups = [{"w": np.full(3, i, np.float32), "step": np.int32(i)}
+               for i in range(1, 4)]
+        ws = [10.0, 20.0, 30.0]
+        agg = StreamingAggregator(tmpl, method="mean")
+        agg.reset(tmpl)
+        for u, w in zip(ups, ws):
+            agg.fold(u, w)
+        fn = make_defended_aggregate("mean")
+        _assert_trees_equal(agg.finalize(0),
+                            fn(tmpl, _stack(ups),
+                               np.asarray(ws, np.float32), 0))
+
+    def test_validation_and_lifecycle_errors(self):
+        tmpl = _params()
+        with pytest.raises(ValueError, match="unknown streaming"):
+            StreamingAggregator(tmpl, method="majority_vote")
+        with pytest.raises(ValueError, match="kind"):
+            StreamingAggregator(tmpl, kind="gradients")
+        with pytest.raises(ValueError, match="reservoir_k"):
+            StreamingAggregator(tmpl, method="krum", reservoir_k=0)
+        agg = StreamingAggregator(tmpl, method="mean")
+        with pytest.raises(RuntimeError, match="fold\\(\\) before reset"):
+            agg.fold(tmpl, 1.0)
+        agg.reset(tmpl)
+        with pytest.raises(RuntimeError, match="no folded uploads"):
+            agg.finalize(0)
+
+    def test_fold_jit_compiles_once_across_rounds(self):
+        tmpl = _params()
+        agg = StreamingAggregator(tmpl, method="mean", norm_clip=5.0)
+        for r in range(4):
+            agg.reset(tmpl if r == 0 else out)  # noqa: F821 — prior round
+            ups, ws = _uploads(3, seed=r)
+            for u, w in zip(ups, ws):
+                agg.fold(u, w)
+            out = agg.finalize(r)
+        assert agg._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# reservoir regime (robust rules)
+# ---------------------------------------------------------------------------
+
+class TestReservoir:
+    def test_exact_when_cohort_fits(self):
+        """cohort <= K: the rule sees every upload (pad slots carry the
+        reference at weight 0 — the zero diff every rule masks out), so
+        the reservoir result equals the stack-mode defended result."""
+        tmpl = _params()
+        ups, ws = _uploads(5)
+        agg = StreamingAggregator(tmpl, method="coordinate_median",
+                                  reservoir_k=8, seed=1)
+        agg.reset(tmpl)
+        for u, w in zip(ups, ws):
+            agg.fold(u, w)
+        got = agg.finalize(0)
+        fn = make_defended_aggregate("coordinate_median")
+        want = fn(tmpl, _stack(ups), np.asarray(ws, np.float32), 0)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6), got, want)
+
+    @pytest.mark.parametrize("method", ["coordinate_median", "trimmed_mean",
+                                        "krum", "geometric_median"])
+    def test_bounded_beyond_k_and_inside_honest_envelope(self, method):
+        """cohort > K: standing memory stays [K, ...] no matter how many
+        uploads fold, and the rule's output lies inside the elementwise
+        envelope of the honest uploads (a uniform subsample of honest
+        points cannot leave their hull under any of these rules)."""
+        tmpl = _params()
+        ups, ws = _uploads(12)
+        agg = StreamingAggregator(tmpl, method=method, reservoir_k=4,
+                                  seed=2, trim_frac=0.25, byz_f=1)
+        agg.reset(tmpl)
+        for u, w in zip(ups, ws):
+            agg.fold(u, w)
+        assert agg.count == 12 and agg._seen == 12
+        # the memory bound: K slots, every one holding a real upload now
+        for leaf in agg._res_leaves:
+            assert leaf.shape[0] == 4
+        assert (agg._res_weights > 0).all()
+        out = jax.tree.map(np.asarray, agg.finalize(0))
+        lo = jax.tree.map(lambda *xs: np.min(np.stack(xs), 0) - 1e-5, *ups)
+        hi = jax.tree.map(lambda *xs: np.max(np.stack(xs), 0) + 1e-5, *ups)
+        jax.tree.map(lambda o, a, b: np.testing.assert_array_less(a, o)
+                     or np.testing.assert_array_less(o, b), out, lo, hi)
+
+    def test_reservoir_finalize_compiles_once_across_rounds(self):
+        tmpl = _params()
+        agg = StreamingAggregator(tmpl, method="trimmed_mean",
+                                  reservoir_k=4, trim_frac=0.25)
+        out = tmpl
+        for r in range(3):
+            agg.reset(out)
+            ups, ws = _uploads(6, seed=r)
+            for u, w in zip(ups, ws):
+                agg.fold(u, w)
+            out = agg.finalize(r)
+        assert agg._cache_size() == 1
+
+    def test_reservoir_rejects_treedef_mismatch(self):
+        tmpl = _params()
+        agg = StreamingAggregator(tmpl, method="krum", reservoir_k=4)
+        agg.reset(tmpl)
+        with pytest.raises(ValueError, match="treedef"):
+            agg.fold({"alien": np.zeros(2, np.float32)}, 1.0)
+        # fail-loud must not depend on winning an Algorithm-R slot: past
+        # the K bound a malformed upload still raises on EVERY arrival
+        # and is never absorbed into the fold count
+        ups, ws = _uploads(8)
+        for u, w in zip(ups, ws):
+            agg.fold(u, w)
+        count_before = agg.count
+        for _ in range(6):  # several draws — losing ones must raise too
+            with pytest.raises(ValueError, match="treedef"):
+                agg.fold({"alien": np.zeros(2, np.float32)}, 1.0)
+        assert agg.count == count_before
+
+
+# ---------------------------------------------------------------------------
+# the live sync server: --agg_mode stream vs stack, bit for bit
+# ---------------------------------------------------------------------------
+
+def _drift_train_fn(scale=0.01):
+    def fn(params, client_idx, round_idx):
+        return (jax.tree.map(
+            lambda v: np.asarray(v)
+            + np.float32(scale * (client_idx + 1)), params),
+            10 * (client_idx + 1))
+    return fn
+
+
+def _run_sync(mode, n_silos=4, n_rounds=3, admission=None, attack=None,
+              attacker=2, deaf=(), norm_clip=5.0, perf=None):
+    """One pump-mode federation; ``deaf`` silos never answer a sync, and
+    the caller-injected ROUND_TIMEOUT closes over them deterministically
+    (arrival order stays slot order, so stream folds == stack scan)."""
+    hub = LocalHub(codec_roundtrip=True)
+    init = _params()
+    kw = {}
+    if mode == "stream":
+        kw["stream_agg"] = StreamingAggregator(init, method="mean",
+                                               norm_clip=norm_clip)
+    else:
+        kw["aggregate_fn"] = make_defended_aggregate("mean",
+                                                     norm_clip=norm_clip)
+    server = FedAvgServerActor(
+        hub.transport(0), init, client_num_in_total=n_silos,
+        client_num_per_round=n_silos, num_rounds=n_rounds,
+        admission=admission, perf=perf,
+        straggler_policy="drop" if deaf else "wait",
+        round_timeout_s=3600 if deaf else None, min_silo_frac=0.5, **kw)
+    server.register_handlers()
+    silos = []
+    for i in range(1, n_silos + 1):
+        fn = _drift_train_fn()
+        if attack is not None and i == attacker:
+            fn = make_malicious_train_fn(attack, fn, silo=i, seed=0)
+        if i in deaf:
+            class Deaf(FedAvgClientActor):
+                def register_handlers(self):
+                    self.register_handler(MsgType.S2C_FINISH,
+                                          lambda m: self.finish())
+            silos.append(Deaf(i, hub.transport(i), fn))
+        else:
+            silos.append(FedAvgClientActor(i, hub.transport(i), fn))
+    for s in silos:
+        s.register_handlers()
+    server.start()
+    hub.pump()
+    while deaf and server.round_idx < n_rounds:
+        # the deterministic straggler close: every honest upload already
+        # arrived (in slot order), the barrier waits only on the deaf
+        # silos — fire the timeout by hand instead of sleeping on the
+        # wall-clock timer
+        server.send(MsgType.ROUND_TIMEOUT, 0,
+                    **{Message.ARG_ROUND: server.round_idx})
+        hub.pump()
+    return server, init
+
+
+class TestLiveSyncEquivalence:
+    def test_stream_matches_stack_bitwise(self):
+        stack, _ = _run_sync("stack")
+        stream, _ = _run_sync("stream")
+        assert stream.round_idx == stack.round_idx == 3
+        _assert_trees_equal(stack.params, stream.params)
+        # the O(1)-memory point: stream mode never allocated the
+        # [cohort, ...] staging buffer at all
+        assert stream._staging is None and stream._staged_seen == 0
+        assert stack._staged_seen == 3 * 4
+        # ... and stack mode RELEASED it at round close
+        assert stack._staging is None
+
+    def test_stream_matches_stack_with_dropped_straggler(self):
+        stack, _ = _run_sync("stack", deaf=(4,))
+        stream, _ = _run_sync("stream", deaf=(4,))
+        assert stack.dropped_silos == stream.dropped_silos
+        assert any(4 in v for v in stack.dropped_silos.values())
+        _assert_trees_equal(stack.params, stream.params)
+
+    def test_stream_matches_stack_with_quarantined_attacker(self):
+        def adm():
+            return AdmissionPipeline(
+                _params(), norm_min_history=3,
+                trust=TrustTracker(strikes_to_quarantine=2,
+                                   quarantine_rounds=10))
+        a1, a2 = adm(), adm()
+        stack, init = _run_sync("stack", n_rounds=6, admission=a1,
+                                attack=Attack("scale", 100.0))
+        stream, _ = _run_sync("stream", n_rounds=6, admission=a2,
+                              attack=Attack("scale", 100.0))
+        # both arms saw the same screen verdicts and the same quarantine
+        assert a1.rejected == a2.rejected
+        assert a1.trust.state(2, 6) == a2.trust.state(2, 6) \
+            == TrustTracker.QUARANTINED
+        _assert_trees_equal(stack.params, stream.params)
+
+    def test_stream_fold_jit_once_on_the_live_path(self):
+        stream, _ = _run_sync("stream", n_rounds=4)
+        assert stream.stream_agg._cache_size() == 1
+
+    def test_perf_ledger_gains_the_fold_phase(self, tmp_path):
+        from fedml_tpu.obs.perf import PerfRecorder
+        rec = PerfRecorder(str(tmp_path / "perf.jsonl"))
+        server, _ = _run_sync("stream", perf=rec)
+        rec.close()
+        rounds = [json.loads(l) for l in
+                  (tmp_path / "perf.jsonl").read_text().splitlines()]
+        assert len(rounds) == 3
+        for line in rounds:
+            assert line["phases"].get("fold", 0) > 0
+            # every admitted upload folded at arrival — nothing staged
+            assert "staging" not in line["phases"]
+
+
+# ---------------------------------------------------------------------------
+# the live async server: stream vs defended-stack, bit for bit
+# ---------------------------------------------------------------------------
+
+def _run_async(mode, n_silos=4, versions=3, goal=2):
+    hub = LocalHub(codec_roundtrip=True)
+    init = _params()
+    kw = {}
+    if mode == "stream":
+        kw["stream_agg"] = StreamingAggregator(init, method="mean",
+                                               kind="delta")
+    else:
+        kw["defended_aggregate"] = make_defended_aggregate("mean")
+    server = AsyncFedServerActor(
+        hub.transport(0), init, client_num_in_total=n_silos,
+        n_silos=n_silos, num_versions=versions, aggregation_goal=goal,
+        **kw)
+    server.register_handlers()
+    silos = [FedAvgClientActor(i, hub.transport(i), _drift_train_fn(),
+                               encode_upload=delta_encoder)
+             for i in range(1, n_silos + 1)]
+    for s in silos:
+        s.register_handlers()
+    server.start()
+    hub.pump()
+    return server
+
+
+class TestLiveAsyncEquivalence:
+    def test_stream_matches_defended_stack_bitwise(self):
+        stack = _run_async("stack")
+        stream = _run_async("stream")
+        assert stack.version == stream.version >= 3
+        assert list(stack.staleness_seen) == list(stream.staleness_seen)
+        _assert_trees_equal(stack.params, stream.params)
+
+    def test_stream_buffer_holds_no_deltas(self):
+        """The async O(1) point: the buffer keeps metadata tuples only —
+        the delta bytes fold at arrival and are dropped."""
+        hub = LocalHub(codec_roundtrip=True)
+        init = _params()
+        server = AsyncFedServerActor(
+            hub.transport(0), init, client_num_in_total=2, n_silos=2,
+            num_versions=2, aggregation_goal=2,
+            stream_agg=StreamingAggregator(init, method="mean",
+                                           kind="delta"))
+        seen = []
+        orig = server._apply_buffer
+
+        def spy():
+            seen.extend(d for d, _, _, _, _ in server._buffer)
+            orig()
+        server._apply_buffer = spy
+        server.register_handlers()
+        silos = [FedAvgClientActor(i, hub.transport(i), _drift_train_fn(),
+                                   encode_upload=delta_encoder)
+                 for i in (1, 2)]
+        for s in silos:
+            s.register_handlers()
+        server.start()
+        hub.pump()
+        assert seen and all(d is None for d in seen)
+
+
+# ---------------------------------------------------------------------------
+# the multi-level aggregator topology over the real transport
+# ---------------------------------------------------------------------------
+
+def _edge_federation(n_edges=2, n_silos=4, n_rounds=3, wrap=lambda i, t: t,
+                     timeout_s=None, root_timeout_s=None,
+                     straggler_policy="wait"):
+    """root 0; edges 1..E; silos at E+g for global slot g (blocks of
+    contiguous slots per edge) — the same address plan experiments/main.py
+    deploys."""
+    hub = LocalHub(codec_roundtrip=True)
+    init = _params()
+    server = FedAvgServerActor(
+        wrap(0, hub.transport(0)), init, client_num_in_total=n_silos,
+        client_num_per_round=n_edges, num_rounds=n_rounds,
+        stream_agg=StreamingAggregator(init, method="mean"),
+        straggler_policy=straggler_policy, round_timeout_s=root_timeout_s,
+        min_silo_frac=0.5)
+    server.register_handlers()
+    blocks = np.array_split(np.arange(1, n_silos + 1), n_edges)
+    edges = []
+    for e, block in enumerate(blocks, start=1):
+        edges.append(EdgeAggregatorActor(
+            e, wrap(e, hub.transport(e)),
+            {n_edges + int(g): int(g) for g in block},
+            cohort_total=n_silos, client_num_in_total=n_silos,
+            stream_agg=StreamingAggregator(init, method="mean"),
+            timeout_s=timeout_s))
+    edge_of = {int(g): e for e, block in enumerate(blocks, start=1)
+               for g in block}
+    silos = [FedAvgClientActor(n_edges + g, wrap(n_edges + g,
+                                                 hub.transport(n_edges + g)),
+                               _drift_train_fn(), server_id=edge_of[g])
+             for g in range(1, n_silos + 1)]
+    return hub, init, server, edges, silos
+
+
+class TestEdgeTopology:
+    def test_edge_root_matches_flat_stream(self):
+        """mean(edge means, edge weights) == mean(all uploads) — the
+        2-tier run lands where the flat run lands (fp association
+        differs across the tiers, so allclose, not bitwise)."""
+        hub, init, server, edges, silos = _edge_federation()
+        for a in edges + silos:
+            a.register_handlers()
+        server.start()
+        hub.pump()
+        assert server.round_idx == 3
+        flat, _ = _run_sync("stream", norm_clip=0.0)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6),
+            server.params, flat.params)
+        # each edge folded exactly its block every round
+        for e in edges:
+            assert e.stream_agg.count == 2
+
+    def test_edge_ships_one_prereduced_frame(self):
+        """The wire contract: the root receives E model-sized frames per
+        round — the folded weight total as num_samples, the fold count
+        as edge_count — no matter how many silos fed each edge."""
+        hub, init, server, edges, silos = _edge_federation(n_rounds=1)
+        got = []
+        orig = server._on_model
+
+        def spy(msg):
+            got.append((msg.sender_id, msg.get(Message.ARG_NUM_SAMPLES),
+                        msg.get(Message.ARG_EDGE_COUNT)))
+            orig(msg)
+        server.register_handler(MsgType.C2S_MODEL, spy)
+        for a in edges + silos:
+            a.register_handlers()
+        server.start()
+        hub.pump()
+        assert sorted(s for s, _, _ in got) == [1, 2]
+        for _, num_samples, edge_count in got:
+            assert edge_count == 2          # silos folded into the edge
+            assert num_samples > 0          # the folded weight total
+
+    def test_chaos_dropped_edge_degrades_to_straggler_policy(self):
+        """Every edge-1 → root frame is chaos-dropped: the root's drop
+        policy closes each round on edge 2 alone (min_silo_frac 0.5)
+        and the global still tracks edge 2's honest drift — a lost edge
+        is a straggler, never a wedge."""
+        plan = ChaosPlan(seed=3, links={(1, 0): LinkChaos(drop_prob=1.0)},
+                         immune_types=(MsgType.S2C_FINISH,
+                                       MsgType.ROUND_TIMEOUT))
+        hub, init, server, edges, silos = _edge_federation(
+            n_rounds=2, straggler_policy="drop", root_timeout_s=0.5,
+            wrap=lambda i, t: ChaosTransport(t, plan) if i == 1 else t)
+        threads = [threading.Thread(target=a.run, daemon=True,
+                                    name=f"node-{a.node_id}")
+                   for a in edges + silos]
+        for th in threads:
+            th.start()
+        server.start()
+        server.transport.run()
+        for th in threads:
+            th.join(timeout=10)
+        assert server.round_idx == 2
+        # edge 1 was dropped every round; edge 2's fold landed
+        assert all(1 in v for v in server.dropped_silos.values())
+        assert all(np.isfinite(l).all()
+                   for l in jax.tree.leaves(server.params))
+        drift = (np.asarray(server.params["dense"]["bias"])
+                 - np.asarray(init["dense"]["bias"]))
+        assert np.abs(drift).max() > 0  # edge 2's silos moved the global
+
+    def test_foreign_and_stale_uploads_are_discarded(self):
+        hub = LocalHub(codec_roundtrip=True)
+        init = _params()
+        edge = EdgeAggregatorActor(
+            1, hub.transport(1), {3: 1, 4: 2}, cohort_total=2,
+            client_num_in_total=2,
+            stream_agg=StreamingAggregator(init, method="mean"))
+        edge.register_handlers()
+        hub.transport(3), hub.transport(4)  # endpoints for the re-broadcast
+        # sync the edge into round 0 by hand
+        msg = Message(MsgType.S2C_SYNC, 0, 1)
+        msg.add(Message.ARG_MODEL_PARAMS, init)
+        msg.add(Message.ARG_ROUND, 0)
+        edge._on_sync(msg)
+        up = Message(MsgType.C2S_MODEL, 9, 1)  # not one of its silos
+        up.add(Message.ARG_MODEL_PARAMS, _params(1))
+        up.add(Message.ARG_NUM_SAMPLES, 10)
+        up.add(Message.ARG_ROUND, 0)
+        edge._on_upload(up)
+        assert edge.stream_agg.count == 0
+        stale = Message(MsgType.C2S_MODEL, 3, 1)
+        stale.add(Message.ARG_MODEL_PARAMS, _params(1))
+        stale.add(Message.ARG_NUM_SAMPLES, 10)
+        stale.add(Message.ARG_ROUND, 7)  # wrong round
+        edge._on_upload(stale)
+        assert edge.stream_agg.count == 0
+        edge.finish()
