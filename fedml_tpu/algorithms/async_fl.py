@@ -96,7 +96,8 @@ class AsyncFedServerActor(ServerManager):
                  defended_aggregate: Optional[Callable] = None,
                  stream_agg=None,
                  encode_once: bool = True,
-                 perf=None):
+                 perf=None,
+                 health=None):
         """``checkpointer``: a `RoundCheckpointer`; every applied version
         is saved per its ``save_every`` gating and ``start()`` resumes
         from the latest saved version — a crashed async server restarts
@@ -148,7 +149,16 @@ class AsyncFedServerActor(ServerManager):
         per applied VERSION (the async analog of a round): tasking-wave
         serialize, admission, defended aggregate, checkpoint, publish
         (the on_version hook), wire deltas, RSS watermark, recompile
-        sentry."""
+        sentry.
+
+        ``health``: a `fedml_tpu.obs.health.HealthAccumulator` built
+        with ``kind="delta"`` — every admitted delta folds its
+        learning-health statistics at arrival (norm Welford moments
+        reusing the admission verdict's norm, cosine alignment of the
+        delta against the version's running mean direction, per-silo
+        staleness), so the buffer-held metadata tuples stay the only
+        per-upload state.  One ``health.jsonl`` line per applied
+        version; rejected/malformed uploads tick fairness counters."""
         super().__init__(0, transport)
         if not 1 <= aggregation_goal <= n_silos:
             raise ValueError(
@@ -188,6 +198,19 @@ class AsyncFedServerActor(ServerManager):
         self.stream_agg = stream_agg
         self.encode_once = encode_once
         self.perf = perf
+        self.health = health
+        if health is not None:
+            # no per-version barrier set exists — the silo universe is
+            # the fairness denominator from version 0.  The starvation
+            # clock ticks per VERSION here, and a healthy rotation only
+            # accepts ~goal of n_silos silos per version — so "N missed
+            # turns" means N rotation periods, not N versions: scale
+            # the accumulator's starve_after by ceil(n_silos / goal) or
+            # every healthy silo would read as starved the moment
+            # n_silos / goal exceeds it
+            period = -(-n_silos // aggregation_goal)
+            health.starve_after = health.starve_after * period
+            health.register(range(1, n_silos + 1))
         # host mirror of the current global — a tasking wave re-tasks up
         # to ``goal`` silos against the SAME version, and each used to
         # pay its own device→host transfer
@@ -251,6 +274,9 @@ class AsyncFedServerActor(ServerManager):
             self.stream_agg.reset(self.params)
         if self.perf is not None:
             self.perf.round_start(self.version)
+        if self.health is not None:
+            with self._perf_phase("health"):
+                self.health.round_start(self.version, self._host_params())
         # one root span for the initial tasking wave, so version-0 silo
         # train/upload spans stitch into a single trace instead of N
         # disconnected fragments
@@ -381,6 +407,7 @@ class AsyncFedServerActor(ServerManager):
             return
         delta = msg.get(Message.ARG_MODEL_PARAMS)
         raw_samples = msg.get(Message.ARG_NUM_SAMPLES)
+        delta_norm = None
         if self.admission is not None:
             pair = (msg.sender_id, base_version)
             seen = self._rejected_crcs.get(pair)
@@ -403,6 +430,10 @@ class AsyncFedServerActor(ServerManager):
                 log.warning("rejecting version-%d upload from silo %d "
                             "(reason=%s)", base_version, msg.sender_id,
                             verdict.reason)
+                if self.health is not None:
+                    with self._perf_phase("health"):
+                        self.health.observe_rejected(msg.sender_id,
+                                                     verdict.reason)
                 if crc is None:
                     crc = _payload_crc(delta)
                 self._rejected_crcs.setdefault(pair, set()).add(crc)
@@ -415,6 +446,8 @@ class AsyncFedServerActor(ServerManager):
                     self._task(msg.sender_id, self._next_client())
                 return
             num_samples = verdict.num_samples
+            # the screen's one O(model) norm pass is shared with health
+            delta_norm = verdict.norm
         else:
             # minimal validation even undefended: float(None) used to
             # raise TypeError and kill the handler thread, and negative/
@@ -433,6 +466,13 @@ class AsyncFedServerActor(ServerManager):
         discount = float(1.0 + staleness) ** (-self.alpha)
         self.staleness_seen.append(staleness)
         self._h_staleness.observe(staleness)
+        if self.health is not None:
+            # health folds BEFORE the aggregation fold consumes the
+            # delta — after it, only metadata tuples survive
+            with self._perf_phase("health"):
+                self.health.observe_admitted(msg.sender_id, delta,
+                                             num_samples, norm=delta_norm,
+                                             staleness=staleness)
         if self.stream_agg is not None:
             # fold at arrival: the buffer keeps only the metadata tuple
             # (weights/discounts/at-most-once bookkeeping) — the delta's
@@ -483,6 +523,9 @@ class AsyncFedServerActor(ServerManager):
         seen.add(crc)
         log.warning("rejecting upload from silo %d: %s", msg.sender_id,
                     detail)
+        if self.health is not None:
+            with self._perf_phase("health"):
+                self.health.observe_rejected(msg.sender_id, "malformed")
         if self.admission is not None:
             # malformed metadata is structural damage: count + strike
             self.admission.reject(msg.sender_id, self.version,
@@ -581,6 +624,14 @@ class AsyncFedServerActor(ServerManager):
                                       np.asarray(p).dtype),
                     self.params, mean)
         silos = [s for _, _, _, s, _ in self._buffer]
+        if self.health is not None:
+            # close the version's health line on the post-apply global
+            # BEFORE perf.round_end, so the health phase ledgers into
+            # the same version line it belongs to
+            with self._perf_phase("health"):
+                self.health.round_end(self.version,
+                                      new_global=self._host_params(),
+                                      buffered=len(silos))
         self._consumed.update((s, b) for _, _, _, s, b in self._buffer)
         self._buffer.clear()
         if self.stream_agg is not None:
@@ -623,6 +674,9 @@ class AsyncFedServerActor(ServerManager):
             # belongs to no line) and before the tasking wave, so the
             # wave's serialize is its first phase
             self.perf.round_start(self.version)
+        if self.health is not None:
+            with self._perf_phase("health"):
+                self.health.round_start(self.version, self._host_params())
         # only the consumed silos need new work; assignments draw in
         # buffer order (the legacy per-silo RNG schedule), the wave then
         # serializes the new global once for all of them

@@ -160,7 +160,8 @@ class FedAvgServerActor(ServerManager):
                  stream_agg=None,
                  encode_once: bool = True,
                  incremental_staging: bool = True,
-                 perf=None):
+                 perf=None,
+                 health=None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -237,6 +238,18 @@ class FedAvgServerActor(ServerManager):
         The actor only drives the round lifecycle; the recorder's owner
         (the runner) registers hot jits and closes it.
 
+        ``health``: a `fedml_tpu.obs.health.HealthAccumulator`; when
+        set, every admitted upload folds its learning-health statistics
+        at arrival on the SAME admission-accept seam the aggregation
+        fold rides (update-norm Welford moments reusing the
+        `AdmissionVerdict` norm, cosine alignment against the round's
+        running mean direction, per-silo fairness counters), and the
+        round close writes one ``health.jsonl`` line with the
+        round-over-round global delta norm and the drift-alarm
+        verdicts.  Under the edge topology the root also banks each
+        edge frame's `Message.ARG_HEALTH` rollup.  The health path is
+        ledgered as its own ``health`` perf phase.
+
         ``incremental_staging``: with an ``aggregate_fn`` set, each
         admitted upload is copied into its slot of a ``[cohort, ...]``
         host staging buffer AT ARRIVAL TIME — staging overlaps the
@@ -291,6 +304,7 @@ class FedAvgServerActor(ServerManager):
         self.encode_once = encode_once
         self.incremental_staging = incremental_staging
         self.perf = perf
+        self.health = health
         self.dropped_silos: Dict[int, list] = {}  # round -> missing silo ids
         self._received: Dict[int, tuple] = {}
         # per-round host mirror of self.params: the broadcast, checkpoint,
@@ -474,6 +488,15 @@ class FedAvgServerActor(ServerManager):
             # (the round's clip reference)
             self.stream_agg.reset(self.params)
         host_params = self._host_params()
+        if self.health is not None:
+            # the health round opens against the SAME host mirror the
+            # broadcast ships — no extra device→host transfer; silos
+            # excluded at broadcast (dead/quarantined) tick their
+            # fairness counters without ever reaching an upload
+            with self._perf_phase("health"):
+                self.health.round_start(self.round_idx, host_params,
+                                        expected=sorted(self._expected),
+                                        excluded=sorted(dead))
         extra = ({} if self._last_accepted is None
                  else {Message.ARG_ACCEPTED: self._last_accepted})
         with self._span("broadcast", parent=self._round_span,
@@ -629,6 +652,10 @@ class FedAvgServerActor(ServerManager):
                         msg.sender_id, handshake_err)
             self.admission.reject(msg.sender_id, self.round_idx,
                                   "fingerprint")
+            if self.health is not None:
+                with self._perf_phase("health"):
+                    self.health.observe_rejected(msg.sender_id,
+                                                 "fingerprint")
             if self._first_upload_t is None:
                 self._first_upload_t = time.monotonic()
             self._note_upload(msg.sender_id, None)
@@ -650,6 +677,7 @@ class FedAvgServerActor(ServerManager):
         if self._first_upload_t is None:
             self._first_upload_t = time.monotonic()
         entry = (upload, msg.get(Message.ARG_NUM_SAMPLES))
+        upload_norm = None
         if self.admission is not None:
             with self._perf_phase("admission"):
                 verdict = self.admission.admit(
@@ -657,6 +685,9 @@ class FedAvgServerActor(ServerManager):
                     self.params, self.round_idx)
             if verdict.ok:
                 entry = (upload, verdict.num_samples)
+                # the screen's one O(model) norm pass is shared: health
+                # reuses it instead of re-walking the tree
+                upload_norm = verdict.norm
             else:
                 # the silo DID report — the barrier closes over it — but
                 # its payload is inadmissible: weight 0, never aggregated
@@ -664,6 +695,22 @@ class FedAvgServerActor(ServerManager):
                             "(reason=%s)", self.round_idx, msg.sender_id,
                             verdict.reason)
                 entry = None
+                if self.health is not None:
+                    with self._perf_phase("health"):
+                        self.health.observe_rejected(msg.sender_id,
+                                                     verdict.reason)
+        if entry is not None and self.health is not None:
+            # fold the health stats at arrival, BEFORE the aggregation
+            # fold can consume (stream mode) or stage the upload —
+            # after it, the evidence is gone
+            with self._perf_phase("health"):
+                # an edge frame carries its block's rollup beside the
+                # pre-reduced mean; the flat topology never sets it
+                edge_summary = msg.get(Message.ARG_HEALTH)
+                if edge_summary is not None:
+                    self.health.note_edge(msg.sender_id, edge_summary)
+                self.health.observe_admitted(msg.sender_id, entry[0],
+                                             entry[1], norm=upload_norm)
         self._note_upload(msg.sender_id, entry)
 
     # sentinel entry marker: the upload's bytes already live in the
@@ -850,6 +897,15 @@ class FedAvgServerActor(ServerManager):
         if self._round_span is not None:
             self._round_span.end()
             self._round_span = None
+        if self.health is not None:
+            # closes the health round on the post-aggregate host mirror
+            # (shared with checkpoint/publish — still one device→host
+            # transfer per round), BEFORE perf.round_end so the health
+            # phase lands in THIS round's ledger line
+            with self._perf_phase("health"):
+                self.health.round_end(self.round_idx,
+                                      new_global=self._host_params(),
+                                      quorum=len(admitted))
 
         if self.checkpointer is not None:
             # thunk: rounds the save_every gate skips pay no device→host

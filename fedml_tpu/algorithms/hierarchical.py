@@ -325,7 +325,14 @@ class EdgeAggregatorActor:
     def __init__(self, node_id: int, transport, silos: Dict[int, int],
                  cohort_total: int, client_num_in_total: int,
                  stream_agg, admission=None, root_id: int = 0,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None, health=None):
+        """``health``: a `fedml_tpu.obs.health.HealthAccumulator`
+        (statistics-only — ``alarms=False``, no ledger: the root owns
+        verdicts); when set, the edge folds its silos' learning-health
+        stats at arrival and ships the compact per-round rollup inside
+        its existing edge frame (`Message.ARG_HEALTH`) — the tree stays
+        one-frame-per-round and the root renders a per-edge health
+        table."""
         from fedml_tpu.comm.actors import ClientManager, SelfMessageTimer
         from fedml_tpu.obs import telemetry
 
@@ -348,12 +355,12 @@ class EdgeAggregatorActor:
         self.client_num_in_total = client_num_in_total
         self.stream_agg = stream_agg
         self.admission = admission
+        self.health = health
         self.root_id = root_id
         self.timeout_s = timeout_s
         self.round_idx: Optional[int] = None
         self._round_params = None
         self._received: set = set()
-        self._weights: Dict[int, float] = {}
         self._timer = SelfMessageTimer()
         self._flushed = False
         self._c_flush = telemetry.get_registry().counter(
@@ -387,12 +394,14 @@ class EdgeAggregatorActor:
         params = msg.get(Message.ARG_MODEL_PARAMS)
         self.round_idx = round_idx
         self._received.clear()
-        self._weights.clear()
         self._flushed = False
         # the round's reference global, kept for the admission screen —
         # the edge's own handle, not a reach into stream_agg internals
         self._round_params = params
         self.stream_agg.reset(params)
+        if self.health is not None:
+            self.health.round_start(round_idx, params,
+                                    expected=sorted(self.silos))
         # the deterministic sampler replays the FLAT deployment's
         # round-cohort assignment, so silo slot g trains client ids[g-1]
         # under any topology (parity with FedAvgServerActor._broadcast)
@@ -449,6 +458,7 @@ class EdgeAggregatorActor:
         self._received.add(msg.sender_id)
         upload = msg.get(Message.ARG_MODEL_PARAMS)
         num_samples = msg.get(Message.ARG_NUM_SAMPLES)
+        upload_norm = None
         if self.admission is not None:
             verdict = self.admission.admit(
                 msg.sender_id, upload, num_samples,
@@ -457,12 +467,22 @@ class EdgeAggregatorActor:
                 logger.warning("edge %d round %s: rejecting upload from silo "
                             "%d (reason=%s)", self.node_id, self.round_idx,
                             msg.sender_id, verdict.reason)
+                if self.health is not None:
+                    self.health.observe_rejected(msg.sender_id,
+                                                 verdict.reason)
                 num_samples = None
             else:
                 num_samples = verdict.num_samples
+                upload_norm = verdict.norm
         if num_samples is not None:
+            if self.health is not None:
+                # health folds before the aggregation fold consumes the
+                # upload — the edge's block-level stats ride to the root
+                # in this round's frame
+                self.health.observe_admitted(msg.sender_id, upload,
+                                             float(num_samples),
+                                             norm=upload_norm)
             self.stream_agg.fold(upload, float(num_samples))
-            self._weights[msg.sender_id] = float(num_samples)
         if self._received >= set(self.silos):
             self._flush()
 
@@ -479,13 +499,26 @@ class EdgeAggregatorActor:
             # policy closes over this edge like any dropped silo
             logger.warning("edge %d round %s: no admissible uploads; not "
                         "reporting", self.node_id, self.round_idx)
+            if self.health is not None:
+                # still close the health round: the per-silo fairness
+                # ledger must record who never showed
+                self.health.round_end(self.round_idx)
             return
         mean = jax.tree.map(np.asarray,
                             self.stream_agg.finalize(self.round_idx))
         self._c_flush.inc()
+        extra = {}
+        if self.health is not None:
+            # close on the edge's own mean: its global_delta_norm says
+            # how far THIS block moved off the broadcast global
+            self.health.round_end(self.round_idx, new_global=mean)
+            summary = self.health.round_summary()
+            if summary is not None:
+                extra[Message.ARG_HEALTH] = summary
         self._mgr.send(
             MsgType.C2S_MODEL, self.root_id,
             **{Message.ARG_MODEL_PARAMS: mean,
-               Message.ARG_NUM_SAMPLES: float(sum(self._weights.values())),
+               Message.ARG_NUM_SAMPLES: float(self.stream_agg.weight_total),
                Message.ARG_ROUND: self.round_idx,
-               Message.ARG_EDGE_COUNT: int(self.stream_agg.count)})
+               Message.ARG_EDGE_COUNT: int(self.stream_agg.count),
+               **extra})

@@ -168,6 +168,12 @@ class Message:
     #                                  ARG_NUM_SAMPLES; this field exists
     #                                  for wire-level observability and
     #                                  tests, nothing load-bearing reads it
+    ARG_HEALTH = "health_summary"    # compact per-round learning-health
+    #                                  rollup an edge aggregator ships
+    #                                  inside its existing edge frame
+    #                                  (obs/health.compact_summary) — the
+    #                                  tree stays one-frame-per-round;
+    #                                  DIAGNOSTIC-ONLY like ARG_EDGE_COUNT
     # span context (obs/trace.py CTX_KEY): a {"t","s"} dict riding the
     # plain JSON header, so one federated round stitches into a single
     # cross-process trace

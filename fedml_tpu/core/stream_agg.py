@@ -121,6 +121,12 @@ class StreamingAggregator:
         self._acc = None                # running weighted sum (mean mode)
         self._wsum = None               # running weight total (device f32)
         self.count = 0                  # uploads folded this round
+        self.weight_total = 0.0         # host f64 fold-order weight sum:
+        #                                 readable AFTER finalize (the
+        #                                 device _wsum is donated away
+        #                                 there) — the edge frame's
+        #                                 num_samples and the health
+        #                                 observatory both read it
         self._seen = 0                  # reservoir: uploads offered
         self._res_leaves: Optional[list] = None   # [K, ...] host buffers
         self._res_def = None
@@ -188,6 +194,7 @@ class StreamingAggregator:
             self._reference = jax.tree.map(jnp.asarray, reference)
         self._acc = self._wsum = None
         self.count = 0
+        self.weight_total = 0.0
         self._seen = 0
         if self._res_weights is not None:
             self._res_weights[:] = 0.0
@@ -230,6 +237,7 @@ class StreamingAggregator:
                                  "template (treedef mismatch)")
         self._c_folds.inc()
         self.count += 1
+        self.weight_total += float(weight)
         if self.method == "mean":
             if self._acc is None:
                 self._acc = jax.tree.map(

@@ -274,7 +274,21 @@ class ExperimentConfig:
     #                                      serve deep health check, e.g.
     #                                      "round_duration_p95_seconds=10,
     #                                      serve_shed_rate=0.01" (names:
-    #                                      obs/perf.DEFAULT_SLOS)
+    #                                      obs/perf.DEFAULT_SLOS; includes
+    #                                      the health_* drift-alarm
+    #                                      thresholds of obs/health.py)
+    health: bool = False                 # federation health observatory
+    #                                      (obs/health.py): streaming
+    #                                      per-round learning-health stats
+    #                                      on the receive path — update-
+    #                                      norm Welford moments, cosine
+    #                                      alignment, per-silo fairness,
+    #                                      drift alarms, one health.jsonl
+    #                                      line per round/version
+    #                                      (cross_silo / async_fl server)
+    health_ledger: Optional[str] = None  # explicit health ledger path
+    #                                      (implies --health; default
+    #                                      run_dir/health.jsonl)
     log_stdout: bool = True
     # ---- chaos injection (comm/chaos.py over the local silo backend) ---
     # seeded per-message fault probabilities for --algo cross_silo

@@ -125,6 +125,31 @@ def _make_perf(cfg: ExperimentConfig):
                         strict_recompiles=cfg.perf_strict)
 
 
+def _make_health(cfg: ExperimentConfig, kind: str):
+    """Federation health observatory (obs/health.py) for the live actor
+    modes: streaming learning-health stats + a ``health.jsonl`` ledger
+    at ``--health_ledger`` (or ``run_dir/health.jsonl`` under
+    ``--health``).  Only the SERVER node accumulates.  Drift-alarm
+    thresholds ride the same ``--slo`` spec as every other objective
+    (health_misalignment_ratio / health_norm_cv_ratio /
+    health_starvation_ratio); non-health names in the spec are simply
+    not thresholds here."""
+    if not (cfg.health or cfg.health_ledger):
+        return None
+    if cfg.silo_backend != "local" and cfg.node_id != 0:
+        return None  # a gRPC silo has no round lifecycle to observe
+    import os
+    from fedml_tpu.obs import HealthAccumulator
+    from fedml_tpu.obs.health import HEALTH_SLOS
+    from fedml_tpu.obs.perf import parse_slo_spec
+    path = cfg.health_ledger or os.path.join(
+        cfg.metrics_dir or cfg.run_dir or ".", "health.jsonl")
+    spec = parse_slo_spec(cfg.slo) if cfg.slo else {}
+    thresholds = {k: v for k, v in spec.items() if k in HEALTH_SLOS}
+    return HealthAccumulator(kind=kind, node=f"node{cfg.node_id}",
+                             ledger_path=path, thresholds=thresholds)
+
+
 def _make_slo(cfg: ExperimentConfig):
     """SLO evaluator over the telemetry registry (obs/perf.py) backing
     the serve frontend's ``/healthz?deep=1``; ``--slo`` overrides the
@@ -705,6 +730,8 @@ def run_async_fl(cfg, data, mesh, sink):
     # async has no serve frontend, but `--slo` must still evaluate: the
     # rolling objectives ride on_version below (gauges + breach counters)
     slo = _make_slo(cfg)
+    # async deltas ARE updates: health norms/alignment read them raw
+    health = _make_health(cfg, kind="delta")
     wl = _make_workload(cfg, data)
     init, make_train_fn = _silo_training_setup(cfg, data, wl, perf=perf)
     n_silos = min(cfg.client_num_per_round, data.client_num)
@@ -741,7 +768,7 @@ def run_async_fl(cfg, data, mesh, sink):
         seed=cfg.seed, checkpointer=_make_checkpointer(cfg),
         retask_timeout_s=cfg.retask_timeout_s or None,
         admission=admission, defended_aggregate=defended,
-        stream_agg=stream, perf=perf)
+        stream_agg=stream, perf=perf, health=health)
     server.register_handlers()
     silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
                                encode_upload=delta_encoder)
@@ -789,6 +816,7 @@ def run_cross_silo(cfg, data, mesh, sink):
     # the fedml_slo_* gauges and ticks breach counters instead of
     # silently never evaluating the configured objectives
     slo = _make_slo(cfg)
+    health = _make_health(cfg, kind="params")
     wl = (_pp_workload(cfg, data) if cfg.mesh_stages > 0
           else _make_workload(cfg, data))
     init, make_train_fn = _silo_training_setup(cfg, data, wl, perf=perf)
@@ -964,7 +992,7 @@ def run_cross_silo(cfg, data, mesh, sink):
         # 503 on breach so an LB can rotate out a violating instance
         frontend = ServeFrontend(registry, batcher,
                                  port=cfg.serve_port,
-                                 slo=slo).start()
+                                 slo=slo, health=health).start()
         _sample_x = np.asarray(data.train["x"][0, 0, 0])
         _warmed = []
 
@@ -994,7 +1022,7 @@ def run_cross_silo(cfg, data, mesh, sink):
             checkpointer=_make_checkpointer(cfg),
             publish=publish, extra_state=ef_extra,
             admission=admission, aggregate_fn=defended,
-            stream_agg=stream, perf=perf)
+            stream_agg=stream, perf=perf, health=health)
         s.register_handlers()
         return s
 
@@ -1065,6 +1093,15 @@ def run_cross_silo(cfg, data, mesh, sink):
                                     cfg.strikes_to_quarantine),
                                 quarantine_rounds=cfg.quarantine_rounds,
                                 probation_rounds=cfg.probation_rounds))
+                    edge_health = None
+                    if health is not None:
+                        # per-edge statistics-only accumulator: the edge
+                        # ships its compact rollup inside its per-round
+                        # frame; the root's accumulator owns the
+                        # gauges, alarms, and the ledger
+                        from fedml_tpu.obs import HealthAccumulator
+                        edge_health = HealthAccumulator(
+                            kind="params", node=f"edge{e}", alarms=False)
                     # edge folds are plain clipped means — the robust
                     # rule and the DP noise run ONCE, at the root, over
                     # the edge means
@@ -1077,6 +1114,7 @@ def run_cross_silo(cfg, data, mesh, sink):
                             init, method="mean", kind="params",
                             norm_clip=cfg.norm_clip, seed=cfg.seed),
                         admission=edge_admission,
+                        health=edge_health,
                         # the edge must flush its partial fold BEFORE
                         # the root's round timer fires, or an on-time
                         # block is discarded with its one straggler —
@@ -1495,12 +1533,14 @@ def main(argv=None) -> Dict[str, Any]:
     # would parse and then never record/evaluate anything — an empty
     # ledger and un-evaluated objectives masquerading as a healthy run
     if cfg.algo not in ("cross_silo", "async_fl") and (
-            cfg.perf or cfg.perf_ledger or cfg.perf_strict or cfg.slo):
+            cfg.perf or cfg.perf_ledger or cfg.perf_strict or cfg.slo
+            or cfg.health or cfg.health_ledger):
         raise ValueError(
-            f"--perf/--perf_ledger/--perf_strict/--slo instrument the "
-            f"live actor modes' round lifecycle and apply to --algo "
-            f"cross_silo/async_fl only; --algo {cfg.algo} would silently "
-            f"write no ledger and never evaluate the objectives.")
+            f"--perf/--perf_ledger/--perf_strict/--slo/--health/"
+            f"--health_ledger instrument the live actor modes' round "
+            f"lifecycle and apply to --algo cross_silo/async_fl only; "
+            f"--algo {cfg.algo} would silently write no ledger and "
+            f"never evaluate the objectives.")
     # decentralized_online consumes a streaming dataset (UCI SUSY/RO or a
     # synthetic stream) that the registry doesn't serve — its runner builds
     # it; loading here would KeyError on --dataset SUSY

@@ -16,16 +16,23 @@ disabled they are a null tracer / null registry and instrumented hot
 paths pay a single branch per event.  Enable BEFORE constructing
 transports/actors — instrumented constructors cache their metric handles.
 
-Two further pillars ride on those:
+Three further pillars ride on those:
 
     fedml_tpu.obs.perf       performance flight recorder: per-round
                              perf.jsonl ledger (phase wall-times, RSS
                              watermark, recompile sentry) + SLO
                              evaluator over the telemetry registry
-    fedml_tpu.obs.trend      perf regression gate + mfu<=1.0 timing-
-                             trust lint (CLI: scripts/perf_trend.py)
+    fedml_tpu.obs.health     federation health observatory: streaming
+                             learning-health statistics on the receive
+                             path (update-norm moments, cosine
+                             alignment, per-silo fairness, drift
+                             alarms) + health.jsonl ledger
+    fedml_tpu.obs.trend      perf regression gate + health-ledger
+                             schema gate + mfu<=1.0 timing-trust lint
+                             (CLI: scripts/perf_trend.py)
 """
 
+from fedml_tpu.obs.health import HealthAccumulator
 from fedml_tpu.obs.perf import (PerfRecorder, RecompileError,
                                 RecompileSentry, RssSampler, SloEvaluator)
 from fedml_tpu.obs.telemetry import (NullRegistry, TelemetryRegistry,
@@ -34,5 +41,5 @@ from fedml_tpu.obs.trace import Span, SpanContext, SpanTracer
 
 __all__ = ["NullRegistry", "TelemetryRegistry", "start_http_server",
            "Span", "SpanContext", "SpanTracer",
-           "PerfRecorder", "RecompileError", "RecompileSentry",
-           "RssSampler", "SloEvaluator"]
+           "HealthAccumulator", "PerfRecorder", "RecompileError",
+           "RecompileSentry", "RssSampler", "SloEvaluator"]

@@ -41,6 +41,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from fedml_tpu.obs import telemetry
+from fedml_tpu.obs.health import HEALTH_SLOS
 
 log = logging.getLogger(__name__)
 
@@ -51,8 +52,8 @@ log = logging.getLogger(__name__)
 # so a defended run never compares against an undefended baseline under
 # one label)
 PHASES = ("broadcast_serialize", "straggler_wait", "staging", "fold",
-          "admission", "aggregate", "defended_aggregate", "checkpoint",
-          "publish")
+          "admission", "health", "aggregate", "defended_aggregate",
+          "checkpoint", "publish")
 
 
 # ---------------------------------------------------------------------------
@@ -421,12 +422,17 @@ def histogram_quantile(stats: dict, q: float) -> Optional[float]:
 
 
 # default objectives; override per-deployment via the ``--slo`` spec
-# ("name=value,...") or the constructor's thresholds dict
+# ("name=value,...") or the constructor's thresholds dict.  The
+# health_* objectives gate on the learning-health gauges the
+# `obs/health.HealthAccumulator` exports each round — absent gauges
+# (health off) evaluate vacuously healthy, like every other
+# traffic-free objective.
 DEFAULT_SLOS = {
     "round_duration_p95_seconds": 60.0,   # p95 round wall time
     "serve_shed_rate": 0.05,              # shed / submitted requests
     "torn_frame_rate": 0.01,              # torn frames / received msgs
     "quarantine_rate": 0.5,               # quarantine events / round
+    **HEALTH_SLOS,                        # drift alarms (obs/health.py)
 }
 
 
@@ -482,6 +488,12 @@ class SloEvaluator:
             "torn_frame_rate": reg.gauge("fedml_slo_torn_frame_ratio"),
             "quarantine_rate":
                 reg.gauge("fedml_slo_quarantine_per_round_ratio"),
+            "health_misalignment_ratio":
+                reg.gauge("fedml_slo_health_misalignment_ratio"),
+            "health_norm_cv_ratio":
+                reg.gauge("fedml_slo_health_norm_cv_ratio"),
+            "health_starvation_ratio":
+                reg.gauge("fedml_slo_health_starvation_ratio"),
         }
         self._breaches = {name: reg.counter(
             "fedml_slo_breaches_total", slo=name)
@@ -519,10 +531,20 @@ class SloEvaluator:
             counters, "fedml_robust_quarantine_events_total")
         quarantine_rate = (quarantines / rounds) if rounds else 0.0
 
+        # drift alarms: the health observatory exports these per round;
+        # an absent gauge (health off, or no round closed yet) reads as
+        # None — vacuously healthy, never a fabricated zero
+        gauges = snap.get("gauges", {})
+        health = {name: gauges.get(f"fedml_{name}")
+                  for name in ("health_misalignment_ratio",
+                               "health_norm_cv_ratio",
+                               "health_starvation_ratio")}
+
         return {"round_duration_p95_seconds": p95,
                 "serve_shed_rate": shed_rate,
                 "torn_frame_rate": torn_rate,
-                "quarantine_rate": quarantine_rate}
+                "quarantine_rate": quarantine_rate,
+                **health}
 
     def evaluate(self, count_breaches: bool = True) -> Dict[str, dict]:
         values = self._values(self._registry.snapshot())

@@ -190,6 +190,71 @@ def _perf_lines(rows: List[dict]) -> List[str]:
     return out
 
 
+# -- health ledger section ---------------------------------------------------
+
+
+def _health_lines(rows: List[dict]) -> List[str]:
+    """Per-round learning-health table from ``health.jsonl`` rows, plus
+    a per-edge rollup table when the run carried the multi-level
+    topology, plus an alarm summary line."""
+    def num(v, spec="8.4f", width=8):
+        return f"{v:{spec}}" if isinstance(v, (int, float)) \
+            else f"{'-':>{width}s}"
+
+    out = ["  " + "  ".join(
+        [f"{'round':>6s}", f"{'up':>4s}", f"{'acc':>4s}", f"{'rej':>4s}",
+         f"{'drop':>4s}", f"{'norm_mean':>10s}", f"{'norm_cv':>8s}",
+         f"{'align':>8s}", f"{'gdelta':>9s}", "alarms"])]
+    fired_total = 0
+    for r in rows:
+        norm = r.get("norm") or {}
+        align = r.get("alignment") or {}
+        alarms = r.get("alarms") or {}
+        fired = sorted(a for a, v in alarms.items() if not v.get("ok"))
+        fired_total += len(fired)
+        mean = norm.get("mean")
+        std = norm.get("std")
+        cv = (std / mean) if mean and std is not None else None
+        out.append("  " + "  ".join(
+            [f"{str(r.get('round', '?')):>6s}",
+             f"{r.get('uploads', 0):>4d}", f"{r.get('accepted', 0):>4d}",
+             f"{r.get('rejected', 0):>4d}", f"{r.get('dropped', 0):>4d}",
+             num(mean, "10.4f", 10), num(cv, "8.3f", 8),
+             num((align.get("mean")), "8.4f", 8),
+             num(r.get("global_delta_norm"), "9.4f", 9),
+             ",".join(fired) if fired else "-"]))
+    edge_rows = [r for r in rows if r.get("edges")]
+    if edge_rows:
+        out.append("  per-edge rollup (latest round with edge frames):")
+        last = edge_rows[-1]
+        out.append("  " + "  ".join(
+            [f"{'edge':>6s}", f"{'up':>4s}", f"{'acc':>4s}",
+             f"{'weight':>9s}", f"{'norm_mean':>10s}", f"{'align':>8s}",
+             f"{'gdelta':>9s}"]))
+        for e, s in sorted(last["edges"].items(),
+                           key=lambda kv: (len(kv[0]), kv[0])):
+            norm = s.get("norm") or {}
+            align = s.get("alignment") or {}
+            out.append("  " + "  ".join(
+                [f"{e:>6s}", f"{s.get('uploads', 0):>4d}",
+                 f"{s.get('accepted', 0):>4d}",
+                 num(s.get("weight"), "9.1f", 9),
+                 num(norm.get("mean"), "10.4f", 10),
+                 num(align.get("mean"), "8.4f", 8),
+                 num(s.get("global_delta_norm"), "9.4f", 9)]))
+        rollup = last.get("edge_rollup") or {}
+        if rollup.get("count"):
+            out.append(f"  edge rollup (merged moments): "
+                       f"count={rollup['count']} "
+                       f"mean={rollup['mean']:.4f} std={rollup['std']:.4f}")
+    out.append(
+        f"  {len(rows)} round(s); "
+        + (f"DRIFT ALARMS fired {fired_total} time(s) — see the alarms "
+           f"column" if fired_total
+           else "drift alarms: none fired"))
+    return out
+
+
 # -- renderer ----------------------------------------------------------------
 
 _ROUND_KEYS = ("round", "version", "step")
@@ -203,10 +268,11 @@ def _fmt(v) -> str:
 
 def render_report(run_dir: Optional[str] = None,
                   trace_dir: Optional[str] = None,
-                  perf_ledger: Optional[str] = None) -> str:
-    """``perf_ledger``: explicit ``perf.jsonl`` path for runs that wrote
-    it outside ``run_dir`` (the ``--perf_ledger`` flag); defaults to
-    ``run_dir/perf.jsonl``."""
+                  perf_ledger: Optional[str] = None,
+                  health_ledger: Optional[str] = None) -> str:
+    """``perf_ledger`` / ``health_ledger``: explicit ledger paths for
+    runs that wrote them outside ``run_dir`` (the ``--perf_ledger`` /
+    ``--health_ledger`` flags); default to ``run_dir/{perf,health}.jsonl``."""
     out: List[str] = ["=" * 64, "fedml_tpu run report", "=" * 64]
     summary = load_json(os.path.join(run_dir, "summary.json")) \
         if run_dir else None
@@ -246,6 +312,18 @@ def render_report(run_dir: Optional[str] = None,
     perf_path = perf_ledger or (os.path.join(run_dir, "perf.jsonl")
                                 if run_dir else None)
     perf_rows = load_jsonl(perf_path) if perf_path else []
+    health_path = health_ledger or (os.path.join(run_dir, "health.jsonl")
+                                    if run_dir else None)
+    health_rows = load_jsonl(health_path) if health_path else []
+
+    if run_dir and not round_rows and (perf_rows or health_rows):
+        # perf-/health-only run (no per-round metrics.jsonl rows — eval
+        # logging off or a crashed sink): say so explicitly, so the
+        # absent rounds table reads as "not recorded", never as "the
+        # run had no rounds" while the ledgers below clearly show them
+        out += ["", "(no per-round metrics.jsonl rows — perf/health-only "
+                    "run; rounds appear in the ledger sections below)"]
+
     if perf_rows:
         out += ["", "-- perf ledger (perf.jsonl, phase ms) " + "-" * 25]
         out += _perf_lines(perf_rows)
@@ -254,6 +332,13 @@ def render_report(run_dir: Optional[str] = None,
         # an instrumented run silently reporting as uninstrumented is
         # the blindness this subsystem exists to end
         out += ["", f"-- perf ledger: no rows at {perf_ledger} "
+                    f"(missing or empty)"]
+
+    if health_rows:
+        out += ["", "-- learning health (health.jsonl) " + "-" * 29]
+        out += _health_lines(health_rows)
+    elif health_ledger:
+        out += ["", f"-- health ledger: no rows at {health_ledger} "
                     f"(missing or empty)"]
 
     traces = group_round_traces(load_trace_events(trace_dir))
@@ -314,6 +399,9 @@ def main(argv=None) -> int:
     p.add_argument("--perf_ledger", default=None,
                    help="explicit perf.jsonl path for runs that wrote it "
                         "outside --run_dir (default: run_dir/perf.jsonl)")
+    p.add_argument("--health_ledger", default=None,
+                   help="explicit health.jsonl path for runs that wrote it "
+                        "outside --run_dir (default: run_dir/health.jsonl)")
     args = p.parse_args(argv)
     if args.merge_trace:
         if not args.trace_dir:
@@ -327,7 +415,8 @@ def main(argv=None) -> int:
             else:
                 print(f"merged {n} span events -> {args.merge_trace}")
     print(render_report(args.run_dir, args.trace_dir,
-                        perf_ledger=args.perf_ledger), end="")
+                        perf_ledger=args.perf_ledger,
+                        health_ledger=args.health_ledger), end="")
     return 0
 
 

@@ -16,11 +16,15 @@ Design:
   hot-path event and allocates nothing per message.
 * **naming contract** — every metric name must match
   ``fedml_[a-z0-9_]+`` and end in a unit suffix ``_total`` / ``_seconds``
-  / ``_bytes`` / ``_ratio`` (enforced at registration; linted by
-  tests/test_metric_naming.py) so dashboards never chase renames.
-  ``_ratio`` exists for non-monotonic rate gauges — Prometheus tooling
-  treats ``*_total`` as counter-by-convention, so a gauge that goes up
-  AND down must not wear it.
+  / ``_bytes`` / ``_ratio`` / ``_value`` (enforced at registration;
+  linted by tests/test_metric_naming.py) so dashboards never chase
+  renames.  ``_ratio`` exists for non-monotonic rate gauges and
+  ``_value`` for non-monotonic unitless point-in-time gauges (update
+  norms, delta norms) — Prometheus tooling treats ``*_total`` as
+  counter-by-convention, so a gauge holding a measurement that goes up
+  AND down must not wear it (count-valued state gauges like
+  ``fedml_robust_quarantined_total`` keep ``_total`` by repo
+  precedent).
 * **exposition** — ``render_prometheus()`` emits the text format; an
   optional ``start_http_server(port)`` serves it at ``/metrics`` from a
   stdlib ThreadingHTTPServer daemon thread; ``snapshot()``/``save()``
@@ -40,7 +44,8 @@ from typing import Dict, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
-NAME_RE = re.compile(r"^fedml_[a-z0-9_]+(_total|_seconds|_bytes|_ratio)$")
+NAME_RE = re.compile(
+    r"^fedml_[a-z0-9_]+(_total|_seconds|_bytes|_ratio|_value)$")
 
 # wall-clock-latency buckets (seconds); callers pass their own for
 # count-valued histograms (quorum size, staleness)

@@ -16,6 +16,10 @@ names the phase/artifact that tripped it):
   artifact, or an ``mfu_retracted`` key beside the offending cell).
   The BENCH_DETAILS mfu-1.57 retraction becomes an automatic check,
   not an archaeology finding.
+* **health ledger schema** (``--health_ledger``) — the learning-health
+  ledger (`obs/health.py`) must carry round/upload accounting, norm
+  moments, alignment, and alarm verdicts on every line; a malformed
+  ledger fails HERE, not in the reader that trusts it later.
 
 ``max_mfu`` here is the single source of truth for "largest MFU
 anywhere in an artifact" (recursive — nested scaling curves included);
@@ -126,6 +130,44 @@ def validate_ledger(rows: List[dict]) -> List[str]:
     return problems
 
 
+def validate_health_ledger(rows: List[dict]) -> List[str]:
+    """Schema check for ``health.jsonl`` (obs/health.py): every line
+    carries the round/upload accounting, the Welford norm summary, the
+    alignment summary, and the alarm verdicts — so a malformed ledger
+    fails the GATE, never the reader that trusts it later.  (Torn tails
+    are `load_ledger`'s job; edge-actor summaries riding inside frames
+    are never ledgered directly and are not validated here.)"""
+    problems = []
+    if not rows:
+        return ["health ledger is empty"]
+    for i, row in enumerate(rows):
+        for key in ("round", "uploads", "accepted", "rejected", "norm",
+                    "alignment", "alarms", "silos"):
+            if key not in row:
+                problems.append(f"line {i + 1}: missing {key!r}")
+        norm = row.get("norm")
+        if isinstance(norm, dict):
+            for key in ("count", "mean", "std", "min", "max"):
+                if key not in norm:
+                    problems.append(f"line {i + 1}: norm without {key!r}")
+        elif "norm" in row:
+            problems.append(f"line {i + 1}: norm is not a summary dict")
+        alarms = row.get("alarms")
+        if isinstance(alarms, dict):
+            for name, v in alarms.items():
+                if not isinstance(v, dict) or "ok" not in v \
+                        or "threshold" not in v:
+                    problems.append(f"line {i + 1}: alarm {name!r} without "
+                                    f"ok/threshold verdict")
+        elif "alarms" in row:
+            problems.append(f"line {i + 1}: alarms is not a verdict dict")
+        acc = row.get("accepted")
+        ups = row.get("uploads")
+        if isinstance(acc, int) and isinstance(ups, int) and acc > ups:
+            problems.append(f"line {i + 1}: accepted {acc} > uploads {ups}")
+    return problems
+
+
 def phase_medians(rows: List[dict],
                   skip_first: bool = True) -> Dict[str, float]:
     """Median per-phase seconds across the ledger (plus ``round_s``).
@@ -206,10 +248,16 @@ def main(argv=None) -> int:
                         "unretracted mfu > 1.0")
     p.add_argument("--no_recompile_gate", action="store_true",
                    help="skip the recompiles-after-round-0 gate")
+    p.add_argument("--health_ledger", default=None,
+                   help="health.jsonl to schema-validate (obs/health.py): "
+                        "a malformed health ledger fails the gate, not "
+                        "the reader that trusts it later")
     args = p.parse_args(argv)
-    if args.ledger is None and not args.lint_mfu:
+    if args.ledger is None and not args.lint_mfu \
+            and args.health_ledger is None:
         p.print_usage()
-        print("perf_trend: nothing to do (pass --ledger and/or --lint_mfu)")
+        print("perf_trend: nothing to do (pass --ledger, --health_ledger "
+              "and/or --lint_mfu)")
         return 2
 
     failures: List[str] = []
@@ -256,6 +304,21 @@ def main(argv=None) -> int:
                     print(f"phase gate: no regression vs {args.baseline} "
                           f"(band +{args.noise:.0%}, floor "
                           f"{args.min_abs_ms:.1f}ms)")
+
+    if args.health_ledger is not None:
+        try:
+            health_rows = load_ledger(args.health_ledger)
+        except (OSError, ValueError) as e:
+            print(f"perf_trend: cannot read health ledger: {e}")
+            return 2
+        problems = validate_health_ledger(health_rows)
+        failures += [f"health ledger schema: {x}" for x in problems]
+        if not problems:
+            alarms = sum(1 for r in health_rows
+                         for v in (r.get("alarms") or {}).values()
+                         if not v.get("ok"))
+            print(f"health ledger: {len(health_rows)} rounds, schema OK, "
+                  f"{alarms} alarm verdict(s) fired")
 
     if args.lint_mfu:
         paths = _expand(args.lint_mfu)

@@ -254,6 +254,17 @@ class TrustTracker:
 
 @dataclasses.dataclass
 class AdmissionVerdict:
+    """The screen's full output — callers must not recompute any of it.
+
+    ``norm`` is the f64 update norm the pipeline already paid one
+    O(model) pass for (``||upload - global||`` for params,
+    ``||delta||`` for deltas): the health observatory
+    (`obs/health.HealthAccumulator.observe_admitted`) and telemetry
+    consume it from here, so defense, health, and metrics share ONE
+    pass over the payload instead of three.  It is set on every accept
+    and on norm-outlier rejects; ``None`` means an earlier screen
+    (fingerprint / finite / sample-count) rejected before the norm was
+    ever computed."""
     ok: bool
     reason: Optional[str] = None     # one of REASONS when rejected
     num_samples: float = 0.0         # sanitized weight (valid when ok)

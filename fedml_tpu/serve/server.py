@@ -57,13 +57,21 @@ class ServeFrontend:
     batcher — in-flight requests still answer."""
 
     def __init__(self, registry: ModelRegistry, batcher: MicroBatcher,
-                 port: int = 0, host: str = "127.0.0.1", slo=None):
+                 port: int = 0, host: str = "127.0.0.1", slo=None,
+                 health=None):
         """``slo``: a `fedml_tpu.obs.perf.SloEvaluator`; when set,
         ``/healthz?deep=1`` evaluates it (deep probes without one answer
-        the shallow payload plus ``"deep": "unconfigured"``)."""
+        the shallow payload plus ``"deep": "unconfigured"``).
+
+        ``health``: a `fedml_tpu.obs.health.HealthAccumulator`; when
+        set, deep probes also carry the last round's learning-health
+        verdict (`HealthAccumulator.healthz` — round, drift alarms,
+        upload accounting) so an operator reading a 503 sees WHICH
+        alarm tripped, not just that one did."""
         self.registry = registry
         self.batcher = batcher
         self.slo = slo
+        self.health = health
         self._host = host
         self._requested_port = port
         self._server: Optional[http.server.ThreadingHTTPServer] = None
@@ -78,7 +86,8 @@ class ServeFrontend:
     def start(self) -> "ServeFrontend":
         if self._server is not None:
             return self
-        handler = _make_handler(self.registry, self.batcher, self.slo)
+        handler = _make_handler(self.registry, self.batcher, self.slo,
+                                self.health)
         self._server = http.server.ThreadingHTTPServer(
             (self._host, self._requested_port), handler)
         self._server.daemon_threads = True
@@ -100,7 +109,7 @@ class ServeFrontend:
 
 
 def _make_handler(registry: ModelRegistry, batcher: MicroBatcher,
-                  slo=None):
+                  slo=None, health=None):
     class _Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"  # keep-alive: the load generator
         # reuses connections, without this every request pays a TCP dial
@@ -140,6 +149,12 @@ def _make_handler(registry: ModelRegistry, batcher: MicroBatcher,
                     results = slo.evaluate(count_breaches=False)
                     ok = all(v["ok"] for v in results.values())
                     body["slo"] = results
+                    if health is not None:
+                        # the learning-health verdict beside the SLO
+                        # numbers: which drift alarm tripped, last round
+                        verdict = health.healthz()
+                        if verdict is not None:
+                            body["health"] = verdict
                     if not ok:
                         body["status"] = "slo_breach"
                         self._reply(503, body)

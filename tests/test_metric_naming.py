@@ -73,6 +73,21 @@ EXPECTED = {
     "fedml_stream_folds_total", "fedml_stream_evictions_total",
     "fedml_stream_reservoir_fill_total", "fedml_stream_finalize_seconds",
     "fedml_stream_edge_flush_total",
+    # PR 8: the federation health observatory (obs/health.py) + the
+    # drift-alarm SLO objectives it feeds (obs/perf.SloEvaluator)
+    "fedml_health_update_norm_mean_value",
+    "fedml_health_update_norm_max_value",
+    "fedml_health_norm_cv_ratio",
+    "fedml_health_alignment_mean_ratio",
+    "fedml_health_misalignment_ratio",
+    "fedml_health_starvation_ratio",
+    "fedml_health_starved_silos_total",
+    "fedml_health_participation_ratio",
+    "fedml_health_global_delta_norm_value",
+    "fedml_health_rounds_total", "fedml_health_breaches_total",
+    "fedml_slo_health_misalignment_ratio",
+    "fedml_slo_health_norm_cv_ratio",
+    "fedml_slo_health_starvation_ratio",
 }
 
 
@@ -109,6 +124,8 @@ def test_canonical_instrumentation_still_registered():
     ("fedml_comm_send_total", True),
     ("fedml_round_duration_seconds", True),
     ("fedml_comm_send_bytes", True),
+    ("fedml_health_global_delta_norm_value", True),
+    ("fedml_health_norm_value_", False),  # suffix must terminate the name
     ("comm_send_total", False),       # missing prefix
     ("fedml_comm_send", False),       # missing unit suffix
     ("fedml_Comm_send_total", False),  # uppercase
