@@ -49,25 +49,18 @@ import time
 
 import numpy as np
 
-# bf16 dense peak by TPU generation (public spec sheets); matched as a
-# substring of jax's device_kind.  The round-2 cohort-scaling numbers
-# exceeded the blanket v5e assumption (197) at 128 clients — the attached
-# chip's kind must be recorded, not assumed.
-_PEAK_BY_KIND = (("v6", 918.0), ("trillium", 918.0), ("v5p", 459.0),
-                 ("v5e", 197.0), ("v5lite", 197.0), ("v4", 275.0),
-                 ("v3", 123.0), ("v2", 45.0))
-
-
-def _peak_for_device(dev) -> float:
-    env = os.environ.get("BENCH_PEAK_TFLOPS")
-    if env:
-        return float(env)
-    kind = str(getattr(dev, "device_kind", "")).lower().replace(" ", "")
-    for key, peak in _PEAK_BY_KIND:
-        if key in kind:
-            return peak
-    return 197.0  # unknown accelerator: keep the v5e assumption
-
+# The bf16 peak table (matched as a substring of jax's device_kind —
+# the round-2 cohort-scaling numbers exceeded the blanket v5e assumption
+# at 128 clients, so the attached chip's kind must be recorded, not
+# assumed) and the XLA cost-analysis probe now LIVE in the device
+# observatory (fedml_tpu/obs/device.py) and are aliased here: the
+# offline bench and the live per-round fedml_dev_*/mfu gauges read ONE
+# table and ONE accounting, so they can never disagree — the same
+# drift-proofing as the _max_mfu -> trend.max_mfu delegation below
+# (tests/test_device_obs.py pins all three by identity).
+from fedml_tpu.obs.device import PEAK_TFLOPS_BY_KIND as _PEAK_BY_KIND
+from fedml_tpu.obs.device import compiled_flops as _compiled_flops
+from fedml_tpu.obs.device import peak_tflops_for_device as _peak_for_device
 
 # device-independent default (env override or v5e); main() re-resolves
 # from the attached chip's device_kind through the same parse path
@@ -86,17 +79,6 @@ def _compute_dtype():
 
 def _now():
     return time.time()
-
-
-def _compiled_flops(jitted, *args) -> float:
-    """XLA's FLOP estimate for the compiled program (0 if unavailable)."""
-    try:
-        cost = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        return float(cost.get("flops", 0.0))
-    except Exception:
-        return 0.0
 
 
 def _twin_device_ctx():

@@ -81,6 +81,12 @@ class StreamingAggregator:
     reuses it in place — O(model) steady state with zero per-fold
     allocation off-CPU; CPU backends warn-and-ignore donation, so auto
     keeps it off there (same contract as `make_defended_aggregate`).
+
+    ``device``: a `fedml_tpu.obs.device.DeviceRecorder`; when set, the
+    hot fold/finalize jits run behind the observatory's wrappers — each
+    compile lands in the round's named compile ledger and every call's
+    cost-analysis FLOPs feed the live MFU gauge.  The wrappers forward
+    ``_cache_size``, so the jit-once pin holds unchanged.
     """
 
     def __init__(self, template, *, method: str = "mean",
@@ -88,7 +94,8 @@ class StreamingAggregator:
                  noise_std: float = 0.0, seed: int = 0,
                  reservoir_k: int = 64, trim_frac: float = 0.1,
                  byz_f: int = 0, krum_m: int = 1, gm_iters: int = 8,
-                 gm_eps: float = 1e-6, donate="auto", sentry=None):
+                 gm_eps: float = 1e-6, donate="auto", sentry=None,
+                 device=None):
         from fedml_tpu.robust.defense import (ROBUST_AGG_METHODS,
                                               make_defended_aggregate)
         if method not in ROBUST_AGG_METHODS:
@@ -159,6 +166,20 @@ class StreamingAggregator:
             self._fold_fn = jax.jit(
                 _fold, donate_argnums=(0, 1) if donate else ())
             self._finalize_fn = jax.jit(_finalize)
+            if device is not None:
+                # per-arrival hot path: every fold call feeds the
+                # compile ledger + FLOPs accounting (wrapper forwards
+                # the _cache_size probe, so the jit-once pin holds).
+                # Signatures note under the SENTRY's registration name
+                # (stream_agg[...], the aggregator itself below) so a
+                # firing verdict can name the shape that changed; the
+                # mean finalize has a different arg shape and is not the
+                # sentry-monitored cache, so it feeds no signatures.
+                self._fold_fn = device.instrument(
+                    f"stream_fold[{method}]", self._fold_fn, sentry=sentry,
+                    sentry_name=f"stream_agg[{method}]")
+                self._finalize_fn = device.instrument(
+                    f"stream_finalize[{method}]", self._finalize_fn)
             self._hot_jit = self._fold_fn
         else:
             # reservoir regime: the bounded stack IS the memory bound;
@@ -169,6 +190,13 @@ class StreamingAggregator:
                 method, trim_frac=trim_frac, byz_f=byz_f, krum_m=krum_m,
                 gm_iters=gm_iters, gm_eps=gm_eps, norm_clip=norm_clip,
                 noise_std=noise_std, seed=seed, donate=donate)
+            if device is not None:
+                # the reservoir finalize IS the sentry-monitored cache
+                # (self._hot_jit): signatures land under the registered
+                # stream_agg name so its verdicts carry the diff too
+                self._finalize_fn = device.instrument(
+                    f"stream_finalize[{method}]", self._finalize_fn,
+                    sentry=sentry, sentry_name=f"stream_agg[{method}]")
             self._hot_jit = self._finalize_fn
         if sentry is not None:
             sentry.register(f"stream_agg[{method}]", self)

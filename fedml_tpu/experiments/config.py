@@ -270,13 +270,30 @@ class ExperimentConfig:
     #                                      makes a retracing hot function
     #                                      fail the run loudly (implies
     #                                      --perf)
+    device_obs: bool = False             # device & compile observatory
+    #                                      (obs/device.py): extend every
+    #                                      perf.jsonl line with a device
+    #                                      section — per-device memory
+    #                                      watermarks (memory_stats, or
+    #                                      the live-arrays CPU fallback),
+    #                                      a named compile ledger (wall
+    #                                      time per jit cache entry, and
+    #                                      recompile warnings name the
+    #                                      arg shape that changed), and
+    #                                      an honest MFU gauge from XLA
+    #                                      cost analysis (implies --perf;
+    #                                      costs one extra cost-analysis
+    #                                      compile per NEW jit cache
+    #                                      entry, off the steady path)
     slo: str = ""                        # SLO threshold overrides for the
     #                                      serve deep health check, e.g.
     #                                      "round_duration_p95_seconds=10,
     #                                      serve_shed_rate=0.01" (names:
     #                                      obs/perf.DEFAULT_SLOS; includes
     #                                      the health_* drift-alarm
-    #                                      thresholds of obs/health.py)
+    #                                      thresholds of obs/health.py
+    #                                      and the device-memory headroom
+    #                                      objective of obs/device.py)
     health: bool = False                 # federation health observatory
     #                                      (obs/health.py): streaming
     #                                      per-round learning-health stats

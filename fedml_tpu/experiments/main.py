@@ -110,19 +110,28 @@ def _make_perf(cfg: ExperimentConfig):
     ``run_dir/perf.jsonl`` under ``--perf``).  Only the SERVER node
     records — silo processes return None.  The runner owns ``close()``
     (stops the RSS sampler thread)."""
-    # perf_strict implies the recorder: a strict sentry with no recorder
-    # to own it would be the exact "flag parses then silently never
-    # enforces" condition the algo gate in main() rejects
-    if not (cfg.perf or cfg.perf_ledger or cfg.perf_strict):
+    # perf_strict/device_obs imply the recorder: a strict sentry (or a
+    # device observatory) with no recorder to own it would be the exact
+    # "flag parses then silently never enforces" condition the algo gate
+    # in main() rejects
+    if not (cfg.perf or cfg.perf_ledger or cfg.perf_strict
+            or cfg.device_obs):
         return None
     if cfg.silo_backend != "local" and cfg.node_id != 0:
         return None  # a gRPC silo has no round lifecycle to ledger
     import os
     from fedml_tpu.obs import PerfRecorder
+    device = None
+    if cfg.device_obs:
+        # device & compile observatory (obs/device.py): every ledger
+        # line gains a device section; the hot jits built below wrap
+        # through PerfRecorder.instrument_jit / the device= seams
+        from fedml_tpu.obs import DeviceRecorder
+        device = DeviceRecorder()
     path = cfg.perf_ledger or os.path.join(
         cfg.metrics_dir or cfg.run_dir or ".", "perf.jsonl")
     return PerfRecorder(path, node=f"node{cfg.node_id}",
-                        strict_recompiles=cfg.perf_strict)
+                        strict_recompiles=cfg.perf_strict, device=device)
 
 
 def _make_health(cfg: ExperimentConfig, kind: str):
@@ -554,15 +563,19 @@ def _silo_training_setup(cfg, data, wl, perf=None):
                                              make_local_trainer)
     from fedml_tpu.trainer.workload import make_client_optimizer
 
-    # instrument_train_fn is the identity when telemetry is disabled
-    local = instrument_train_fn(jax.jit(make_local_trainer(
+    jitted = jax.jit(make_local_trainer(
         wl, make_client_optimizer(cfg.client_optimizer, cfg.lr, cfg.wd),
-        cfg.epochs)), epochs=cfg.epochs)
+        cfg.epochs))
     if perf is not None:
         # flight recorder: the local trainer jit is a registered hot
-        # function — the sentry counts any round that grows its cache
-        # (instrument_train_fn forwards the jit's _cache_size probe)
-        perf.register_jit("train_fn", local)
+        # function — the sentry counts any round that grows its cache,
+        # and under --device_obs instrument_jit wraps it so each compile
+        # lands in the named compile ledger (wall time + arg signature)
+        # and its cost-analysis FLOPs feed the live MFU gauge
+        jitted = perf.instrument_jit("train_fn", jitted)
+    # instrument_train_fn is the identity when telemetry is disabled;
+    # it composes OUTSIDE the device wrapper (both forward _cache_size)
+    local = instrument_train_fn(jitted, epochs=cfg.epochs)
     import threading
     _chain = {"next_round": 0,
               "rng": jax.random.split(jax.random.key(cfg.seed))[0]}
@@ -605,7 +618,8 @@ def _silo_training_setup(cfg, data, wl, perf=None):
     return wl.init(init_rng, sample), make_train_fn
 
 
-def _robust_setup(cfg: ExperimentConfig, template, kind: str, sentry=None):
+def _robust_setup(cfg: ExperimentConfig, template, kind: str, sentry=None,
+                  device=None):
     """Payload-defense wiring shared by the sync and async actor modes
     (fedml_tpu/robust): the admission pipeline (``--admission`` — 'auto'
     arms it whenever any defense flag is set) and the aggregation
@@ -617,7 +631,10 @@ def _robust_setup(cfg: ExperimentConfig, template, kind: str, sentry=None):
     (``stream_agg``, ALWAYS set — plain mean streams too; that is the
     O(model)-memory point), and ``defended_aggregate`` stays None.
     ``sentry``: the flight recorder's RecompileSentry — the hot
-    aggregation jit registers so a retracing round is counted/failed."""
+    aggregation jit registers so a retracing round is counted/failed.
+    ``device``: the flight recorder's DeviceRecorder (--device_obs) —
+    the hot aggregation jits wrap through its compile-ledger/FLOPs
+    instrumentation."""
     if cfg.admission not in ("auto", "on", "off"):
         raise ValueError(f"--admission must be auto|on|off, "
                          f"got {cfg.admission!r}")
@@ -651,7 +668,8 @@ def _robust_setup(cfg: ExperimentConfig, template, kind: str, sentry=None):
             norm_clip=cfg.norm_clip, noise_std=cfg.agg_noise_std,
             seed=cfg.seed, reservoir_k=cfg.stream_reservoir,
             trim_frac=cfg.trim_frac, byz_f=cfg.byz_f, krum_m=cfg.krum_m,
-            gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps, sentry=sentry)
+            gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps, sentry=sentry,
+            device=device)
         return admission, None, stream
     if robust_on:
         from fedml_tpu.robust import make_defended_aggregate
@@ -659,7 +677,7 @@ def _robust_setup(cfg: ExperimentConfig, template, kind: str, sentry=None):
             cfg.robust_agg, trim_frac=cfg.trim_frac, byz_f=cfg.byz_f,
             krum_m=cfg.krum_m, gm_iters=cfg.gm_iters, gm_eps=cfg.gm_eps,
             norm_clip=cfg.norm_clip, noise_std=cfg.agg_noise_std,
-            seed=cfg.seed, sentry=sentry)
+            seed=cfg.seed, sentry=sentry, device=device)
     return admission, defended, None
 
 
@@ -745,7 +763,8 @@ def run_async_fl(cfg, data, mesh, sink):
     # against the params template (same treedef/shapes/dtypes) and
     # screens the raw delta norm
     admission, defended, stream = _robust_setup(
-        cfg, init, kind="delta", sentry=perf.sentry if perf else None)
+        cfg, init, kind="delta", sentry=perf.sentry if perf else None,
+        device=perf.device if perf else None)
 
     history = []
 
@@ -824,7 +843,8 @@ def run_cross_silo(cfg, data, mesh, sink):
     timeout = cfg.round_timeout_s or None
     make_train_fn = _adversary_train_fns(cfg, data, make_train_fn, n_silos)
     admission, defended, stream = _robust_setup(
-        cfg, init, kind="params", sentry=perf.sentry if perf else None)
+        cfg, init, kind="params", sentry=perf.sentry if perf else None,
+        device=perf.device if perf else None)
 
     # multi-level aggregator topology (--edge_aggregators E): E edge
     # actors sit between the silos and the root, each folding its block
@@ -1534,12 +1554,12 @@ def main(argv=None) -> Dict[str, Any]:
     # ledger and un-evaluated objectives masquerading as a healthy run
     if cfg.algo not in ("cross_silo", "async_fl") and (
             cfg.perf or cfg.perf_ledger or cfg.perf_strict or cfg.slo
-            or cfg.health or cfg.health_ledger):
+            or cfg.device_obs or cfg.health or cfg.health_ledger):
         raise ValueError(
-            f"--perf/--perf_ledger/--perf_strict/--slo/--health/"
-            f"--health_ledger instrument the live actor modes' round "
-            f"lifecycle and apply to --algo cross_silo/async_fl only; "
-            f"--algo {cfg.algo} would silently write no ledger and "
+            f"--perf/--perf_ledger/--perf_strict/--device_obs/--slo/"
+            f"--health/--health_ledger instrument the live actor modes' "
+            f"round lifecycle and apply to --algo cross_silo/async_fl "
+            f"only; --algo {cfg.algo} would silently write no ledger and "
             f"never evaluate the objectives.")
     # decentralized_online consumes a streaming dataset (UCI SUSY/RO or a
     # synthetic stream) that the registry doesn't serve — its runner builds

@@ -16,22 +16,30 @@ disabled they are a null tracer / null registry and instrumented hot
 paths pay a single branch per event.  Enable BEFORE constructing
 transports/actors — instrumented constructors cache their metric handles.
 
-Three further pillars ride on those:
+Four further pillars ride on those:
 
     fedml_tpu.obs.perf       performance flight recorder: per-round
                              perf.jsonl ledger (phase wall-times, RSS
                              watermark, recompile sentry) + SLO
                              evaluator over the telemetry registry
+    fedml_tpu.obs.device     device & compile observatory: per-device
+                             memory watermarks, named compile ledger
+                             (wall time per jit cache entry), achieved
+                             FLOP/s + honest MFU from XLA cost
+                             analysis — rides the PerfRecorder round
+                             cadence as each line's ``device`` section
     fedml_tpu.obs.health     federation health observatory: streaming
                              learning-health statistics on the receive
                              path (update-norm moments, cosine
                              alignment, per-silo fairness, drift
                              alarms) + health.jsonl ledger
-    fedml_tpu.obs.trend      perf regression gate + health-ledger
+    fedml_tpu.obs.trend      perf regression gate (phases + device
+                             compile-time/memory) + health-ledger
                              schema gate + mfu<=1.0 timing-trust lint
                              (CLI: scripts/perf_trend.py)
 """
 
+from fedml_tpu.obs.device import DeviceRecorder
 from fedml_tpu.obs.health import HealthAccumulator
 from fedml_tpu.obs.perf import (PerfRecorder, RecompileError,
                                 RecompileSentry, RssSampler, SloEvaluator)
@@ -41,5 +49,6 @@ from fedml_tpu.obs.trace import Span, SpanContext, SpanTracer
 
 __all__ = ["NullRegistry", "TelemetryRegistry", "start_http_server",
            "Span", "SpanContext", "SpanTracer",
-           "HealthAccumulator", "PerfRecorder", "RecompileError",
-           "RecompileSentry", "RssSampler", "SloEvaluator"]
+           "DeviceRecorder", "HealthAccumulator", "PerfRecorder",
+           "RecompileError", "RecompileSentry", "RssSampler",
+           "SloEvaluator"]

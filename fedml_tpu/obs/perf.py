@@ -25,9 +25,17 @@ Three instruments, stdlib-only like the rest of `obs/`:
 
 `SloEvaluator` sits on top of the telemetry registry: rolling SLO
 values (round-duration p95, serve shed rate, torn-frame rate,
-quarantine events per round) exported as ``fedml_slo_*`` gauges with a
-per-SLO breach counter; it backs the serve frontend's
-``/healthz?deep=1`` mode (200 while every SLO holds, 503 on breach).
+quarantine events per round, device-memory headroom) exported as
+``fedml_slo_*`` gauges with a per-SLO breach counter; it backs the
+serve frontend's ``/healthz?deep=1`` mode (200 while every SLO holds,
+503 on breach).
+
+A `fedml_tpu.obs.device.DeviceRecorder` attaches via ``device=``: each
+ledger line then carries a ``device`` section (per-device memory
+watermarks, the round's named compile ledger, achieved-FLOP/s and an
+honest MFU) and the sentry's recompile verdicts name the arg
+shape/dtype that changed.  Ledgers without the section keep validating
+— the device observatory is additive.
 """
 
 from __future__ import annotations
@@ -150,12 +158,22 @@ class RecompileSentry:
     compiles are expected); later checks count any GROWTH as recompiles:
     ``fedml_perf_recompiles_total`` ticks, production warns, ``strict``
     raises `RecompileError`.  A shrunk cache (explicit clear) re-baselines
-    silently."""
+    silently.
+
+    When the device observatory wraps a registered function
+    (`obs.device.DeviceRecorder.instrument`), every call's arg
+    shape/dtype signature lands here via ``note_signature`` — a firing
+    verdict then NAMES the arg that changed instead of reporting a bare
+    count, turning "something retraced" into an actionable diff."""
 
     def __init__(self, strict: bool = False, registry=None):
         self.strict = strict
         self._fns: Dict[str, Callable] = {}
         self._baseline: Dict[str, int] = {}
+        # last two DISTINCT call signatures per fn (note_signature): the
+        # observable projection of the jit cache key the verdict diffs
+        self._sig_cur: Dict[str, tuple] = {}
+        self._sig_prev: Dict[str, tuple] = {}
         reg = registry if registry is not None else telemetry.get_registry()
         self._c_recompiles = reg.counter("fedml_perf_recompiles_total")
 
@@ -168,6 +186,25 @@ class RecompileSentry:
             return False
         self._fns[name] = fn
         return True
+
+    def note_signature(self, name: str, sig) -> None:
+        """Record a registered fn's latest call signature (fed by the
+        device observatory's wrappers).  Only the last two distinct
+        signatures are kept — exactly what a recompile diff needs."""
+        sig = tuple(sig)
+        cur = self._sig_cur.get(name)
+        if cur is not None and cur != sig:
+            self._sig_prev[name] = cur
+        self._sig_cur[name] = sig
+
+    def signature_change(self, name: str) -> str:
+        """The prev -> cur call-signature diff for ``name`` ("" when no
+        change was observed or signatures were never fed)."""
+        prev, cur = self._sig_prev.get(name), self._sig_cur.get(name)
+        if prev is None or cur is None or prev == cur:
+            return ""
+        from fedml_tpu.obs.device import signature_diff
+        return signature_diff(prev, cur)
 
     def names(self):
         return sorted(self._fns)
@@ -198,7 +235,19 @@ class RecompileSentry:
         total = sum(events.values())
         if total:
             self._c_recompiles.inc(total)
-            detail = ", ".join(f"{k}:+{v}" for k, v in sorted(events.items()))
+            parts = []
+            for k, v in sorted(events.items()):
+                part = f"{k}:+{v}"
+                diff = self.signature_change(k)
+                if diff:
+                    part += f" [{diff}]"
+                # consume the diff: it explains THIS verdict only — a
+                # later same-signature recompile (the numpy-vs-jax
+                # double-compile class) must not be decorated with a
+                # stale, unrelated shape change
+                self._sig_prev.pop(k, None)
+                parts.append(part)
+            detail = ", ".join(parts)
             msg = (f"recompile sentry: round {round_idx}: {total} new jit "
                    f"cache entr{'y' if total == 1 else 'ies'} after the "
                    f"baseline round ({detail}) — a hot function is "
@@ -256,8 +305,13 @@ class PerfRecorder:
 
     def __init__(self, path: str, node: str = "server",
                  rss_interval_s: float = 0.05, strict_recompiles: bool = False,
-                 registry=None, node_index: int = 0):
+                 registry=None, node_index: int = 0, device=None):
         self.path = path
+        # optional device & compile observatory (obs/device.DeviceRecorder):
+        # when attached, every ledger line gains a ``device`` section —
+        # per-device memory watermarks, the round's named compile ledger,
+        # and the honest MFU gauge (readers without it keep validating)
+        self.device = device
         self.node = node
         self.node_index = node_index  # wire-byte direction split anchor
         d = os.path.dirname(path)
@@ -290,6 +344,17 @@ class PerfRecorder:
         """Register a hot function with the recompile sentry."""
         return self.sentry.register(name, fn)
 
+    def instrument_jit(self, name: str, fn):
+        """Register ``fn`` with the recompile sentry AND — when the
+        device observatory is attached — wrap it with compile-ledger +
+        FLOPs instrumentation.  Returns the callable the caller should
+        use in ``fn``'s place (``fn`` itself when no device recorder is
+        on; the wrapper forwards the ``_cache_size`` probe either way)."""
+        self.sentry.register(name, fn)
+        if self.device is not None:
+            fn = self.device.instrument(name, fn, sentry=self.sentry)
+        return fn
+
     # -- wire accounting -----------------------------------------------------
     def _wire_totals(self):
         counters = self._registry.snapshot().get("counters", {})
@@ -317,6 +382,8 @@ class PerfRecorder:
         self._round_t0 = time.perf_counter()
         self.rss.reset_peak()
         self._wire0 = self._wire_totals()
+        if self.device is not None:
+            self.device.round_start()
 
     def phase(self, name: str) -> _PhaseTimer:
         """Context manager accumulating wall time into the current
@@ -365,6 +432,8 @@ class PerfRecorder:
         }
         if recompile_events:
             line["recompiled"] = recompile_events
+        if self.device is not None:
+            line["device"] = self.device.round_snapshot(round_s)
         line.update(extra)
         self._write(line)
         self._c_rounds.inc()
@@ -432,6 +501,13 @@ DEFAULT_SLOS = {
     "serve_shed_rate": 0.05,              # shed / submitted requests
     "torn_frame_rate": 0.01,              # torn frames / received msgs
     "quarantine_rate": 0.5,               # quarantine events / round
+    # device-memory headroom (obs/device.py): worst per-device
+    # bytes_in_use / bytes_limit the observatory exported last round —
+    # breach means the next cohort/model growth OOMs the chip, the exact
+    # signal ROADMAP items 1/3 gate on.  Backends without allocator
+    # limits (CPU live-arrays fallback) never export the gauge, so the
+    # objective evaluates vacuously there.
+    "device_mem_utilization_ratio": 0.92,
     **HEALTH_SLOS,                        # drift alarms (obs/health.py)
 }
 
@@ -494,6 +570,8 @@ class SloEvaluator:
                 reg.gauge("fedml_slo_health_norm_cv_ratio"),
             "health_starvation_ratio":
                 reg.gauge("fedml_slo_health_starvation_ratio"),
+            "device_mem_utilization_ratio":
+                reg.gauge("fedml_slo_device_mem_utilization_ratio"),
         }
         self._breaches = {name: reg.counter(
             "fedml_slo_breaches_total", slo=name)
@@ -544,6 +622,12 @@ class SloEvaluator:
                 "serve_shed_rate": shed_rate,
                 "torn_frame_rate": torn_rate,
                 "quarantine_rate": quarantine_rate,
+                # device observatory: worst-device memory utilization
+                # (absent gauge — device obs off, or a backend without
+                # allocator limits — reads None: vacuously healthy,
+                # never a fabricated zero)
+                "device_mem_utilization_ratio":
+                    gauges.get("fedml_dev_mem_utilization_ratio"),
                 **health}
 
     def evaluate(self, count_breaches: bool = True) -> Dict[str, dict]:

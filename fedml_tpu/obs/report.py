@@ -190,6 +190,64 @@ def _perf_lines(rows: List[dict]) -> List[str]:
     return out
 
 
+# -- device observatory section ----------------------------------------------
+
+
+def _device_lines(rows: List[dict]) -> List[str]:
+    """Per-round device table from the perf ledger's ``device`` sections
+    (obs/device.py): memory in-use/watermark (summed across devices),
+    compile-ledger entries, achieved FLOP/s and MFU — plus a summary
+    naming every compile with its wall time.  Rounds without a device
+    section render '-' (the observatory is additive)."""
+    def mb(v):
+        return f"{v / 2 ** 20:10.1f}" if v is not None else f"{'-':>10s}"
+
+    out = ["  " + "  ".join(
+        [f"{'round':>6s}", f"{'mem_mb':>10s}", f"{'mem_peak_mb':>11s}",
+         f"{'devs':>4s}", f"{'compiles':>8s}", f"{'compile_ms':>10s}",
+         f"{'mfu':>9s}"])]
+    all_compiles: List[dict] = []
+    backend = None
+    sources = set()
+    for r in rows:
+        dev = r.get("device")
+        if not isinstance(dev, dict):
+            continue
+        backend = dev.get("backend") or backend
+        mem = dev.get("memory") or []
+        in_use = [e.get("bytes_in_use") for e in mem]
+        in_use = [b for b in in_use if b is not None]
+        peaks = [e.get("round_peak_bytes") or e.get("peak_bytes")
+                 or e.get("bytes_in_use") for e in mem]
+        peaks = [b for b in peaks if b is not None]
+        sources.update(e.get("source") for e in mem if e.get("source"))
+        comps = dev.get("compiles") or []
+        all_compiles.extend(comps)
+        compile_s = sum(float(e.get("wall_s") or 0.0) for e in comps)
+        mfu = dev.get("mfu")
+        out.append("  " + "  ".join(
+            [f"{str(r.get('round', '?')):>6s}",
+             mb(sum(in_use) if in_use else None),
+             mb(max(peaks) if peaks else None)[:11].rjust(11),
+             f"{len(mem) if mem else 0:>4d}",
+             f"{len(comps):>8d}",
+             f"{compile_s * 1e3:10.1f}" if comps else f"{'-':>10s}",
+             f"{mfu:9.2e}" if isinstance(mfu, (int, float))
+             else f"{'-':>9s}"]))
+    head = f"  backend {backend or '?'}"
+    if sources:
+        head += f"; memory via {'/'.join(sorted(sources))}"
+    head += (f"; {len(all_compiles)} compile(s) totalling "
+             f"{sum(float(e.get('wall_s') or 0.0) for e in all_compiles) * 1e3:.1f}ms"
+             if all_compiles else "; no compiles ledgered")
+    out.append(head)
+    for e in all_compiles:
+        out.append(f"    compile {e.get('fn', '?'):<28s} "
+                   f"{float(e.get('wall_s') or 0.0) * 1e3:8.1f}ms  "
+                   f"{e.get('signature', '')[:48]}")
+    return out
+
+
 # -- health ledger section ---------------------------------------------------
 
 
@@ -327,6 +385,10 @@ def render_report(run_dir: Optional[str] = None,
     if perf_rows:
         out += ["", "-- perf ledger (perf.jsonl, phase ms) " + "-" * 25]
         out += _perf_lines(perf_rows)
+        if any(isinstance(r.get("device"), dict) for r in perf_rows):
+            out += ["", "-- device observatory (perf.jsonl device "
+                        "section) " + "-" * 17]
+            out += _device_lines(perf_rows)
     elif perf_ledger:
         # an EXPLICITLY named ledger that renders nothing must say so —
         # an instrumented run silently reporting as uninstrumented is

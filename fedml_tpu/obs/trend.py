@@ -11,6 +11,12 @@ names the phase/artifact that tripped it):
 * **recompile gate** — any ledger round after the first with
   ``recompiles > 0`` fails: the flight recorder's sentry counted a hot
   function retracing (the PR 5 double-compile class).
+* **device gates** — when both ledgers carry the device observatory's
+  ``device`` sections (obs/device.py), total hot-jit compile time and
+  the per-device memory watermark each gate against the baseline
+  (relative band + absolute floor, round 0 in scope — compile cost
+  lives there).  Pre-device-observatory ledgers compare vacuously, so
+  old artifacts never fail the new gate.
 * **mfu lint** — every mfu value in every given JSON artifact must be
   ≤ 1.0 *or explicitly retracted* (a ``timing_untrusted`` mark on the
   artifact, or an ``mfu_retracted`` key beside the offending cell).
@@ -116,7 +122,13 @@ def load_ledger(path: str) -> List[dict]:
 
 def validate_ledger(rows: List[dict]) -> List[str]:
     """Schema check: every line carries round/phases/recompiles (and an
-    RSS watermark where the platform provides one)."""
+    RSS watermark where the platform provides one).  The ``device``
+    section (obs/device.py) is OPTIONAL — pre-device-observatory ledgers
+    keep validating — but where present it must be well-formed: memory
+    is a per-device list or null (never a fabricated placeholder),
+    compile entries name their fn and wall time, and an mfu above 1.0
+    is a schema failure (physically impossible — the timing-trust
+    contract applies to the live ledger exactly as to BENCH artifacts)."""
     problems = []
     if not rows:
         return ["ledger is empty"]
@@ -127,6 +139,41 @@ def validate_ledger(rows: List[dict]) -> List[str]:
         if "rss" in row and row["rss"] is not None \
                 and "peak_bytes" not in row["rss"]:
             problems.append(f"line {i + 1}: rss without peak_bytes")
+        if "device" in row and row["device"] is not None:
+            problems += _validate_device_section(row["device"], i + 1)
+    return problems
+
+
+def _validate_device_section(dev, line_no: int) -> List[str]:
+    problems = []
+    if not isinstance(dev, dict):
+        return [f"line {line_no}: device is not a section dict"]
+    mem = dev.get("memory")
+    if mem is not None:
+        if not isinstance(mem, list) or not mem:
+            problems.append(f"line {line_no}: device memory must be a "
+                            f"non-empty per-device list or null")
+        else:
+            for e in mem:
+                if not isinstance(e, dict) or "bytes_in_use" not in e \
+                        or "source" not in e:
+                    problems.append(f"line {line_no}: device memory entry "
+                                    f"without bytes_in_use/source")
+                    break
+    comps = dev.get("compiles")
+    if not isinstance(comps, list):
+        problems.append(f"line {line_no}: device without a compiles list")
+    else:
+        for e in comps:
+            if not isinstance(e, dict) or "fn" not in e or "wall_s" not in e:
+                problems.append(f"line {line_no}: compile entry without "
+                                f"fn/wall_s")
+                break
+    mfu = dev.get("mfu")
+    if isinstance(mfu, (int, float)) and mfu > 1.0:
+        problems.append(f"line {line_no}: device mfu {mfu:.3g} > 1.0 — "
+                        f"physically impossible (timing or peak-table "
+                        f"failure, not performance)")
     return problems
 
 
@@ -195,6 +242,69 @@ def check_recompiles(rows: List[dict]) -> List[str]:
             for row in rows[1:] if row.get("recompiles")]
 
 
+def device_compile_seconds(rows: List[dict]) -> Optional[float]:
+    """Total registered-hot-jit compile wall seconds across the ledger
+    (round 0 INCLUDED — compile cost lives there, so the device gate
+    must not skip it the way phase medians do).  None when no line
+    carries a device section (pre-device-observatory ledger)."""
+    total, seen = 0.0, False
+    for row in rows:
+        dev = row.get("device")
+        if not isinstance(dev, dict):
+            continue
+        seen = True
+        for e in dev.get("compiles") or []:
+            try:
+                total += float(e.get("wall_s") or 0.0)
+            except (TypeError, ValueError):
+                continue
+    return total if seen else None
+
+
+def device_mem_peak_bytes(rows: List[dict]) -> Optional[int]:
+    """Largest per-device memory watermark anywhere in the ledger
+    (round peak preferred, falling back to backend-lifetime peak, then
+    the in-use sample).  None when no line measured device memory."""
+    peak = None
+    for row in rows:
+        dev = row.get("device")
+        if not isinstance(dev, dict):
+            continue
+        for e in dev.get("memory") or []:
+            for key in ("round_peak_bytes", "peak_bytes", "bytes_in_use"):
+                v = e.get(key)
+                if v is not None:
+                    peak = max(peak or 0, int(v))
+                    break
+    return peak
+
+
+def compare_device(current: List[dict], baseline: List[dict],
+                   noise_frac: float = 0.25,
+                   min_abs_compile_s: float = 0.05,
+                   min_abs_mem_bytes: int = 16 << 20) -> List[str]:
+    """Device-layer regressions of ``current`` vs ``baseline``: total
+    hot-jit compile time and the device-memory watermark, each gated by
+    BOTH a relative band and an absolute floor (the phase-gate
+    discipline).  Ledgers without device sections on either side
+    compare vacuously — old ledgers never fail the new gate."""
+    out: List[str] = []
+    cc, cb = device_compile_seconds(current), device_compile_seconds(baseline)
+    if cc is not None and cb is not None \
+            and cc > cb * (1.0 + noise_frac) and (cc - cb) > min_abs_compile_s:
+        ratio = (cc / cb) if cb else float("inf")
+        out.append(f"device compile regression: total hot-jit compile "
+                   f"{cb * 1e3:.1f}ms -> {cc * 1e3:.1f}ms ({ratio:.2f}x)")
+    mc, mb = device_mem_peak_bytes(current), device_mem_peak_bytes(baseline)
+    if mc is not None and mb is not None \
+            and mc > mb * (1.0 + noise_frac) and (mc - mb) > min_abs_mem_bytes:
+        ratio = (mc / mb) if mb else float("inf")
+        out.append(f"device memory regression: watermark "
+                   f"{mb / 2 ** 20:.1f}MiB -> {mc / 2 ** 20:.1f}MiB "
+                   f"({ratio:.2f}x)")
+    return out
+
+
 def compare_ledgers(current: List[dict], baseline: List[dict],
                     noise_frac: float = 0.25,
                     min_abs_s: float = 0.005) -> List[dict]:
@@ -248,6 +358,15 @@ def main(argv=None) -> int:
                         "unretracted mfu > 1.0")
     p.add_argument("--no_recompile_gate", action="store_true",
                    help="skip the recompiles-after-round-0 gate")
+    p.add_argument("--no_device_gate", action="store_true",
+                   help="skip the device compile-time/memory gates "
+                        "(obs/device.py sections)")
+    p.add_argument("--min_abs_compile_ms", type=float, default=50.0,
+                   help="absolute floor (ms) a total-compile-time "
+                        "regression must also exceed")
+    p.add_argument("--min_abs_mem_mb", type=float, default=16.0,
+                   help="absolute floor (MiB) a device-memory watermark "
+                        "regression must also exceed")
     p.add_argument("--health_ledger", default=None,
                    help="health.jsonl to schema-validate (obs/health.py): "
                         "a malformed health ledger fails the gate, not "
@@ -304,6 +423,27 @@ def main(argv=None) -> int:
                     print(f"phase gate: no regression vs {args.baseline} "
                           f"(band +{args.noise:.0%}, floor "
                           f"{args.min_abs_ms:.1f}ms)")
+            if not args.no_device_gate:
+                # device gate (compile time + memory watermark): round 0
+                # is in scope — compile cost lives there — so this runs
+                # even on a one-round smoke.  Pre-device-observatory
+                # ledgers on either side compare vacuously.
+                if device_compile_seconds(rows) is None \
+                        or device_compile_seconds(base) is None:
+                    print("device gate: ledger(s) carry no device "
+                          "section — skipped (pre-device-observatory "
+                          "ledger, or --device_obs off)")
+                else:
+                    dev_regressions = compare_device(
+                        rows, base, noise_frac=args.noise,
+                        min_abs_compile_s=args.min_abs_compile_ms / 1e3,
+                        min_abs_mem_bytes=int(args.min_abs_mem_mb
+                                              * 2 ** 20))
+                    failures += dev_regressions
+                    if not dev_regressions:
+                        print(f"device gate: no compile-time or "
+                              f"device-memory regression vs "
+                              f"{args.baseline} (band +{args.noise:.0%})")
 
     if args.health_ledger is not None:
         try:

@@ -43,7 +43,7 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
                             gm_iters: int = 8, gm_eps: float = 1e-6,
                             norm_clip: float = 0.0, noise_std: float = 0.0,
                             seed: int = 0, donate="auto",
-                            sentry=None) -> Callable:
+                            sentry=None, device=None) -> Callable:
     """Build the jitted ``fn(global_params, stacked, weights, step) ->
     new_params`` the server actors call once per round/version.
 
@@ -71,6 +71,14 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
     under strict mode fails) any round that grows its cache — the
     ``_cache_size() == 1`` acceptance criterion, enforced live instead
     of only in tests.
+
+    ``device``: a `fedml_tpu.obs.device.DeviceRecorder`; when set, the
+    returned callable is the observatory's wrapper — each compile lands
+    in the round's named compile ledger with its wall time and arg
+    signature, every call's cost-analysis FLOPs feed the live MFU
+    gauge, and the sentry's recompile verdicts can name the arg
+    shape/dtype that changed.  The wrapper forwards ``_cache_size``, so
+    the jit-once pin holds with it on or off.
     """
     if method not in ROBUST_AGG_METHODS:
         raise ValueError(f"unknown robust aggregation method {method!r}; "
@@ -136,4 +144,7 @@ def make_defended_aggregate(method: str = "mean", *, trim_frac: float = 0.1,
     fn = jax.jit(_aggregate, donate_argnums=(1,) if donate else ())
     if sentry is not None:
         sentry.register(f"defended_aggregate[{method}]", fn)
+    if device is not None:
+        fn = device.instrument(f"defended_aggregate[{method}]", fn,
+                               sentry=sentry)
     return fn

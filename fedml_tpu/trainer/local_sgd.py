@@ -47,6 +47,11 @@ def instrument_train_fn(train_fn, epochs: int = 1, registry=None):
     The wrapper forwards the underlying jit's ``_cache_size`` probe, so
     the flight recorder's `RecompileSentry` (obs/perf.py) can register
     the instrumented function directly and catch a retracing trainer.
+    Under ``--device_obs`` the device observatory's wrapper
+    (`obs.device.DeviceRecorder.instrument`, applied via
+    ``PerfRecorder.instrument_jit``) composes INSIDE this one — it sees
+    raw calls for compile/FLOPs accounting while this wrapper keeps the
+    blocked-wall-time trainer histograms; both forward the probe.
 
     With telemetry disabled this returns ``train_fn`` unchanged — zero
     wrapper, zero cost."""

@@ -10,7 +10,13 @@
 #   * an obs_report per-round timeline,
 #   * a perf.jsonl flight-recorder ledger (ISSUE 6) that passes the
 #     perf_trend gate honestly and FAILS it on a seeded regression,
-#     with the mfu<=1.0 lint green over every committed BENCH artifact.
+#     with the mfu<=1.0 lint green over every committed BENCH artifact,
+#   * a device & compile observatory section on every ledger line
+#     (ISSUE 10): per-device memory watermarks, a NAMED compile ledger
+#     with wall times, and an honest MFU <= 1.0 whose FLOPs/peak
+#     provably come from the same table bench.py uses — plus a forced
+#     recompile whose sentry verdict names the exact arg shape change,
+#     and a seeded compile-time regression failing the trend gate.
 #
 # Usage: scripts/run_obs_demo.sh [workdir]  (default: a fresh mktemp dir)
 set -euo pipefail
@@ -29,7 +35,7 @@ env JAX_PLATFORMS=cpu python -m fedml_tpu \
     --chaos_reorder 0.1 --chaos_seed 7 \
     --heartbeat_s 0.2 --dead_after_s 5 \
     --run_dir "$RUN" --trace_dir "$TRACE" --telemetry true \
-    --perf true --perf_strict true
+    --perf true --perf_strict true --device_obs true
 
 REPORT="$DIR/report.txt"
 env JAX_PLATFORMS=cpu python scripts/obs_report.py \
@@ -88,6 +94,84 @@ fi
 grep -q "phase regression" "$DIR/trend_fail.txt"
 echo "trend gate OK: honest ledger passes, seeded regression fails"
 
+echo "== asserting the device & compile observatory (ISSUE 10)"
+# every ledger line carries a device section: per-device memory
+# watermarks (CPU-honest live_arrays source here), at least one NAMED
+# compile-ledger entry with wall time, and an MFU <= 1.0 whose peak
+# provably comes from the SAME table bench.py delegates to
+env JAX_PLATFORMS=cpu python - "$RUN/perf.jsonl" <<'EOF'
+import json, sys
+import bench
+from fedml_tpu.obs.device import (MFU_PROVENANCE, compiled_flops,
+                                  peak_tflops_for_device)
+assert bench._peak_for_device is peak_tflops_for_device
+assert bench._compiled_flops is compiled_flops
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+assert rows, "no ledger lines"
+compiles = []
+for r in rows:
+    d = r["device"]
+    mem = d["memory"]
+    assert mem is None or (mem and all(
+        "bytes_in_use" in e and "source" in e for e in mem)), mem
+    compiles += d["compiles"]
+    mfu = d["mfu"]
+    if mfu is not None:
+        assert 0.0 <= mfu <= 1.0, f"impossible mfu {mfu}"
+        import jax
+        assert d["peak_tflops"] == peak_tflops_for_device(None) * len(
+            jax.local_devices())
+        assert d["mfu_provenance"] == MFU_PROVENANCE
+assert compiles, "no named compile-ledger entry in the whole run"
+assert all(e["fn"] and e["wall_s"] > 0 for e in compiles), compiles
+names = sorted({e["fn"] for e in compiles})
+print(f"device section OK: {len(rows)} rounds, compiles {names}, "
+      f"mem source "
+      f"{sorted({e['source'] for r in rows for e in r['device']['memory'] or []})}")
+EOF
+# the report renders the device observatory table
+grep -q "device observatory" "$REPORT"
+# a forced recompile (a REAL re-jit on a changed arg shape) fires a
+# sentry verdict that NAMES the exact shape change
+env JAX_PLATFORMS=cpu python - "$DIR/recompile_probe.jsonl" <<'EOF'
+import sys
+import jax, jax.numpy as jnp
+from fedml_tpu.obs import telemetry
+from fedml_tpu.obs.device import DeviceRecorder
+from fedml_tpu.obs.perf import PerfRecorder, RecompileError
+reg = telemetry.TelemetryRegistry()
+rec = PerfRecorder(sys.argv[1], registry=reg, strict_recompiles=True,
+                   device=DeviceRecorder(registry=reg))
+f = rec.instrument_jit("hot_fn", jax.jit(lambda x: x * 2.0))
+rec.round_start(0); f(jnp.ones((4,), jnp.float32)); rec.round_end(0)
+rec.round_start(1); f(jnp.ones((8,), jnp.float32))
+try:
+    rec.round_end(1)
+    raise SystemExit("ERROR: sentry did not fire on a forced re-jit")
+except RecompileError as e:
+    assert "float32[4] -> float32[8]" in str(e), str(e)
+    print(f"sentry names the shape change: {e}")
+finally:
+    rec.close()
+EOF
+# a seeded 4x compile-time regression MUST fail the (device) trend gate
+python - "$RUN/perf.jsonl" "$DIR/perf_compile_regressed.jsonl" <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+for r in rows:
+    for e in r["device"]["compiles"]:
+        e["wall_s"] = e["wall_s"] * 4.0 + 0.2
+with open(sys.argv[2], "w") as f:
+    f.writelines(json.dumps(r) + "\n" for r in rows)
+EOF
+if env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
+    --ledger "$DIR/perf_compile_regressed.jsonl" \
+    --baseline "$RUN/perf.jsonl" > "$DIR/device_fail.txt"; then
+    echo "ERROR: trend gate passed a seeded 4x compile regression"; exit 1
+fi
+grep -q "device compile regression" "$DIR/device_fail.txt"
+echo "device gate OK: honest ledger passes, seeded compile regression fails"
+
 echo "== streaming aggregation: one --agg_mode stream round, fold phase"
 # the O(1)-memory fold path (ISSUE 7): uploads fold at arrival, so the
 # ledger gains a 'fold' phase and never records a 'staging' one — and
@@ -98,7 +182,8 @@ env JAX_PLATFORMS=cpu python -m fedml_tpu \
     --client_num_in_total 4 --client_num_per_round 2 --comm_round 3 \
     --frequency_of_the_test 1 --batch_size 4 --log_stdout false \
     --agg_mode stream --norm_clip 5.0 \
-    --run_dir "$STREAM_RUN" --perf true --perf_strict true
+    --run_dir "$STREAM_RUN" --perf true --perf_strict true \
+    --device_obs true
 python - "$STREAM_RUN/perf.jsonl" <<'EOF'
 import json, sys
 rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
@@ -108,7 +193,13 @@ for r in rows:
         f"round {r['round']} ledger is missing the fold phase: {r['phases']}"
     assert "staging" not in r["phases"], \
         "stream mode must not stage a cohort buffer"
-print(f"fold phase present in all {len(rows)} stream-round ledger lines")
+# the device observatory covers the stream hot path too: the per-arrival
+# fold jit compiles exactly once, named in round 0's compile ledger
+fold_compiles = [e["fn"] for r in rows for e in r["device"]["compiles"]
+                 if e["fn"].startswith("stream_fold")]
+assert fold_compiles == ["stream_fold[mean]"], fold_compiles
+print(f"fold phase present in all {len(rows)} stream-round ledger lines; "
+      f"stream fold compiled once, named in the device ledger")
 EOF
 env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
     --ledger "$STREAM_RUN/perf.jsonl" --baseline "$STREAM_RUN/perf.jsonl"

@@ -88,6 +88,17 @@ EXPECTED = {
     "fedml_slo_health_misalignment_ratio",
     "fedml_slo_health_norm_cv_ratio",
     "fedml_slo_health_starvation_ratio",
+    # PR 10: the device & compile observatory (obs/device.py) + the
+    # device-memory headroom SLO it feeds.  Naming rule (PR 8, from day
+    # one here): non-monotonic device measurements wear _bytes/_ratio/
+    # _value — fedml_dev_compiles_total is the one true counter
+    # (tests/test_device_obs.py audits that no other *_total lands)
+    "fedml_dev_mem_in_use_bytes", "fedml_dev_mem_peak_bytes",
+    "fedml_dev_mem_utilization_ratio",
+    "fedml_dev_compile_seconds", "fedml_dev_compiles_total",
+    "fedml_dev_achieved_flops_value",
+    "fedml_perf_mfu_ratio",
+    "fedml_slo_device_mem_utilization_ratio",
 }
 
 
