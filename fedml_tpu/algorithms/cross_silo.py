@@ -161,7 +161,8 @@ class FedAvgServerActor(ServerManager):
                  encode_once: bool = True,
                  incremental_staging: bool = True,
                  perf=None,
-                 health=None):
+                 health=None,
+                 secagg=None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -262,6 +263,22 @@ class FedAvgServerActor(ServerManager):
         stack-at-the-barrier path (bit-identical results either way;
         tests/test_wire.py pins the equivalence).
 
+        ``secagg``: a `fedml_tpu.secure.protocol.SecAggServer` — the
+        round becomes the live secure-aggregation protocol: the sync
+        broadcast ships the masking parameters (``Message.ARG_SECAGG``),
+        silos advertise DH public keys + Shamir share envelopes, the
+        server relays one roster frame per silo, uploads arrive MASKED
+        in the uint32 ring (screened by the ``kind="masked"`` admission
+        pipeline PRE-mask-removal, then ring-folded at arrival — the
+        O(model) streaming spine), and the barrier close runs an UNMASK
+        phase: survivors reveal the shares that reconstruct uploaders'
+        self-masks and dead silos' pairwise secrets, the sum dequantizes,
+        and the post-unmask sum screen + sum-level clip/noise run before
+        the global publishes.  The ledger gains ``mask_agreement`` and
+        ``unmask`` phases.  Mutually exclusive with ``aggregate_fn`` /
+        ``stream_agg`` / ``decode_upload`` — masked uploads have no
+        plaintext to stack, stream, or decompress.
+
         ``stream_agg``: a `fedml_tpu.core.stream_agg.StreamingAggregator`
         — the O(model)-memory replacement for the ``[cohort, ...]``
         buffer entirely (``--agg_mode stream``).  Each admitted upload
@@ -301,6 +318,19 @@ class FedAvgServerActor(ServerManager):
                              "one --agg_mode")
         self.aggregate_fn = aggregate_fn
         self.stream_agg = stream_agg
+        self.secagg = secagg
+        if secagg is not None and (aggregate_fn is not None
+                                   or stream_agg is not None
+                                   or decode_upload is not None):
+            raise ValueError(
+                "secagg is mutually exclusive with aggregate_fn/"
+                "stream_agg/decode_upload: masked uploads have no "
+                "plaintext to stack, stream, or decompress")
+        # secagg round stage: None | "agreement" | "upload" | "unmask"
+        self._secagg_stage: Optional[str] = None
+        self._secagg_quorum = 0
+        self._secagg_unmask_laps = 0
+        self._secagg_agreement_laps = 0
         self.encode_once = encode_once
         self.incremental_staging = incremental_staging
         self.perf = perf
@@ -349,6 +379,11 @@ class FedAvgServerActor(ServerManager):
         self.register_handler(MsgType.C2S_MODEL, self._on_model)
         self.register_handler(MsgType.ROUND_TIMEOUT, self._on_timeout)
         self.register_handler(MsgType.C2S_HEARTBEAT, self._on_heartbeat)
+        if self.secagg is not None:
+            from fedml_tpu.secure.protocol import (MSG_SECAGG_ADVERT,
+                                                   MSG_SECAGG_SHARES)
+            self.register_handler(MSG_SECAGG_ADVERT, self._on_secagg_advert)
+            self.register_handler(MSG_SECAGG_SHARES, self._on_secagg_shares)
 
     # -- round logic ---------------------------------------------------------
     def start(self) -> None:
@@ -460,6 +495,19 @@ class FedAvgServerActor(ServerManager):
             # still revive the federation instead of the barrier
             # closing on nothing
             dead = set()
+        if self.secagg is not None and len(cohort - dead) < 2:
+            # runtime attrition left fewer than 2 live silos: a 1-member
+            # "masked sum" IS that silo's update, so the group cannot
+            # mask.  Clear the dead set like the all-dead fallback so
+            # the masked sync reaches EVERYONE (the rejoin warm-up sync
+            # carries no masking parameters, so a mid-round rejoin could
+            # never advertise otherwise); truly-gone silos stall the
+            # agreement, which abandons the round after its retry cap
+            # instead of wedging.
+            log.warning("round %d: fewer than 2 live silos for the "
+                        "masking group; tasking the full cohort and "
+                        "waiting for returns", self.round_idx)
+            dead = set()
         # silos already known dead are dropped AT BROADCAST: they are
         # logged for this round immediately and the barrier never waits
         # on them (the quorum "shrinks" instead of re-paying the timeout)
@@ -499,6 +547,18 @@ class FedAvgServerActor(ServerManager):
                                         excluded=sorted(dead))
         extra = ({} if self._last_accepted is None
                  else {Message.ARG_ACCEPTED: self._last_accepted})
+        if self.secagg is not None:
+            # open the mask-agreement phase: the sync frame carries the
+            # round's masking parameters (group / threshold / clip /
+            # weight normalizer) so silos need zero secagg configuration
+            # (the <2-live-silos fallback above guarantees the group
+            # size here)
+            with self._perf_phase("mask_agreement"):
+                self.secagg.round_start(self.round_idx,
+                                        sorted(self._expected))
+                self._secagg_stage = "agreement"
+                self._secagg_agreement_laps = 0
+                extra[Message.ARG_SECAGG] = self.secagg.sync_info()
         with self._span("broadcast", parent=self._round_span,
                         round=self.round_idx), \
                 self._perf_phase("broadcast_serialize"):
@@ -545,6 +605,12 @@ class FedAvgServerActor(ServerManager):
     def _on_timeout(self, msg: Message) -> None:
         if msg.get(Message.ARG_ROUND) != self.round_idx:
             return  # stale timer from an already-completed round
+        if self._secagg_stage == "agreement":
+            self._secagg_agreement_timeout()
+            return
+        if self._secagg_stage == "unmask":
+            self._secagg_unmask_timeout()
+            return
         missing = sorted(self._expected - set(self._received))
         if not missing:
             return
@@ -569,6 +635,180 @@ class FedAvgServerActor(ServerManager):
             self._complete_round()
             return
         self._arm_timer()  # wait (or drop below quorum): keep waiting
+
+    # -- secure aggregation (secure/protocol.py) -----------------------------
+    def _on_secagg_advert(self, msg: Message) -> None:
+        """Mask-agreement phase: bank a silo's pk + share envelopes;
+        when the whole expected group advertised, relay the rosters."""
+        self._beat(msg.sender_id)
+        if msg.get(Message.ARG_ROUND) != self.round_idx \
+                or self._secagg_stage != "agreement":
+            log.info("discarding stale/late secagg advert from silo %d",
+                     msg.sender_id)
+            return
+        with self._perf_phase("mask_agreement"):
+            complete = self.secagg.note_advert(msg.sender_id,
+                                               msg.get(Message.ARG_SECAGG))
+        if complete:
+            self._send_rosters()
+
+    def _send_rosters(self, subset=None) -> None:
+        """Fix the round's masking roster and fan the roster frames out
+        (encode-once: the pks repeat, only each silo's inbound share
+        envelope differs).  Silos that never advertised fall out of the
+        roster AND the barrier — they are this round's dropouts."""
+        from fedml_tpu.secure.protocol import MSG_SECAGG_ROSTER, SecAggError
+        with self._perf_phase("mask_agreement"):
+            try:
+                rosters = self.secagg.flush_roster(subset)
+            except SecAggError as e:
+                # below the share threshold: a roster this small could
+                # never unmask — keep waiting for more adverts
+                log.warning("round %d: cannot fix secagg roster yet (%s)",
+                            self.round_idx, e)
+                self._arm_timer()
+                return
+            self._secagg_stage = "upload"
+            lost = self._expected - set(rosters)
+            if lost:
+                log.warning("round %d: silos %s never advertised; dropped "
+                            "from the masking roster and the barrier",
+                            self.round_idx, sorted(lost))
+                self.dropped_silos.setdefault(self.round_idx, []).extend(
+                    sorted(lost))
+                self._expected = self._expected - lost
+            per = {silo: {Message.ARG_SECAGG: payload}
+                   for silo, payload in rosters.items()}
+            self.send_many(MSG_SECAGG_ROSTER, sorted(per),
+                           shared_params={Message.ARG_ROUND: self.round_idx},
+                           per_receiver_params=per)
+        self._arm_timer()
+
+    def _secagg_agreement_timeout(self) -> None:
+        advertised = self.secagg.advertised()
+        missing = sorted(self._expected - advertised)
+        if not missing:
+            return  # roster flush is already in flight
+        log.warning("round %d: silos %s have not advertised after %.1fs "
+                    "(policy=%s)", self.round_idx, missing,
+                    self.round_timeout_s, self.straggler_policy)
+        if self.straggler_policy == "abort":
+            self.aborted = True
+            for silo in range(1, self._num_silos + 1):
+                self.send(MsgType.S2C_FINISH, silo)
+            self.finish()
+            return
+        quorum = max(1, math.ceil(self.min_silo_frac * len(self._expected)))
+        if self.straggler_policy == "drop" and len(advertised) >= quorum:
+            self._send_rosters(subset=sorted(advertised))
+            # _send_rosters re-armed the timer either way; when the
+            # subset sat below the SHARE threshold the roster was
+            # refused — count the lap so a cohort that can never reach
+            # t abandons the round instead of stalling forever (the
+            # agreement twin of the unmask retry cap)
+            if self._secagg_stage == "agreement":
+                self._secagg_agreement_laps += 1
+                if self._secagg_agreement_laps > \
+                        self._SECAGG_UNMASK_RETRIES:
+                    log.error(
+                        "round %d: mask agreement cannot reach the share "
+                        "threshold after %d laps; abandoning the round",
+                        self.round_idx, self._secagg_agreement_laps - 1)
+                    self._secagg_stage = None
+                    self._cancel_timer()
+                    self._finish_round(0)
+            return
+        self._arm_timer()  # wait policy (or below quorum): keep waiting
+
+    # a lost UNMASK/SHARES frame must not wedge the round: the request
+    # re-sends on each timer lap, and after this many laps below the
+    # share threshold the round is abandoned loudly (global unchanged)
+    _SECAGG_UNMASK_RETRIES = 3
+
+    def _begin_unmask(self, admitted_count: int) -> None:
+        """Barrier closed over masked uploads: ask the survivors for the
+        shares that unmask the sum (self-mask seeds of every uploader,
+        pairwise secrets of every dead roster member)."""
+        self._secagg_stage = "unmask"
+        self._secagg_quorum = admitted_count
+        self._secagg_unmask_laps = 0
+        self._send_unmask_request()
+        self._arm_timer()
+
+    def _send_unmask_request(self) -> None:
+        from fedml_tpu.secure.protocol import MSG_SECAGG_UNMASK
+        with self._perf_phase("unmask"):
+            survivors, dead = self.secagg.unmask_request()
+            if dead:
+                log.warning("round %d: reconstructing %d dead silo(s) %s "
+                            "from surviving shares", self.round_idx,
+                            len(dead), dead)
+            self.send_many(
+                MSG_SECAGG_UNMASK, survivors,
+                shared_params={Message.ARG_ROUND: self.round_idx,
+                               Message.ARG_SECAGG: {"survivors": survivors,
+                                                    "dead": dead}})
+
+    def _on_secagg_shares(self, msg: Message) -> None:
+        self._beat(msg.sender_id)
+        if msg.get(Message.ARG_ROUND) != self.round_idx \
+                or self._secagg_stage != "unmask":
+            return
+        with self._perf_phase("unmask"):
+            complete = self.secagg.note_reveal(msg.sender_id,
+                                               msg.get(Message.ARG_SECAGG))
+        if complete:
+            self._finalize_secagg()
+
+    def _secagg_unmask_timeout(self) -> None:
+        if self.secagg.can_finalize():
+            log.warning("round %d: unmask quorum reached but not every "
+                        "survivor revealed; finalizing from the available "
+                        "shares", self.round_idx)
+            self._finalize_secagg()
+            return
+        self._secagg_unmask_laps += 1
+        if self._secagg_unmask_laps > self._SECAGG_UNMASK_RETRIES:
+            # unrecoverable: too many survivors unreachable to ever reach
+            # the share threshold — the round is LOST loudly, the global
+            # stays put (a partially-unmasked sum must never publish)
+            log.error("round %d: unmask share threshold unreachable after "
+                      "%d request retries; abandoning the round",
+                      self.round_idx, self._SECAGG_UNMASK_RETRIES)
+            self._secagg_stage = None
+            self._finish_round(0)
+            return
+        log.warning("round %d: below the unmask share threshold; re-"
+                    "requesting reveals (lap %d/%d)", self.round_idx,
+                    self._secagg_unmask_laps, self._SECAGG_UNMASK_RETRIES)
+        self._send_unmask_request()
+        self._arm_timer()
+
+    def _finalize_secagg(self) -> None:
+        """Unmask the ring sum, run the post-unmask sum defenses, publish
+        (or — on an unrecoverable round — keep the global and say so)."""
+        from fedml_tpu.secure.protocol import SecAggError
+        self._secagg_stage = None
+        self._cancel_timer()
+        quorum = self._secagg_quorum
+        with self._span("aggregate", parent=self._round_span,
+                        round=self.round_idx, quorum=quorum), \
+                self._perf_phase("unmask"):
+            try:
+                mean, den = self.secagg.finalize(
+                    reference=self._host_params())
+            except SecAggError:
+                log.exception("round %d: secure unmask FAILED; the global "
+                              "model is unchanged this round",
+                              self.round_idx)
+                mean = None
+            if mean is None:
+                # unmask failure or the post-unmask sum screen fired:
+                # the round is lost loudly, never mis-aggregated
+                quorum = 0
+            else:
+                self.params = mean
+        self._finish_round(quorum)
 
     # -- health --------------------------------------------------------------
     def _on_heartbeat(self, msg: Message) -> None:
@@ -603,6 +843,17 @@ class FedAvgServerActor(ServerManager):
             log.warning("discarding round-%s upload from silo %d (current "
                         "round %d)", upload_round, msg.sender_id,
                         self.round_idx)
+            return
+        if self.secagg is not None and self._secagg_stage != "upload":
+            # a masked upload outside the upload stage (a straggler
+            # landing after the barrier closed, mid-unmask) must not
+            # mutate the fold: the unmask request already snapshotted
+            # survivors/dead, and folding now would demand self-mask
+            # shares nobody was asked to reveal — the round that HAD
+            # quorum would be abandoned.  Same guard as the edge path.
+            log.info("round %d: discarding masked upload from silo %d "
+                     "outside the upload stage (stage=%s)", self.round_idx,
+                     msg.sender_id, self._secagg_stage)
             return
         if self._expected and msg.sender_id not in self._expected:
             # an upload from a silo outside the expected quorum (it was
@@ -729,7 +980,25 @@ class FedAvgServerActor(ServerManager):
         per-leaf stacking at all.  In stream mode the upload FOLDS into
         the O(model) running aggregate here instead, and nothing
         model-sized survives the fold."""
-        if entry is not None and self.stream_agg is not None:
+        if entry is not None and self.secagg is not None:
+            # ring addition IS the fold: the masked upload lands in the
+            # O(model) uint32 accumulator at arrival (the PR 7 streaming
+            # spine, preserved under masking) and nothing model-sized
+            # survives per silo
+            from fedml_tpu.secure.protocol import SecAggError
+            try:
+                with self._perf_phase("fold"):
+                    self.secagg.fold(silo, entry[0], entry[1])
+            except SecAggError as e:
+                # an upload from outside the fixed roster (e.g. a silo
+                # whose advert was dropped but whose upload got through):
+                # inadmissible — its masks cannot cancel
+                log.warning("round %d: rejecting masked upload from silo "
+                            "%d (%s)", self.round_idx, silo, e)
+                entry = None
+            else:
+                entry = (self._STAGED, entry[1])
+        elif entry is not None and self.stream_agg is not None:
             with self._perf_phase("fold"):
                 self.stream_agg.fold(entry[0], entry[1])
             entry = (self._STAGED, entry[1])
@@ -852,6 +1121,19 @@ class FedAvgServerActor(ServerManager):
         # assume the rejected uploads were aggregated
         self._last_accepted = np.asarray(sorted(admitted), np.int32)
         self._received.clear()
+        if self.secagg is not None:
+            if admitted:
+                # the barrier is met but the sum is still masked: the
+                # round closes asynchronously once the unmask share
+                # reveals arrive (_finalize_secagg)
+                self._begin_unmask(len(admitted))
+                return
+            self._secagg_stage = None
+            log.warning("round %d: no admissible masked uploads; the "
+                        "global model is unchanged this round",
+                        self.round_idx)
+            self._finish_round(0)
+            return
         defended = (self.aggregate_fn is not None
                     or (self.stream_agg is not None
                         and self.stream_agg.defended))
@@ -884,6 +1166,12 @@ class FedAvgServerActor(ServerManager):
                 weights = np.array([admitted[s][1] for s in sorted(admitted)],
                                    dtype=np.float32)
                 self.params = tree_weighted_mean(trees, weights)
+        self._finish_round(len(admitted))
+
+    def _finish_round(self, quorum: int) -> None:
+        """The round-close tail shared by the plaintext barrier close and
+        the secagg unmask completion: staging release, health/checkpoint/
+        publish/perf hooks, then the next broadcast (or FINISH)."""
         # release the staged cohort at round close: the defended jit
         # already copied the host buffer to the device, so holding the
         # [cohort, ...] block between rounds keeps server RSS at the
@@ -905,7 +1193,7 @@ class FedAvgServerActor(ServerManager):
             with self._perf_phase("health"):
                 self.health.round_end(self.round_idx,
                                       new_global=self._host_params(),
-                                      quorum=len(admitted))
+                                      quorum=quorum)
 
         if self.checkpointer is not None:
             # thunk: rounds the save_every gate skips pay no device→host
@@ -928,7 +1216,7 @@ class FedAvgServerActor(ServerManager):
             # the server's own round costs, not the eval cadence.  A
             # strict-mode RecompileError raises here, on the event loop,
             # and fails the run loudly (the test-mode contract).
-            self.perf.round_end(self.round_idx, quorum=len(admitted),
+            self.perf.round_end(self.round_idx, quorum=quorum,
                                 dropped=len(self.dropped_silos.get(
                                     self.round_idx, [])))
         if self.on_round_done is not None:
@@ -968,7 +1256,17 @@ class FedAvgClientActor(ClientManager):
                  encode_upload: Optional[Callable] = None,
                  on_accepted: Optional[Callable] = None,
                  heartbeat_interval_s: Optional[float] = None,
-                 server_id: int = 0):
+                 server_id: int = 0,
+                 secagg=None):
+        """``secagg``: a `fedml_tpu.secure.protocol.SecAggClient` — the
+        silo speaks the secure-aggregation choreography: on sync it
+        advertises its round keys (then trains while the agreement
+        completes), uploads only after the ROSTER fixes the masking
+        cohort — quantized into the ring, pairwise- and self-masked —
+        and answers the server's UNMASK request with exactly the share
+        kinds requested (never both for one silo).  Every masking
+        parameter rides the sync frame; the client needs no
+        configuration beyond this object."""
         super().__init__(node_id, transport)
         self.server_id = server_id
         self.train_fn = train_fn
@@ -980,6 +1278,13 @@ class FedAvgClientActor(ClientManager):
         # settle (ErrorFeedback.resolve) before the next encode reads them
         self.on_accepted = on_accepted
         self.heartbeat_interval_s = heartbeat_interval_s
+        self.secagg = secagg
+        if secagg is not None and encode_upload is not None:
+            raise ValueError("secagg and encode_upload (wire compression) "
+                             "are mutually exclusive: a compressed payload "
+                             "cannot ride the masking ring")
+        # (round, trained host params, num_samples) awaiting its roster
+        self._pending_upload: Optional[tuple] = None
         self._round: Optional[int] = None  # last round synced from server
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
@@ -988,6 +1293,11 @@ class FedAvgClientActor(ClientManager):
         self.register_handler(MsgType.S2C_INIT, self._on_sync)
         self.register_handler(MsgType.S2C_SYNC, self._on_sync)
         self.register_handler(MsgType.S2C_FINISH, lambda m: self.finish())
+        if self.secagg is not None:
+            from fedml_tpu.secure.protocol import (MSG_SECAGG_ROSTER,
+                                                   MSG_SECAGG_UNMASK)
+            self.register_handler(MSG_SECAGG_ROSTER, self._on_secagg_roster)
+            self.register_handler(MSG_SECAGG_UNMASK, self._on_secagg_unmask)
 
     def run(self) -> None:
         if self.heartbeat_interval_s is not None and self._hb_thread is None:
@@ -1020,6 +1330,24 @@ class FedAvgClientActor(ClientManager):
         self._round = round_idx
         if self.on_accepted is not None:
             self.on_accepted(msg.get(Message.ARG_ACCEPTED))
+        secagg_info = (msg.get(Message.ARG_SECAGG)
+                       if self.secagg is not None else None)
+        if self.secagg is not None and secagg_info is None:
+            # a sync without masking parameters (e.g. the rejoin warm-up
+            # sync) must NEVER fall through to a plaintext upload — that
+            # is the one frame the whole protocol exists to prevent.
+            # Bank the global and wait for the next masked broadcast.
+            log.info("silo %d: sync without secagg parameters (rejoin "
+                     "warm-up?); not uploading this round", self.node_id)
+            return
+        if secagg_info is not None:
+            # advertise BEFORE training so the mask agreement overlaps
+            # the local-SGD wall time instead of serializing after it
+            from fedml_tpu.secure.protocol import MSG_SECAGG_ADVERT
+            advert = self.secagg.begin_round(round_idx, secagg_info)
+            self.send(MSG_SECAGG_ADVERT, self.server_id,
+                      **{Message.ARG_SECAGG: advert,
+                         Message.ARG_ROUND: round_idx})
         # deterministic span ids: a chaos-duplicated sync re-trains, but
         # its train/upload spans collapse onto the first delivery's
         with self._span("train", deterministic=True, round=round_idx,
@@ -1027,6 +1355,12 @@ class FedAvgClientActor(ClientManager):
             new_params, num_samples = self.train_fn(params, client_idx,
                                                     round_idx)
         upload = jax.tree.map(np.asarray, new_params)
+        if secagg_info is not None:
+            # the upload waits for the roster: masks are derived from the
+            # FIXED cohort, so uploading pre-roster is impossible
+            self._pending_upload = (round_idx, upload, float(num_samples))
+            self._maybe_masked_upload()
+            return
         if self.encode_upload is not None:
             upload = self.encode_upload(upload, params)
         with self._span("upload", deterministic=True, round=round_idx):
@@ -1034,3 +1368,43 @@ class FedAvgClientActor(ClientManager):
                       **{Message.ARG_MODEL_PARAMS: upload,
                          Message.ARG_NUM_SAMPLES: int(num_samples),
                          Message.ARG_ROUND: round_idx})
+
+    # -- secure aggregation --------------------------------------------------
+    def _on_secagg_roster(self, msg: Message) -> None:
+        round_idx = msg.get(Message.ARG_ROUND)
+        if self.secagg.on_roster(round_idx, msg.get(Message.ARG_SECAGG)):
+            self._maybe_masked_upload()
+
+    def _maybe_masked_upload(self) -> None:
+        """Ship the trained update once BOTH the training and the roster
+        have landed (either order — sync trains first, roster may beat
+        or trail it)."""
+        if self._pending_upload is None:
+            return
+        round_idx, update, num_samples = self._pending_upload
+        if not self.secagg.has_roster(round_idx):
+            return
+        masked = self.secagg.mask(round_idx, update, num_samples)
+        self._pending_upload = None
+        with self._span("upload", deterministic=True, round=round_idx):
+            self.send(MsgType.C2S_MODEL, self.server_id,
+                      **{Message.ARG_MODEL_PARAMS: masked,
+                         Message.ARG_NUM_SAMPLES: int(num_samples),
+                         Message.ARG_ROUND: round_idx})
+
+    def _on_secagg_unmask(self, msg: Message) -> None:
+        from fedml_tpu.secure.protocol import MSG_SECAGG_SHARES, SecAggError
+        round_idx = msg.get(Message.ARG_ROUND)
+        info = msg.get(Message.ARG_SECAGG) or {}
+        try:
+            reveal = self.secagg.reveal(round_idx, info.get("survivors", []),
+                                        info.get("dead", []))
+        except SecAggError as e:
+            # a malformed/adversarial request (e.g. naming a silo as both
+            # survivor and dead): refuse loudly, reveal nothing
+            log.error("silo %d: refusing unmask request for round %s: %s",
+                      self.node_id, round_idx, e)
+            return
+        self.send(MSG_SECAGG_SHARES, self.server_id,
+                  **{Message.ARG_SECAGG: reveal,
+                     Message.ARG_ROUND: round_idx})

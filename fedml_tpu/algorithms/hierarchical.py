@@ -325,16 +325,32 @@ class EdgeAggregatorActor:
     def __init__(self, node_id: int, transport, silos: Dict[int, int],
                  cohort_total: int, client_num_in_total: int,
                  stream_agg, admission=None, root_id: int = 0,
-                 timeout_s: Optional[float] = None, health=None):
+                 timeout_s: Optional[float] = None, health=None,
+                 secagg=None):
         """``health``: a `fedml_tpu.obs.health.HealthAccumulator`
         (statistics-only — ``alarms=False``, no ledger: the root owns
         verdicts); when set, the edge folds its silos' learning-health
         stats at arrival and ships the compact per-round rollup inside
         its existing edge frame (`Message.ARG_HEALTH`) — the tree stays
         one-frame-per-round and the root renders a per-edge health
-        table."""
+        table.
+
+        ``secagg``: a `fedml_tpu.secure.protocol.SecAggServer` scoped to
+        THIS edge's block (``--secagg grouped`` — TurboAggregate's
+        grouped scheme on the live tree): the edge runs the whole
+        secure-aggregation choreography for its silos — advert relay,
+        roster, ring fold of masked uploads, unmask at flush — and ships
+        the recovered plaintext PARTIAL MEAN to the root in the existing
+        one-frame-per-round format, so the root stays an UNMODIFIED
+        `FedAvgServerActor` and mask-agreement traffic drops from
+        O(N²) to O(N²/E).  Mutually exclusive with ``stream_agg``."""
         from fedml_tpu.comm.actors import ClientManager, SelfMessageTimer
         from fedml_tpu.obs import telemetry
+
+        if (secagg is None) == (stream_agg is None):
+            raise ValueError("EdgeAggregatorActor needs exactly one of "
+                             "stream_agg (plaintext fold) or secagg "
+                             "(masked ring fold)")
 
         # composition over inheritance for the manager plumbing: the
         # actor IS a ClientManager to the root and a server to its silos
@@ -347,7 +363,15 @@ class EdgeAggregatorActor:
                 mgr.register_handler(MsgType.C2S_HEARTBEAT, lambda m: None)
                 mgr.register_handler(MSG_EDGE_TIMEOUT, self._on_timeout)
                 mgr.register_handler(MsgType.S2C_FINISH, self._on_finish)
+                if self.secagg is not None:
+                    from fedml_tpu.secure.protocol import (
+                        MSG_SECAGG_ADVERT, MSG_SECAGG_SHARES)
+                    mgr.register_handler(MSG_SECAGG_ADVERT,
+                                         self._on_secagg_advert)
+                    mgr.register_handler(MSG_SECAGG_SHARES,
+                                         self._on_secagg_shares)
 
+        self.secagg = secagg
         self._mgr = _Mgr(node_id, transport)
         self.node_id = node_id
         self.silos = dict(silos)
@@ -363,6 +387,7 @@ class EdgeAggregatorActor:
         self._received: set = set()
         self._timer = SelfMessageTimer()
         self._flushed = False
+        self._secagg_stage: Optional[str] = None
         self._c_flush = telemetry.get_registry().counter(
             "fedml_stream_edge_flush_total")
 
@@ -395,10 +420,20 @@ class EdgeAggregatorActor:
         self.round_idx = round_idx
         self._received.clear()
         self._flushed = False
+        self._secagg_stage = None
         # the round's reference global, kept for the admission screen —
         # the edge's own handle, not a reach into stream_agg internals
         self._round_params = params
-        self.stream_agg.reset(params)
+        shared_extra = {}
+        if self.secagg is not None:
+            # the edge IS the secagg server for its block: the re-
+            # broadcast carries the block's masking parameters, so the
+            # silos of a grouped deployment mask exactly as flat ones do
+            self.secagg.round_start(round_idx, sorted(self.silos))
+            self._secagg_stage = "agreement"
+            shared_extra[Message.ARG_SECAGG] = self.secagg.sync_info()
+        else:
+            self.stream_agg.reset(params)
         if self.health is not None:
             self.health.round_start(round_idx, params,
                                     expected=sorted(self.silos))
@@ -413,7 +448,7 @@ class EdgeAggregatorActor:
         self._mgr.send_many(
             msg.type, sorted(per_silo),
             shared_params={Message.ARG_MODEL_PARAMS: params,
-                           Message.ARG_ROUND: round_idx},
+                           Message.ARG_ROUND: round_idx, **shared_extra},
             per_receiver_params=per_silo)
         self._arm_timer()
 
@@ -432,11 +467,109 @@ class EdgeAggregatorActor:
         from fedml_tpu.comm.message import Message
         if msg.get(Message.ARG_ROUND) != self.round_idx or self._flushed:
             return
+        if self._secagg_stage == "agreement":
+            from fedml_tpu.secure.protocol import SecAggError
+            advertised = sorted(self.secagg.advertised())
+            logger.warning("edge %d round %s: fixing the masking roster on "
+                           "the %d silo(s) that advertised", self.node_id,
+                           self.round_idx, len(advertised))
+            try:
+                self._send_rosters(subset=advertised)
+            except SecAggError as e:
+                self._give_up(f"roster below the share threshold ({e})")
+            return
+        if self._secagg_stage == "unmask":
+            if self.secagg.can_finalize():
+                self._finalize_secagg()
+            else:
+                self._give_up("below the unmask share threshold")
+            return
         missing = sorted(set(self.silos) - self._received)
         logger.warning("edge %d round %s: silos %s missing after %.1fs; "
                     "flushing the partial fold", self.node_id,
                     self.round_idx, missing, self.timeout_s)
         self._flush()
+
+    # -- secure aggregation (grouped masking, secure/protocol.py) ------------
+    def _on_secagg_advert(self, msg) -> None:
+        from fedml_tpu.comm.message import Message
+        if msg.sender_id not in self.silos \
+                or msg.get(Message.ARG_ROUND) != self.round_idx \
+                or self._secagg_stage != "agreement":
+            return
+        if self.secagg.note_advert(msg.sender_id,
+                                   msg.get(Message.ARG_SECAGG)):
+            from fedml_tpu.secure.protocol import SecAggError
+            try:
+                self._send_rosters()
+            except SecAggError as e:  # unreachable with a full group
+                self._give_up(str(e))
+
+    def _send_rosters(self, subset=None) -> None:
+        from fedml_tpu.comm.message import Message
+        from fedml_tpu.secure.protocol import MSG_SECAGG_ROSTER
+        rosters = self.secagg.flush_roster(subset)  # raises below threshold
+        self._secagg_stage = "upload"
+        per = {silo: {Message.ARG_SECAGG: payload}
+               for silo, payload in rosters.items()}
+        self._mgr.send_many(MSG_SECAGG_ROSTER, sorted(per),
+                            shared_params={Message.ARG_ROUND: self.round_idx},
+                            per_receiver_params=per)
+        self._arm_timer()
+
+    def _begin_unmask(self) -> None:
+        from fedml_tpu.comm.message import Message
+        from fedml_tpu.secure.protocol import MSG_SECAGG_UNMASK
+        self._secagg_stage = "unmask"
+        survivors, dead = self.secagg.unmask_request()
+        if dead:
+            logger.warning("edge %d round %s: reconstructing dead silo(s) "
+                           "%s from surviving shares", self.node_id,
+                           self.round_idx, dead)
+        self._mgr.send_many(
+            MSG_SECAGG_UNMASK, survivors,
+            shared_params={Message.ARG_ROUND: self.round_idx,
+                           Message.ARG_SECAGG: {"survivors": survivors,
+                                                "dead": dead}})
+        self._arm_timer()
+
+    def _on_secagg_shares(self, msg) -> None:
+        from fedml_tpu.comm.message import Message
+        if msg.get(Message.ARG_ROUND) != self.round_idx \
+                or self._secagg_stage != "unmask":
+            return
+        if self.secagg.note_reveal(msg.sender_id,
+                                   msg.get(Message.ARG_SECAGG)):
+            self._finalize_secagg()
+
+    def _finalize_secagg(self) -> None:
+        """Unmask the block's ring sum and ship the plaintext partial
+        mean to the root — the SAME one-frame-per-round format, so the
+        root never knows its 'silo' spoke a masked protocol downstream."""
+        from fedml_tpu.secure.protocol import SecAggError
+        self._secagg_stage = None
+        self._timer.cancel()
+        try:
+            mean, _den = self.secagg.finalize(reference=self._round_params)
+        except SecAggError as e:
+            self._give_up(f"unmask failed: {e}")
+            return
+        if mean is None:  # the post-unmask sum screen fired
+            self._give_up("recovered sum rejected by the norm screen")
+            return
+        self._ship(mean, self.secagg.weight_total, self.secagg.count)
+
+    def _give_up(self, why: str) -> None:
+        """An unrecoverable masked round: stay SILENT (the root's
+        straggler policy closes over this edge like any dropped silo) —
+        a partially-unmasked sum must never ship."""
+        logger.warning("edge %d round %s: giving up the masked round (%s); "
+                       "not reporting", self.node_id, self.round_idx, why)
+        self._secagg_stage = None
+        self._flushed = True
+        self._timer.cancel()
+        if self.health is not None:
+            self.health.round_end(self.round_idx)
 
     def _on_upload(self, msg) -> None:
         from fedml_tpu.comm.message import Message
@@ -478,21 +611,54 @@ class EdgeAggregatorActor:
             if self.health is not None:
                 # health folds before the aggregation fold consumes the
                 # upload — the edge's block-level stats ride to the root
-                # in this round's frame
+                # in this round's frame (payload stats suppressed by name
+                # under masking)
                 self.health.observe_admitted(msg.sender_id, upload,
                                              float(num_samples),
                                              norm=upload_norm)
-            self.stream_agg.fold(upload, float(num_samples))
+            if self.secagg is not None:
+                from fedml_tpu.secure.protocol import SecAggError
+                if self._secagg_stage != "upload":
+                    logger.warning("edge %d: masked upload from silo %d "
+                                   "outside the upload stage; dropped",
+                                   self.node_id, msg.sender_id)
+                else:
+                    try:
+                        self.secagg.fold(msg.sender_id, upload,
+                                         float(num_samples))
+                    except SecAggError as e:
+                        logger.warning("edge %d: rejecting masked upload "
+                                       "from silo %d (%s)", self.node_id,
+                                       msg.sender_id, e)
+            else:
+                self.stream_agg.fold(upload, float(num_samples))
+        if self.secagg is not None:
+            # the masked barrier closes over the ROSTER (silos that never
+            # advertised can never upload) by REPORTS, not folds — a
+            # reported-but-rejected upload must close the barrier exactly
+            # as on the flat root, or one inadmissible frame stalls the
+            # block to full timeout (and wedges it forever under the
+            # wait policy's timeout_s=None)
+            if self._secagg_stage == "upload" \
+                    and self._received >= \
+                    set(self.secagg.roster_members()):
+                self._flush()
+            return
         if self._received >= set(self.silos):
             self._flush()
 
     def _flush(self) -> None:
-        """Ship the pre-reduced edge update: the fold's weighted mean,
-        its weight total, and the fold count — one model-sized frame per
-        round no matter how many silos fed it."""
-        from fedml_tpu.algorithms.cross_silo import MsgType
-        from fedml_tpu.comm.message import Message
+        """Close the block's upload phase.  Plaintext: ship the fold's
+        pre-reduced mean immediately.  Masked: the fold is still
+        ciphertext — begin the unmask phase instead (the frame ships
+        from `_finalize_secagg` once the share reveals land)."""
         self._timer.cancel()
+        if self.secagg is not None:
+            if self.secagg.count == 0:
+                self._give_up("no admissible masked uploads")
+                return
+            self._begin_unmask()
+            return
         self._flushed = True
         if self.stream_agg.count == 0:
             # nothing admissible: stay silent; the root's straggler
@@ -506,6 +672,15 @@ class EdgeAggregatorActor:
             return
         mean = jax.tree.map(np.asarray,
                             self.stream_agg.finalize(self.round_idx))
+        self._ship(mean, self.stream_agg.weight_total, self.stream_agg.count)
+
+    def _ship(self, mean, weight_total: float, count: int) -> None:
+        """One pre-reduced frame to the root: the block mean, its weight
+        total, and the fold count — identical format for the plaintext
+        and masked paths."""
+        from fedml_tpu.algorithms.cross_silo import MsgType
+        from fedml_tpu.comm.message import Message
+        self._flushed = True
         self._c_flush.inc()
         extra = {}
         if self.health is not None:
@@ -518,7 +693,7 @@ class EdgeAggregatorActor:
         self._mgr.send(
             MsgType.C2S_MODEL, self.root_id,
             **{Message.ARG_MODEL_PARAMS: mean,
-               Message.ARG_NUM_SAMPLES: float(self.stream_agg.weight_total),
+               Message.ARG_NUM_SAMPLES: float(weight_total),
                Message.ARG_ROUND: self.round_idx,
-               Message.ARG_EDGE_COUNT: int(self.stream_agg.count),
+               Message.ARG_EDGE_COUNT: int(count),
                **extra})

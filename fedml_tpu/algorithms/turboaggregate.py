@@ -54,8 +54,11 @@ class TurboAggregateConfig:
     client_optimizer: str = "sgd"
     seed: int = 0
     # clip * scale must stay within the centered field range P//2, or a
-    # saturated element decodes with flipped sign (see __init__ assert)
-    quant_scale: float = 2.0**15
+    # saturated element decodes with flipped sign (see __init__ assert) —
+    # AND clients_per_group * clip * scale must stay within the uint32
+    # ring (secagg.validate_ring_budget), or a group's masked sum wraps.
+    # None = auto-derive the largest power-of-two scale satisfying both.
+    quant_scale: Optional[float] = None
     quant_clip: float = 2.0**14
     secagg_backend: str = "xla"   # "pallas": fused quantize+mask kernel
     # secret entropy for the LCC masking chunks; None = fresh per instance.
@@ -72,7 +75,29 @@ class TurboAggregate:
         self.workload = workload
         self.data = data
         self.cfg = config
-        assert config.quant_clip * config.quant_scale <= P_DEFAULT // 2, (
+        if config.quant_scale is None:
+            # auto: the largest power-of-two scale the GROUP's uint32 ring
+            # budget allows (N clipped group members must sum without
+            # wrapping — the ISSUE 11 satellite bug), further bounded by
+            # the LCC field range below.  Derived into THIS instance, not
+            # written back into the (possibly shared) config.
+            from fedml_tpu.secure.secagg import ring_budget_scale
+            self.quant_scale = ring_budget_scale(config.clients_per_group,
+                                                 config.quant_clip)
+            while config.quant_clip * self.quant_scale > P_DEFAULT // 2:
+                self.quant_scale /= 2.0
+            if self.quant_scale < 1.0:
+                raise ValueError(
+                    f"no usable fixed-point scale: clients_per_group="
+                    f"{config.clients_per_group} at clip="
+                    f"{config.quant_clip} cannot satisfy both the uint32 "
+                    f"ring and the LCC field range")
+        else:
+            from fedml_tpu.secure.secagg import validate_ring_budget
+            validate_ring_budget(config.clients_per_group,
+                                 config.quant_clip, config.quant_scale)
+            self.quant_scale = config.quant_scale
+        assert config.quant_clip * self.quant_scale <= P_DEFAULT // 2, (
             "quant_clip*quant_scale exceeds the centered field range "
             f"P//2={P_DEFAULT // 2}: a clipped element at +clip would decode "
             "with flipped sign on the dropout-recovery path")
@@ -83,7 +108,7 @@ class TurboAggregate:
             make_local_trainer(workload, opt, config.epochs),
             in_axes=(None, 0, 0)))
         self.secagg = SecureCohortAggregator(
-            config.clients_per_group, config.quant_scale, config.quant_clip,
+            config.clients_per_group, self.quant_scale, config.quant_clip,
             backend=config.secagg_backend)
         self._masked_group_sum = jax.jit(self._masked_group_sum_impl)
 
@@ -138,7 +163,7 @@ class TurboAggregate:
                 continue
             vec_j, unravel = jax.flatten_util.ravel_pytree(mean)
             vec = np.asarray(vec_j, np.float64)
-            q = np.mod(np.round(vec * cfg.quant_scale).astype(np.int64),
+            q = np.mod(np.round(vec * self.quant_scale).astype(np.int64),
                        P_DEFAULT)
             pad = (-len(q)) % 2
             q2 = np.pad(q, (0, pad)).reshape(-1, 2)
@@ -163,7 +188,7 @@ class TurboAggregate:
             vec_q = decoded.T.reshape(-1)[:len(q)]
             # undo centered field representation (values may encode negatives)
             signed = np.where(vec_q > P_DEFAULT // 2, vec_q - P_DEFAULT, vec_q)
-            vec_rec = signed.astype(np.float64) / cfg.quant_scale
+            vec_rec = signed.astype(np.float64) / self.quant_scale
             recovered.append(unravel(jnp.asarray(vec_rec, jnp.float32)))
 
         return tree_weighted_mean(recovered,

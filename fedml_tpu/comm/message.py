@@ -174,6 +174,16 @@ class Message:
     #                                  (obs/health.compact_summary) — the
     #                                  tree stays one-frame-per-round;
     #                                  DIAGNOSTIC-ONLY like ARG_EDGE_COUNT
+    ARG_SECAGG = "secagg"            # secure-aggregation protocol frames
+    #                                  (secure/protocol.py): the sync
+    #                                  broadcast's masking parameters
+    #                                  (group/threshold/clip/weight_cap),
+    #                                  a silo's advert (pk + Shamir share
+    #                                  envelopes), the roster relay, and
+    #                                  the unmask request/reveal payloads
+    #                                  — all plain-JSON dicts of ints, so
+    #                                  they ride the header beside the
+    #                                  masked uint32 model payload
     # span context (obs/trace.py CTX_KEY): a {"t","s"} dict riding the
     # plain JSON header, so one federated round stitches into a single
     # cross-process trace

@@ -224,6 +224,33 @@ class ExperimentConfig:
     #                                   edge folds its silos locally and
     #                                   ships ONE pre-reduced update per
     #                                   round (cross_silo local backend)
+    # ---- secure aggregation (secure/protocol.py, ROADMAP item 3) -------
+    secagg: str = "off"               # cross_silo live secure aggregation:
+    #                                   off | pairwise (one masking group =
+    #                                   the whole cohort) | grouped
+    #                                   (masking scoped per edge block —
+    #                                   requires --edge_aggregators;
+    #                                   TurboAggregate's grouped scheme,
+    #                                   mask-agreement traffic O(N^2/E)).
+    #                                   Uploads are quantized into the
+    #                                   uint32 ring and pairwise+self
+    #                                   masked; the server learns only the
+    #                                   cohort sum.  Dropouts recover via
+    #                                   t-of-N Shamir shares (unmask phase
+    #                                   at barrier close).  Requires
+    #                                   --agg_mode stream (the masked fold
+    #                                   is ring addition at arrival; there
+    #                                   is no stack path).
+    secagg_threshold: int = 0         # t of t-of-N Shamir: shares needed
+    #                                   to reconstruct a seed — the round
+    #                                   survives up to N-t dropouts and
+    #                                   fails LOUDLY beyond.  0 = majority
+    #                                   (N//2+1, min 2)
+    secagg_clip: float = 64.0         # per-coordinate clip before ring
+    #                                   quantization; the fixed-point
+    #                                   scale auto-derives from the group
+    #                                   size so the cohort sum cannot
+    #                                   wrap uint32
     adversary: str = ""               # seeded per-silo attacks over the
     #                                   real message path, e.g.
     #                                   "2:scale:20,3:sign_flip" (kinds:

@@ -134,7 +134,8 @@ def _make_perf(cfg: ExperimentConfig):
                         strict_recompiles=cfg.perf_strict, device=device)
 
 
-def _make_health(cfg: ExperimentConfig, kind: str):
+def _make_health(cfg: ExperimentConfig, kind: str,
+                 suppress_payload=None):
     """Federation health observatory (obs/health.py) for the live actor
     modes: streaming learning-health stats + a ``health.jsonl`` ledger
     at ``--health_ledger`` (or ``run_dir/health.jsonl`` under
@@ -156,7 +157,8 @@ def _make_health(cfg: ExperimentConfig, kind: str):
     spec = parse_slo_spec(cfg.slo) if cfg.slo else {}
     thresholds = {k: v for k, v in spec.items() if k in HEALTH_SLOS}
     return HealthAccumulator(kind=kind, node=f"node{cfg.node_id}",
-                             ledger_path=path, thresholds=thresholds)
+                             ledger_path=path, thresholds=thresholds,
+                             suppress_payload=suppress_payload)
 
 
 def _make_slo(cfg: ExperimentConfig):
@@ -835,7 +837,16 @@ def run_cross_silo(cfg, data, mesh, sink):
     # the fedml_slo_* gauges and ticks breach counters instead of
     # silently never evaluating the configured objectives
     slo = _make_slo(cfg)
-    health = _make_health(cfg, kind="params")
+    # the privacy↔observability trade, stated in the ledger: under flat
+    # (--secagg pairwise) masking the root sees only ciphertext, so the
+    # payload-derived health stats are SUPPRESSED BY NAME; under grouped
+    # masking the root receives plaintext edge MEANS and its block-level
+    # stats keep working (the edges' own accumulators are the suppressed
+    # ones)
+    health = _make_health(
+        cfg, kind="params",
+        suppress_payload=("secagg_pairwise_masking"
+                          if cfg.secagg == "pairwise" else None))
     wl = (_pp_workload(cfg, data) if cfg.mesh_stages > 0
           else _make_workload(cfg, data))
     init, make_train_fn = _silo_training_setup(cfg, data, wl, perf=perf)
@@ -845,6 +856,63 @@ def run_cross_silo(cfg, data, mesh, sink):
     admission, defended, stream = _robust_setup(
         cfg, init, kind="params", sentry=perf.sentry if perf else None,
         device=perf.device if perf else None)
+
+    # live secure aggregation (secure/protocol.py, --secagg): masked
+    # uploads over the real transport.  pairwise = the whole cohort is
+    # one masking group served by the ROOT's SecAggServer; grouped =
+    # masking scoped per edge block (each edge runs the protocol for its
+    # silos and ships a plaintext partial mean to an UNMODIFIED root).
+    secagg_root = None
+    make_edge_secagg = None
+    make_silo_secagg = lambda g: None  # noqa: E731
+    if cfg.secagg != "off":
+        from fedml_tpu.robust import AdmissionPipeline, TrustTracker
+        from fedml_tpu.secure.protocol import (SecAggClient, SecAggServer,
+                                               masked_template)
+        # the weight normalizer every silo and server must agree on:
+        # each silo masks n_i/weight_cap <= 1 so the ring budget holds;
+        # the normalizer cancels in the recovered sum/weight ratio
+        weight_cap = float(np.max(data.train["num_samples"]))
+        host_init = jax.tree.map(np.asarray, init)
+
+        def _masked_admission():
+            # the PRE-mask-removal screens: structural fingerprint vs
+            # the MASKED template + num_samples validation.  Norm
+            # screening moves to the post-unmask sum (the protocol's
+            # SumNormScreen) — a ciphertext norm is PRG noise.
+            return AdmissionPipeline(
+                masked_template(host_init), kind="masked",
+                max_num_samples=cfg.max_num_samples,
+                trust=TrustTracker(
+                    strikes_to_quarantine=cfg.strikes_to_quarantine,
+                    quarantine_rounds=cfg.quarantine_rounds,
+                    probation_rounds=cfg.probation_rounds))
+
+        def _secagg_server(node, noise_std):
+            return SecAggServer(
+                threshold=cfg.secagg_threshold, clip=cfg.secagg_clip,
+                weight_cap=weight_cap, norm_clip=cfg.norm_clip,
+                noise_std=noise_std, seed=cfg.seed,
+                norm_screen_k=cfg.norm_screen_k,
+                norm_screen_window=cfg.norm_screen_window,
+                norm_screen_min_history=cfg.norm_screen_min_history,
+                node=node)
+
+        make_silo_secagg = lambda g: SecAggClient(g)  # noqa: E731
+        if cfg.secagg == "pairwise":
+            secagg_root = _secagg_server("server", cfg.agg_noise_std)
+            admission = (_masked_admission()
+                         if cfg.admission != "off" else None)
+            defended = stream = None  # the ring fold replaces both
+        else:
+            # grouped: edges mask, the root stays plaintext.  The DP
+            # noise is injected ONCE, by the root's streaming finalize
+            # over the edge means — an edge-side injection would add
+            # E+1 draws and make grouped runs systematically noisier
+            # than flat ones (the plaintext edge topology's convention,
+            # mirrored: edges clip, the root alone adds noise)
+            make_edge_secagg = lambda node: _secagg_server(  # noqa: E731
+                node, 0.0)
 
     # multi-level aggregator topology (--edge_aggregators E): E edge
     # actors sit between the silos and the root, each folding its block
@@ -1042,7 +1110,8 @@ def run_cross_silo(cfg, data, mesh, sink):
             checkpointer=_make_checkpointer(cfg),
             publish=publish, extra_state=ef_extra,
             admission=admission, aggregate_fn=defended,
-            stream_agg=stream, perf=perf, health=health)
+            stream_agg=stream, perf=perf, health=health,
+            secagg=secagg_root)
         s.register_handlers()
         return s
 
@@ -1095,7 +1164,13 @@ def run_cross_silo(cfg, data, mesh, sink):
                 blocks = np.array_split(np.arange(1, n_silos + 1), n_edges)
                 for e, block in enumerate(blocks, start=1):
                     edge_admission = None
-                    if admission is not None:
+                    if make_edge_secagg is not None:
+                        # grouped masking: the edge screens CIPHERTEXT
+                        # (masked-template fingerprint + num_samples,
+                        # pre-mask-removal) with its own trust ledger
+                        if cfg.admission != "off":
+                            edge_admission = _masked_admission()
+                    elif admission is not None:
                         # each edge screens ITS silos with its own
                         # pipeline/trust ledger (PR 4 composes per-upload
                         # at the edge; the root's screen then sees the
@@ -1118,28 +1193,47 @@ def run_cross_silo(cfg, data, mesh, sink):
                         # per-edge statistics-only accumulator: the edge
                         # ships its compact rollup inside its per-round
                         # frame; the root's accumulator owns the
-                        # gauges, alarms, and the ledger
+                        # gauges, alarms, and the ledger.  Under grouped
+                        # masking the edge sees only ciphertext, so its
+                        # payload stats are suppressed BY NAME.
                         from fedml_tpu.obs import HealthAccumulator
                         edge_health = HealthAccumulator(
-                            kind="params", node=f"edge{e}", alarms=False)
+                            kind="params", node=f"edge{e}", alarms=False,
+                            suppress_payload=(
+                                "secagg_grouped_masking"
+                                if make_edge_secagg is not None else None))
                     # edge folds are plain clipped means — the robust
                     # rule and the DP noise run ONCE, at the root, over
-                    # the edge means
+                    # the edge means.  Under grouped masking the edge
+                    # instead runs the secure protocol for its block
+                    # (ring fold + unmask) and ships the plaintext
+                    # PARTIAL MEAN in the same one-frame-per-round format.
                     edges.append(EdgeAggregatorActor(
                         e, wrap(hub.transport(e)),
                         {n_edges + int(g): int(g) for g in block},
                         cohort_total=n_silos,
                         client_num_in_total=data.client_num,
-                        stream_agg=StreamingAggregator(
-                            init, method="mean", kind="params",
-                            norm_clip=cfg.norm_clip, seed=cfg.seed),
+                        stream_agg=(None if make_edge_secagg is not None
+                                    else StreamingAggregator(
+                                        init, method="mean", kind="params",
+                                        norm_clip=cfg.norm_clip,
+                                        seed=cfg.seed)),
                         admission=edge_admission,
                         health=edge_health,
+                        secagg=(make_edge_secagg(f"edge{e}")
+                                if make_edge_secagg is not None else None),
                         # the edge must flush its partial fold BEFORE
                         # the root's round timer fires, or an on-time
                         # block is discarded with its one straggler —
-                        # half the root timeout leaves the flush margin
-                        timeout_s=timeout / 2 if timeout else None))
+                        # half the root timeout leaves the flush margin.
+                        # A MASKED edge runs up to three timed stages
+                        # (agreement / upload / unmask), so its per-stage
+                        # margin is a quarter: two stage timeouts still
+                        # land inside the root's window
+                        timeout_s=((timeout / 4
+                                    if make_edge_secagg is not None
+                                    else timeout / 2)
+                                   if timeout else None)))
                     for g in block:
                         edge_of[int(g)] = e
             silos = [FedAvgClientActor(
@@ -1149,7 +1243,10 @@ def run_cross_silo(cfg, data, mesh, sink):
                          on_accepted=make_on_accepted(g),
                          heartbeat_interval_s=(cfg.heartbeat_s or None)
                          if chaos_on else None,
-                         server_id=edge_of.get(g, 0))
+                         server_id=edge_of.get(g, 0),
+                         # masking identity = the TRANSPORT id (the group
+                         # lists in sync frames are transport ids)
+                         secagg=make_silo_secagg(n_edges + g))
                      for g in range(1, n_silos + 1)]
             if not chaos_on:
                 for a in edges + silos:
@@ -1541,6 +1638,78 @@ def main(argv=None) -> Dict[str, Any]:
     if cfg.error_feedback and cfg.wire_compression == "none":
         raise ValueError("--error_feedback requires --wire_compression "
                          "topk or int8")
+    # secure aggregation (secure/protocol.py): every incompatible combo
+    # fails AT CONFIG TIME — a silently-ignored privacy flag would label
+    # plaintext traffic as masked, the worst possible mislabel
+    if cfg.secagg not in ("off", "pairwise", "grouped"):
+        raise ValueError(f"--secagg must be off|pairwise|grouped, "
+                         f"got {cfg.secagg!r}")
+    if cfg.secagg != "off":
+        if cfg.algo != "cross_silo":
+            raise ValueError(
+                f"--secagg is the sync-barrier secure-aggregation protocol "
+                f"and applies to --algo cross_silo only; --algo {cfg.algo} "
+                f"(including async_fl, whose per-upload staleness discounts "
+                f"need plaintext individual deltas) would silently train "
+                f"unmasked and label the run as private")
+        if cfg.wire_compression != "none" or cfg.error_feedback:
+            raise ValueError(
+                "--secagg and --wire_compression/--error_feedback are "
+                "mutually exclusive: a compressed/EF payload cannot ride "
+                "the uint32 masking ring (masks must cancel word-for-word)")
+        if cfg.robust_agg != "mean":
+            raise ValueError(
+                f"--secagg hides individual uploads by construction, so "
+                f"order-statistic rules (--robust_agg {cfg.robust_agg}) "
+                f"have no population to rank; the defenses that compose "
+                f"are the pre-mask structure/num_samples screens and the "
+                f"post-unmask sum screen + --norm_clip/--agg_noise_std "
+                f"on the sum")
+        if cfg.agg_mode != "stream":
+            raise ValueError(
+                "--secagg folds masked uploads in the uint32 ring at "
+                "arrival — there is no stack path; pass --agg_mode stream")
+        if cfg.silo_backend != "local":
+            raise ValueError("--secagg deploys over the local hub only "
+                             "for now (the actors are transport-agnostic; "
+                             "gRPC wiring mirrors the flat one)")
+        if cfg.secagg == "grouped" and cfg.edge_aggregators < 1:
+            raise ValueError(
+                "--secagg grouped scopes masking per edge block and needs "
+                "--edge_aggregators E >= 1; for a single cohort-wide "
+                "masking group use --secagg pairwise")
+        if cfg.secagg == "pairwise" and cfg.edge_aggregators > 0:
+            raise ValueError(
+                "--secagg pairwise masks across the WHOLE cohort, which an "
+                "edge cannot partially unmask (cross-block pair masks only "
+                "cancel in the root's full sum); use --secagg grouped with "
+                "--edge_aggregators")
+        if cfg.secagg == "grouped" \
+                and cfg.client_num_per_round < 2 * cfg.edge_aggregators:
+            raise ValueError(
+                f"--secagg grouped needs every edge block to hold >= 2 "
+                f"silos (a 1-silo 'masked sum' IS that silo's update): "
+                f"{cfg.client_num_per_round} silos over "
+                f"{cfg.edge_aggregators} edges leaves a short block")
+        if cfg.secagg == "pairwise" and cfg.client_num_per_round < 2:
+            raise ValueError("--secagg pairwise needs >= 2 silos per round")
+        if cfg.secagg_threshold == 1:
+            raise ValueError(
+                "--secagg_threshold 1 voids the privacy guarantee: one "
+                "share reconstructs every seed; the minimum is 2 (0 = "
+                "majority default)")
+        # the threshold is a PER-GROUP share count: a t larger than the
+        # masking group could never reconstruct, and silently clamping
+        # it would rewrite the dropout-tolerance contract the flag
+        # documents — fail here, where the group sizes are knowable
+        group_min = (cfg.client_num_per_round if cfg.secagg == "pairwise"
+                     else cfg.client_num_per_round // cfg.edge_aggregators)
+        if cfg.secagg_threshold > group_min:
+            raise ValueError(
+                f"--secagg_threshold {cfg.secagg_threshold} exceeds the "
+                f"smallest masking group ({group_min} silos"
+                f"{' per edge block' if cfg.secagg == 'grouped' else ''}): "
+                f"reconstruction could never gather that many shares")
     if cfg.serve_port > 0 and cfg.algo != "cross_silo":
         raise ValueError(
             "--serve_port starts the serve-while-train frontend, which is "

@@ -296,13 +296,26 @@ class HealthAccumulator:
                  thresholds: Optional[dict] = None,
                  starve_after: int = 3, alarms: bool = True,
                  sketch_coords: int = 1_000_000,
+                 suppress_payload: Optional[str] = None,
                  registry=None):
         """``sketch_coords``: past this many model coordinates the
         per-upload statistics ride a deterministic proportional-prefix
         coordinate sketch (`_sketch_f32`) instead of the full vector —
         bounding health work per upload at O(cap) for arbitrarily large
         models (0 = always exact).  Sketched norms are rescaled by
-        sqrt(total/m); cosines need no correction."""
+        sqrt(total/m); cosines need no correction.
+
+        ``suppress_payload``: a REASON string (e.g.
+        ``"secagg_pairwise_masking"``) that disables every payload-
+        derived statistic — update-norm moments and cosine alignment —
+        because the uploads are ciphertext and per-silo learning stats
+        are unavailable BY CONSTRUCTION (the privacy↔observability
+        trade of secure aggregation).  Fairness counters, participation,
+        and the round-over-round global delta norm (computed on the
+        published PLAINTEXT global) keep working, and every ledger line
+        carries a ``suppressed`` section NAMING the missing fields and
+        the reason — the observatory degrades honestly, never to a
+        silent zero that reads as 'perfectly aligned cohort'."""
         if kind not in ("params", "delta"):
             raise ValueError(f"kind must be 'params' or 'delta', got {kind!r}")
         if starve_after < 1:
@@ -318,6 +331,7 @@ class HealthAccumulator:
         self.starve_after = starve_after
         self.alarms_enabled = alarms
         self.sketch_coords = int(sketch_coords)
+        self.suppress_payload = suppress_payload
         if ledger_path:
             d = os.path.dirname(ledger_path)
             if d:
@@ -414,47 +428,54 @@ class HealthAccumulator:
         the admission pipeline already computed (`AdmissionVerdict.norm`)
         — passed through so the screen's one O(model) norm pass is the
         only one; computed here only when no screen ran."""
-        delta, scale = _sketch_f32(upload, self.sketch_coords)
-        if self.kind == "params":
-            if self._ref_vec is None:
-                raise RuntimeError("observe_admitted() before round_start(): "
-                                   "the round's update reference is not set")
-            delta = delta - self._ref_vec
+        delta = None
+        if self.suppress_payload is None:
+            delta, scale = _sketch_f32(upload, self.sketch_coords)
+            if self.kind == "params":
+                if self._ref_vec is None:
+                    raise RuntimeError(
+                        "observe_admitted() before round_start(): the "
+                        "round's update reference is not set")
+                delta = delta - self._ref_vec
+        # else: ciphertext upload — the payload-derived stats below are
+        # suppressed BY NAME in the ledger line; only the shared
+        # fairness/participation tail runs
         with self._lock:
-            dd = float(np.dot(delta, delta))
-            if norm is None:
-                # no screen ran: the norm is the sketch's rescaled
-                # estimate (exact below the sketch cap, scale == 1)
-                norm = math.sqrt(dd) * scale
-            norm = float(norm)
-            if math.isfinite(norm):
-                self._norms.push(norm)
             try:
                 w = float(weight)
             except (TypeError, ValueError):
                 w = 0.0
             if not math.isfinite(w) or w < 0:
                 w = 0.0
-            if self._dir_sum is None:
-                eff_w = w if w > 0 else 1.0
-                self._dir_sum = eff_w * delta
-                self._dir_sq = eff_w * eff_w * dd
-            else:
-                # one dot product against the O(model) running
-                # weighted-mean direction (cos is scale-invariant, so
-                # the un-normalized running SUM is the same direction);
-                # the same dot then advances the incremental ||sum||^2
-                sd = float(np.dot(delta, self._dir_sum))
-                denom = math.sqrt(max(dd, 0.0)) \
-                    * math.sqrt(max(self._dir_sq, 0.0))
-                if denom > 0 and math.isfinite(denom):
-                    cos = sd / denom
-                    if math.isfinite(cos):
-                        self._aligns.push(cos)
-                eff_w = w if w > 0 else 1.0
-                self._dir_sum += eff_w * delta
-                self._dir_sq += 2.0 * eff_w * sd + eff_w * eff_w * dd
-            self._dir_weight += w if w > 0 else 1.0
+            if delta is not None:
+                dd = float(np.dot(delta, delta))
+                if norm is None:
+                    # no screen ran: the norm is the sketch's rescaled
+                    # estimate (exact below the sketch cap, scale == 1)
+                    norm = math.sqrt(dd) * scale
+                norm = float(norm)
+                if math.isfinite(norm):
+                    self._norms.push(norm)
+                if self._dir_sum is None:
+                    eff_w = w if w > 0 else 1.0
+                    self._dir_sum = eff_w * delta
+                    self._dir_sq = eff_w * eff_w * dd
+                else:
+                    # one dot product against the O(model) running
+                    # weighted-mean direction (cos is scale-invariant, so
+                    # the un-normalized running SUM is the same direction);
+                    # the same dot then advances the incremental ||sum||^2
+                    sd = float(np.dot(delta, self._dir_sum))
+                    denom = math.sqrt(max(dd, 0.0)) \
+                        * math.sqrt(max(self._dir_sq, 0.0))
+                    if denom > 0 and math.isfinite(denom):
+                        cos = sd / denom
+                        if math.isfinite(cos):
+                            self._aligns.push(cos)
+                    eff_w = w if w > 0 else 1.0
+                    self._dir_sum += eff_w * delta
+                    self._dir_sq += 2.0 * eff_w * sd + eff_w * eff_w * dd
+                self._dir_weight += w if w > 0 else 1.0
             self._weight_total += w
             self._seen[int(silo)] = "accepted"
             rec = self._silo(int(silo))
@@ -552,6 +573,11 @@ class HealthAccumulator:
                                           | set(self._expected)
                                           | set(self._excluded))},
             }
+            if self.suppress_payload is not None:
+                # the named privacy↔observability trade: these fields ARE
+                # absent (count-0 summaries), and the line says why
+                line["suppressed"] = {"fields": ["norm", "alignment"],
+                                      "reason": self.suppress_payload}
             if self._stale.count:
                 line["staleness"] = self._stale.summary()
             if self._edges:

@@ -61,7 +61,13 @@ log = logging.getLogger(__name__)
 # one label)
 PHASES = ("broadcast_serialize", "straggler_wait", "staging", "fold",
           "admission", "health", "aggregate", "defended_aggregate",
-          "checkpoint", "publish")
+          "checkpoint", "publish",
+          # secure aggregation (secure/protocol.py): advert/roster relay
+          # time and the barrier-close share-reveal + reconstruction.
+          # Phase names are open vocabulary to every reader
+          # (trend.phase_medians keys on whatever a ledger carries), so
+          # pre-secagg ledgers keep validating and gating unchanged.
+          "mask_agreement", "unmask")
 
 
 # ---------------------------------------------------------------------------

@@ -294,8 +294,19 @@ class AdmissionPipeline:
                  norm_k: float = 6.0, norm_window: int = 64,
                  norm_min_history: int = 8,
                  trust: Optional[TrustTracker] = None):
-        if kind not in ("params", "delta"):
-            raise ValueError(f"kind must be 'params' or 'delta', got {kind!r}")
+        """``kind="masked"`` (secure aggregation, `secure/protocol.py`):
+        the template is the MASKED-payload structure
+        (`protocol.masked_template` — uint32 ring leaves + the masked
+        weight scalar), and only the screens that are meaningful on
+        ciphertext run: structural fingerprint and ``num_samples``
+        validation, PRE-mask-removal.  The norm screen is skipped by
+        construction — a masked blob's norm is PRG noise — and the
+        defense that replaces it is the server's POST-unmask sum screen
+        (`protocol.SecAggServer.finalize`).  Trust strikes and rejection
+        accounting work unchanged."""
+        if kind not in ("params", "delta", "masked"):
+            raise ValueError(f"kind must be 'params', 'delta', or "
+                             f"'masked', got {kind!r}")
         if max_num_samples < 0:
             raise ValueError(f"max_num_samples must be >= 0 (0 disables the "
                              f"cap), got {max_num_samples}")
@@ -381,6 +392,14 @@ class AdmissionPipeline:
         if not math.isfinite(n) or n <= 0 \
                 or (self.max_num_samples > 0 and n > self.max_num_samples):
             return self._reject(silo, round_idx, "bad_num_samples")
+        if self.kind == "masked":
+            # ciphertext: the finite guard is vacuous on uint32 ring
+            # words and a norm would measure PRG noise — the sum-level
+            # screens run post-unmask instead (protocol.SecAggServer)
+            self.admitted += 1
+            self._c_admitted.inc()
+            self.trust.record_clean(silo, round_idx)
+            return AdmissionVerdict(True, num_samples=n, norm=None)
         if not _all_finite(upload):
             return self._reject(silo, round_idx, "nonfinite")
         norm = (_update_norm(upload, self._reference_leaves(global_params))

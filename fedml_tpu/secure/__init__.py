@@ -15,6 +15,11 @@ Two layers here:
   masking in the ring Z_2^32 (uint32 wraparound — mod arithmetic for free,
   the construction of practical SecAgg), so the masked cohort sum is a plain
   `lax.psum` inside the jit round program; masks cancel exactly.
+- `fedml_tpu.secure.protocol` — the LIVE round protocol: the same ring
+  masking spoken over `Message`/`Transport` between real actors (mask
+  agreement with Shamir-shared seeds, masked uploads, ring fold at
+  arrival, unmask with dropout recovery) — `--secagg {pairwise,grouped}`
+  on the cross-silo path.
 """
 
 from fedml_tpu.secure.field import (
@@ -23,14 +28,19 @@ from fedml_tpu.secure.field import (
     additive_shares, pk_gen, key_agreement,
 )
 from fedml_tpu.secure.pallas_mask import fused_quantize_mask
+from fedml_tpu.secure.protocol import (SecAggClient, SecAggError,
+                                       SecAggServer, masked_template)
 from fedml_tpu.secure.secagg import (
-    quantize, dequantize, pairwise_masks, SecureCohortAggregator,
+    quantize, dequantize, pairwise_masks, ring_budget_scale,
+    validate_ring_budget, SecureCohortAggregator,
 )
 
 __all__ = [
     "mod_inv", "mod_div", "prod_mod", "lagrange_coeffs", "bgw_encode",
     "bgw_decode", "lcc_encode", "lcc_decode", "lcc_encode_with_points",
     "lcc_decode_with_points", "additive_shares", "pk_gen", "key_agreement",
-    "quantize", "dequantize", "pairwise_masks", "SecureCohortAggregator",
+    "quantize", "dequantize", "pairwise_masks", "ring_budget_scale",
+    "validate_ring_budget", "SecureCohortAggregator",
     "fused_quantize_mask",
+    "SecAggClient", "SecAggServer", "SecAggError", "masked_template",
 ]

@@ -21,12 +21,59 @@ clip range and scale.
 
 from __future__ import annotations
 
-from typing import Any
+import logging
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
 Pytree = Any
+
+log = logging.getLogger(__name__)
+
+# the uint32 ring holds signed fixed-point values in ±2^31; the COHORT SUM
+# must stay inside that, not just each update
+RING_CAPACITY = 2.0**31
+
+
+def ring_budget_scale(num_clients: int, clip: float) -> float:
+    """Largest power-of-two fixed-point scale whose worst-case cohort sum
+    cannot wrap the uint32 ring: ``num_clients * clip * scale < 2^31``.
+
+    Each masked contribution is clipped to ±clip BEFORE quantization, so
+    N clients all saturating the clip sum to N*clip — the wrap boundary
+    the per-update quantize range used to ignore (every aggregate beyond
+    it silently flipped sign).  Deriving the scale from the cohort size
+    makes the budget structural instead of a caller obligation."""
+    if num_clients < 1:
+        raise ValueError(f"num_clients must be >= 1, got {num_clients}")
+    if clip <= 0:
+        raise ValueError(f"clip must be > 0, got {clip}")
+    import math
+    scale = 2.0 ** math.floor(math.log2(RING_CAPACITY / (num_clients * clip)))
+    while num_clients * clip * scale >= RING_CAPACITY:  # boundary guard
+        scale /= 2.0
+    if scale < 1.0:
+        raise ValueError(
+            f"no usable fixed-point scale: {num_clients} clients at "
+            f"clip={clip} already exceed the uint32 ring capacity")
+    return scale
+
+
+def validate_ring_budget(num_clients: int, clip: float,
+                         scale: float) -> None:
+    """Fail loudly when a cohort sum can wrap the ring: the satellite bug
+    (ISSUE 11) — quantize's fixed-point range is per-update, but N
+    clipped updates sum to N*clip, and a wrapped sum dequantizes to a
+    silently-corrupted aggregate (sign-flipped, not noisy)."""
+    if num_clients * clip * scale >= RING_CAPACITY:
+        raise ValueError(
+            f"uint32 ring budget exceeded: num_clients={num_clients} * "
+            f"clip={clip} * scale={scale} = "
+            f"{num_clients * clip * scale:.3g} >= 2^31 — the cohort sum "
+            f"can wrap and corrupt the aggregate.  Lower scale/clip or "
+            f"pass scale=None to auto-derive it from the cohort size "
+            f"(ring_budget_scale gives {ring_budget_scale(num_clients, clip)})")
 
 
 def quantize(tree: Pytree, scale: float = 2.0**16,
@@ -85,7 +132,7 @@ class SecureCohortAggregator:
     whether the sum is a stacked ``sum(axis=0)`` (single chip) or a
     ``lax.psum`` over the cohort mesh axis — masks cancel in either."""
 
-    def __init__(self, num_clients: int, scale: float = 2.0**16,
+    def __init__(self, num_clients: int, scale: Optional[float] = None,
                  clip: float = 2.0**14, backend: str = "xla"):
         """``backend="pallas"`` fuses quantize+mask into one VMEM pass per
         block with an in-kernel counter PRG (fedml_tpu.secure.pallas_mask)
@@ -94,9 +141,21 @@ class SecureCohortAggregator:
         one or masks won't cancel.  Note the pallas stream is a 64-bit-keyed
         hash PRG (architecture demo), not the threefry PRF of the XLA path —
         see the pallas_mask module docstring before using it for real
-        privacy."""
+        privacy.
+
+        ``scale=None`` (default) derives the fixed-point scale from the
+        cohort size so the worst-case cohort sum (every client's clipped
+        contribution at ±clip) cannot wrap the uint32 ring; an explicit
+        scale that CAN wrap is rejected at construction instead of
+        corrupting an aggregate mid-federation (`validate_ring_budget`)."""
         if backend not in ("xla", "pallas"):
             raise ValueError(f"unknown secagg backend {backend!r}")
+        if scale is None:
+            scale = ring_budget_scale(num_clients, clip)
+            log.debug("secagg: auto-derived scale %g for %d clients at "
+                      "clip %g", scale, num_clients, clip)
+        else:
+            validate_ring_budget(num_clients, clip, scale)
         self.num_clients = num_clients
         self.scale = scale
         self.clip = clip

@@ -99,6 +99,19 @@ EXPECTED = {
     "fedml_dev_achieved_flops_value",
     "fedml_perf_mfu_ratio",
     "fedml_slo_device_mem_utilization_ratio",
+    # PR 11: live secure aggregation (secure/protocol.py) — masked
+    # uploads folded in the ring, share-envelope frames (adverts +
+    # reveals), Shamir reconstructions at unmask (labeled self_mask /
+    # pair_key), agreement/unmask wall time, and the post-unmask sum
+    # screen's discard counter
+    "fedml_secagg_masked_uploads_total",
+    "fedml_secagg_share_frames_total",
+    "fedml_secagg_share_envelopes_total",
+    "fedml_secagg_unmask_reconstructions_total",
+    "fedml_secagg_rounds_total",
+    "fedml_secagg_sum_rejected_total",
+    "fedml_secagg_agreement_seconds",
+    "fedml_secagg_unmask_seconds",
 }
 
 

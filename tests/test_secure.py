@@ -203,6 +203,53 @@ class TestTurboAggregate:
         assert err < 1e-3
 
 
+class TestRingBudget:
+    """ISSUE 11 satellite: quantize's fixed-point range is per-update,
+    but the cohort sum of N clipped clients reaches N*clip — beyond
+    ±2^31/scale the uint32 sum silently wraps and the aggregate decodes
+    sign-flipped.  The budget is now validated at aggregator
+    construction (fail loudly) or the scale auto-derives from the
+    cohort size."""
+
+    def test_explicit_scale_at_wrap_boundary_rejected(self):
+        # 4 * 2^14 * 2^15 = 2^31 exactly: a full-clip cohort wraps
+        with pytest.raises(ValueError, match="ring budget"):
+            SecureCohortAggregator(4, scale=2.0**15, clip=2.0**14)
+
+    def test_explicit_scale_below_boundary_accepted(self):
+        agg = SecureCohortAggregator(4, scale=2.0**14, clip=2.0**14)
+        assert agg.scale == 2.0**14
+
+    def test_auto_scale_survives_full_clip_saturation(self):
+        """The exact input that silently wrapped under the old default
+        (scale 2^16): every weighted value saturates the clip, so the
+        ring sum is N*clip*scale.  Auto-derived scale keeps it inside
+        ±2^31 and the aggregate decodes correctly instead of
+        sign-flipped."""
+        C, clip = 4, 2.0**14
+        agg = SecureCohortAggregator(C, clip=clip)  # scale auto-derived
+        assert C * clip * agg.scale < 2.0**31
+        # equal weights; every client's value is C*clip so the weighted
+        # value (x * 1/C) sits exactly AT the clip — the historical wrap
+        updates = {"w": jnp.full((C, 8), C * clip, jnp.float32)}
+        num = jnp.ones(C)
+        out = agg.aggregate_stacked(updates, num, jax.random.key(0))
+        # true sum of clipped weighted values = C * clip (all positive);
+        # a wrapped ring would decode this hugely NEGATIVE
+        np.testing.assert_allclose(np.asarray(out["w"]), C * clip,
+                                   rtol=1e-6)
+
+    def test_ring_budget_helpers(self):
+        from fedml_tpu.secure.secagg import (ring_budget_scale,
+                                             validate_ring_budget)
+        s = ring_budget_scale(8, 2.0**14)
+        assert 8 * 2.0**14 * s < 2.0**31
+        assert 8 * 2.0**14 * (s * 2) >= 2.0**31  # largest power of two
+        validate_ring_budget(8, 2.0**14, s)  # no raise
+        with pytest.raises(ValueError, match="ring budget"):
+            validate_ring_budget(8, 2.0**14, s * 2)
+
+
 class TestReviewRegressions:
     def test_no_ring_overflow_with_large_sample_counts(self):
         """Normalized-weight masking: huge sample counts must not wrap the
