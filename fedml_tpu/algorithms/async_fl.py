@@ -97,7 +97,10 @@ class AsyncFedServerActor(ServerManager):
                  stream_agg=None,
                  encode_once: bool = True,
                  perf=None,
-                 health=None):
+                 health=None,
+                 extra_state: Optional[tuple] = None,
+                 journal=None,
+                 faultline=None):
         """``checkpointer``: a `RoundCheckpointer`; every applied version
         is saved per its ``save_every`` gating and ``start()`` resumes
         from the latest saved version — a crashed async server restarts
@@ -158,7 +161,29 @@ class AsyncFedServerActor(ServerManager):
         delta against the version's running mean direction, per-silo
         staleness), so the buffer-held metadata tuples stay the only
         per-upload state.  One ``health.jsonl`` line per applied
-        version; rejected/malformed uploads tick fairness counters."""
+        version; rejected/malformed uploads tick fairness counters.
+
+        ``extra_state``: a ``(get_fn, set_fn)`` pair folding extra
+        cross-version state into every version checkpoint (the sync
+        server's PR 3 hook, mirrored): ``get_fn()`` returns a
+        FIXED-SHAPE host pytree saved beside params, ``set_fn(tree)``
+        restores it on resume.  The runner persists the admission
+        `TrustTracker` through it so a resumed server keeps strikes,
+        quarantine sentences, and probation clocks.
+
+        ``journal``: a `fedml_tpu.utils.journal.RoundJournal` — the
+        async twin of the sync server's mid-round crash consistency:
+        each admitted delta's fold journals a crash-safe metadata
+        record (carrying its base version, so the buffer rebuilds) and
+        the streaming-MEAN fold state snapshots atomically on the
+        journal's cadence.  A server killed mid-version resumes the
+        SAME version — the durable fold prefix and buffer metadata
+        restore, and only silos outside the restored buffer re-task.
+        Requires ``stream_agg``.
+
+        ``faultline``: a `fedml_tpu.robust.faultline.Faultline` — the
+        seeded process-kill injector (test/soak only); the version loop
+        is threaded with the named crash points."""
         super().__init__(0, transport)
         if not 1 <= aggregation_goal <= n_silos:
             raise ValueError(
@@ -199,6 +224,14 @@ class AsyncFedServerActor(ServerManager):
         self.encode_once = encode_once
         self.perf = perf
         self.health = health
+        self.extra_state = extra_state
+        if journal is not None and stream_agg is None:
+            raise ValueError(
+                "journal (crash consistency) rides the streaming-fold "
+                "receive path: pass --agg_mode stream; the stacked delta "
+                "buffer has no incremental fold state to snapshot")
+        self.journal = journal
+        self.faultline = faultline
         if health is not None:
             # no per-version barrier set exists — the silo universe is
             # the fairness denominator from version 0.  The starvation
@@ -254,12 +287,27 @@ class AsyncFedServerActor(ServerManager):
         if self.checkpointer is not None:
             step = self.checkpointer.latest_round()
             if step is not None:
-                state = self.checkpointer.restore(
-                    step, like=self._checkpoint_state())
+                try:
+                    state = self.checkpointer.restore(
+                        step, like=self._checkpoint_state())
+                except ValueError:
+                    # schema drift on the optional "extra" leaf (a
+                    # pre-trust checkpoint resumed with admission on, or
+                    # the reverse): restore untemplated and take what's
+                    # there — the sync server's convention
+                    log.warning("checkpoint %d does not match the "
+                                "current state schema; restoring "
+                                "untemplated", step)
+                    state = self.checkpointer.restore(step)
                 self.params = state["params"]
                 self.version = int(np.asarray(state["version"]))
+                if self.extra_state is not None and "extra" in state:
+                    self.extra_state[1](state["extra"])
                 log.info("resumed from checkpoint: continuing at version "
                          "%d of %d", self.version, self.num_versions)
+        resume = None
+        if self.journal is not None:
+            resume = self._journal_recovery()
         if self.version >= self.num_versions:
             for silo in range(1, self.n_silos + 1):
                 self.send(MsgType.S2C_FINISH, silo)
@@ -274,6 +322,28 @@ class AsyncFedServerActor(ServerManager):
             self.stream_agg.reset(self.params)
         if self.perf is not None:
             self.perf.round_start(self.version)
+        buffered: Set[int] = set()
+        if resume is not None:
+            # continue the crashed version: the durable fold prefix and
+            # the buffer's metadata tuples restore; those silos are NOT
+            # re-tasked (re-tasking them would double-count their
+            # version-v deltas — the at-most-once set died with the
+            # process)
+            with self._perf_phase("journal"):
+                self.stream_agg.load_state_dict(resume.state)
+                for silo, weight, extra in resume.folded:
+                    base = int(extra.get("base", self.version))
+                    staleness = self.version - base
+                    discount = float(1.0 + staleness) ** (-self.alpha)
+                    self._buffer.append((None, float(weight), discount,
+                                         int(silo), base))
+                    buffered.add(int(silo))
+                # re-arms the journal's round state so the resumed
+                # version keeps snapshotting on its cadence
+                self.journal.note_resume(self.version, resume.folded,
+                                         global_crc=resume.global_crc)
+        else:
+            self._journal_round_start()
         if self.health is not None:
             with self._perf_phase("health"):
                 self.health.round_start(self.version, self._host_params())
@@ -283,7 +353,8 @@ class AsyncFedServerActor(ServerManager):
         with self._root_span("tasking", f"version{self.version}",
                              version=self.version):
             assignments = {silo: int(client_idx) for silo, client_idx
-                           in enumerate(ids, start=1)}
+                           in enumerate(ids, start=1)
+                           if silo not in buffered}
             # stamp only the silos actually tasked: sample_clients caps
             # the wave at client_num_in_total, and priming the watchdog
             # clock for an untasked silo would make it re-task silos the
@@ -293,6 +364,10 @@ class AsyncFedServerActor(ServerManager):
             with self._perf_phase("broadcast_serialize"):
                 self._task_wave(assignments, MsgType.S2C_INIT)
         self._arm_retask_timer()
+        if self._buffer and len(self._buffer) >= self._effective_goal():
+            # the restored buffer already satisfies the goal (the crash
+            # hit between goal-reached and the version close): apply now
+            self._apply_buffer()
 
     # -- liveness watchdog --------------------------------------------------
     def _arm_retask_timer(self) -> None:
@@ -370,8 +445,68 @@ class AsyncFedServerActor(ServerManager):
     def _checkpoint_state(self) -> dict:
         """Version-state pytree (fixed shapes — doubles as the orbax
         restore template)."""
-        return {"params": self._host_params(),
-                "version": np.asarray(self.version, np.int64)}
+        out = {"params": self._host_params(),
+               "version": np.asarray(self.version, np.int64)}
+        if self.extra_state is not None:
+            out["extra"] = self.extra_state[0]()
+        return out
+
+    def _journal_round_start(self) -> None:
+        """Open the new version in the journal (mode/resumability from
+        the fold regime; the global crc pins the tasking reference the
+        fold must resume against)."""
+        if self.journal is None:
+            return
+        from fedml_tpu.utils.journal import tree_crc
+        with self._perf_phase("journal"):
+            self.journal.round_start(
+                self.version, mode=f"stream_{self.stream_agg.method}",
+                resumable=self.stream_agg.method == "mean",
+                global_crc=tree_crc(self._host_params()))
+
+    def _journal_recovery(self):
+        """The async twin of the sync server's recovery gate: resume the
+        open version only when it is exactly the checkpoint's next
+        version, its fold regime is resumable, the tasking global
+        matches, and a durable snapshot exists — otherwise abandon
+        loudly and restart the version from the boundary."""
+        from fedml_tpu.utils.journal import tree_crc
+        rec = self.journal.recover()
+        if rec is None:
+            return None
+        if rec.round_idx != self.version:
+            log.warning("journal holds mid-flight version %d but the "
+                        "checkpoint boundary resumes at version %d; "
+                        "abandoning the journal version",
+                        rec.round_idx, self.version)
+            self.journal.abandon(rec.round_idx, "version mismatch")
+            return None
+        if not rec.resumable:
+            log.error("version %d crashed mid-flight in non-resumable "
+                      "mode %r (reservoir rules have no durable draw "
+                      "stream); restarting the version from the boundary",
+                      rec.round_idx, rec.mode)
+            self.journal.abandon(rec.round_idx,
+                                 f"non-resumable mode {rec.mode}")
+            return None
+        if rec.global_crc is not None \
+                and rec.global_crc != tree_crc(self._host_params()):
+            log.error("version %d journal opened against a different "
+                      "global (crc mismatch); refusing to resume the "
+                      "fold", rec.round_idx)
+            self.journal.abandon(rec.round_idx, "global crc mismatch")
+            return None
+        if rec.state is None or not rec.folded:
+            log.warning("version %d crashed before any durable fold "
+                        "snapshot; re-tasking every silo from the "
+                        "boundary", rec.round_idx)
+            self.journal.abandon(rec.round_idx, "no durable snapshot")
+            return None
+        log.warning("version %d: resuming MID-VERSION from the journal — "
+                    "%d delta(s) durably folded (silos %s) rebuild the "
+                    "buffer and will not be re-tasked", rec.round_idx,
+                    len(rec.folded), [s for s, _, _ in rec.folded])
+        return rec
 
     # -- aggregation -------------------------------------------------------
     def _on_model(self, msg: Message) -> None:
@@ -434,6 +569,11 @@ class AsyncFedServerActor(ServerManager):
                     with self._perf_phase("health"):
                         self.health.observe_rejected(msg.sender_id,
                                                      verdict.reason)
+                if self.journal is not None:
+                    with self._perf_phase("journal"):
+                        self.journal.note_accept(
+                            self.version, msg.sender_id, 0.0,
+                            folded=False, reason=verdict.reason)
                 if crc is None:
                     crc = _payload_crc(delta)
                 self._rejected_crcs.setdefault(pair, set()).add(crc)
@@ -473,6 +613,10 @@ class AsyncFedServerActor(ServerManager):
                 self.health.observe_admitted(msg.sender_id, delta,
                                              num_samples, norm=delta_norm,
                                              staleness=staleness)
+        if self.faultline is not None:
+            self.faultline.maybe_crash("post_admission_pre_fold",
+                                       round_idx=self.version,
+                                       silo=msg.sender_id)
         if self.stream_agg is not None:
             # fold at arrival: the buffer keeps only the metadata tuple
             # (weights/discounts/at-most-once bookkeeping) — the delta's
@@ -480,6 +624,20 @@ class AsyncFedServerActor(ServerManager):
             with self._perf_phase("fold"):
                 self.stream_agg.fold(delta, num_samples)
             delta = None
+            if self.journal is not None:
+                # the base version rides the record so a resumed server
+                # rebuilds the buffer tuple (staleness discount included)
+                state_fn = (self.stream_agg.state_dict
+                            if self.stream_agg.method == "mean" else None)
+                with self._perf_phase("journal"):
+                    self.journal.note_accept(
+                        self.version, msg.sender_id, float(num_samples),
+                        extra={"base": int(base_version)},
+                        state_fn=state_fn)
+        if self.faultline is not None:
+            self.faultline.maybe_crash("post_fold_pre_ack",
+                                       round_idx=self.version,
+                                       silo=msg.sender_id)
         self._buffer.append(
             (delta, num_samples, discount, msg.sender_id, base_version))
         if len(self._buffer) >= self._effective_goal():
@@ -548,6 +706,9 @@ class AsyncFedServerActor(ServerManager):
         return max(1, min(self.goal, active))
 
     def _apply_buffer(self) -> None:
+        if self.faultline is not None:
+            self.faultline.maybe_crash("barrier_close",
+                                       round_idx=self.version)
         now = time.monotonic()
         if self._version_t0 is not None:
             self._h_version.observe(now - self._version_t0)
@@ -648,11 +809,23 @@ class AsyncFedServerActor(ServerManager):
             self._rejected_crcs = {p: c for p, c in
                                    self._rejected_crcs.items()
                                    if p[1] >= horizon}
+        if self.faultline is not None:
+            self.faultline.maybe_crash("mid_checkpoint_write",
+                                       round_idx=self.version - 1)
         if self.checkpointer is not None:
             with self._perf_phase("checkpoint"):
                 self.checkpointer.maybe_save(
                     self.version - 1, self._checkpoint_state(),
                     last_round=self.version >= self.num_versions)
+        if self.journal is not None:
+            # after the checkpoint is durable (the sync server's
+            # ordering): a crash between the two re-finalizes the
+            # version from the journal snapshot on resume
+            with self._perf_phase("journal"):
+                self.journal.round_end(self.version - 1)
+        if self.faultline is not None:
+            self.faultline.maybe_crash("publish",
+                                       round_idx=self.version - 1)
         if self.perf is not None:
             # close the applied version's ledger line (strict-mode
             # RecompileError raises here, on the event loop) BEFORE the
@@ -674,6 +847,10 @@ class AsyncFedServerActor(ServerManager):
             # belongs to no line) and before the tasking wave, so the
             # wave's serialize is its first phase
             self.perf.round_start(self.version)
+        # the journal opens the next version BEFORE the tasking wave: a
+        # delta can arrive the moment the wave lands, and its accept
+        # record must fall inside an open round
+        self._journal_round_start()
         if self.health is not None:
             with self._perf_phase("health"):
                 self.health.round_start(self.version, self._host_params())

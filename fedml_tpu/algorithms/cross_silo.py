@@ -162,7 +162,9 @@ class FedAvgServerActor(ServerManager):
                  incremental_staging: bool = True,
                  perf=None,
                  health=None,
-                 secagg=None):
+                 secagg=None,
+                 journal=None,
+                 faultline=None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -289,6 +291,31 @@ class FedAvgServerActor(ServerManager):
         with ``aggregate_fn`` — the stack path stays behind
         ``--agg_mode stack`` for equivalence pinning (the ``mean``
         results are bit-identical; tests/test_stream_agg.py).
+
+        ``journal``: a `fedml_tpu.utils.journal.RoundJournal` — crash
+        consistency for the round IN FLIGHT (the checkpointer covers
+        round boundaries).  Every report appends a crash-safe metadata
+        record on the receive path, and on the resumable path (the
+        streaming MEAN fold) the fold state snapshots atomically every
+        ``snapshot_every`` folds — so a server killed mid-round resumes
+        the SAME round, re-tasks only the silos whose uploads were not
+        durably folded, and finishes with a global bit-identical to the
+        uncrashed run (deterministic silos re-train the same bytes; the
+        sequential fold preserves order; pinned in
+        tests/test_crash_recovery.py).  Secagg rounds journal as
+        ``resumable=False`` — resuming a half-masked ring fold would
+        require self-mask shares nobody agreed to reveal — and recovery
+        restarts them loudly from the boundary with the global
+        unchanged; reservoir (order-statistic) stream rounds are
+        likewise abort-only.  Requires ``stream_agg`` or ``secagg``:
+        the stack path has no incremental fold state to snapshot.
+
+        ``faultline``: a `fedml_tpu.robust.faultline.Faultline` — the
+        seeded process-kill injector (test/soak only).  The round loop
+        is threaded with the named crash points
+        (`faultline.CRASH_POINTS`); an armed faultline raises
+        `ActorKilled` (a BaseException — no receive-path guard survives
+        it) out of the event loop with zero cleanup, emulating kill -9.
         """
         super().__init__(0, transport)
         if straggler_policy not in ("wait", "drop", "abort"):
@@ -335,6 +362,16 @@ class FedAvgServerActor(ServerManager):
         self.incremental_staging = incremental_staging
         self.perf = perf
         self.health = health
+        if journal is not None and stream_agg is None and secagg is None:
+            raise ValueError(
+                "journal (crash consistency) rides the streaming-fold "
+                "receive path: pass --agg_mode stream (or --secagg); the "
+                "stack path has no incremental fold state to snapshot")
+        self.journal = journal
+        self.faultline = faultline
+        # a mid-round recovery found by start(): consumed by the next
+        # _broadcast of the matching round
+        self._pending_resume = None
         self.dropped_silos: Dict[int, list] = {}  # round -> missing silo ids
         self._received: Dict[int, tuple] = {}
         # per-round host mirror of self.params: the broadcast, checkpoint,
@@ -426,6 +463,12 @@ class FedAvgServerActor(ServerManager):
                     self.publish(self._host_params(), self.round_idx - 1)
                 log.info("resumed from checkpoint: continuing at round %d "
                          "of %d", self.round_idx, self.num_rounds)
+        if self.journal is not None:
+            # mid-round recovery: the journal may hold a round the crash
+            # interrupted BETWEEN the checkpoint boundary and its
+            # round_end — restore its durable fold prefix (or abandon it
+            # loudly when the mode/round/global forbid resuming)
+            self._pending_resume = self._journal_recovery()
         if self.round_idx >= self.num_rounds:
             # the federation already completed on disk: just dismiss silos
             cohort = len(sample_clients(0, self.client_num_in_total,
@@ -435,6 +478,63 @@ class FedAvgServerActor(ServerManager):
             self.finish()
             return
         self._broadcast(MsgType.S2C_INIT)
+
+    def _journal_recovery(self):
+        """Inspect the journal for a round the crash left mid-flight.
+        Returns a `utils.journal.Recovery` ONLY when resuming is safe:
+        the open round is exactly the one the checkpoint boundary says
+        comes next, its mode is resumable (streaming mean — never a
+        half-masked secagg fold or a reservoir draw stream), its
+        opening-global crc matches the restored global (folding against
+        a different clip reference would mis-aggregate silently), and a
+        durable snapshot exists.  Everything else is ABANDONED loudly:
+        the round restarts from the boundary with the global unchanged —
+        lost work, never a mis-aggregated global."""
+        from fedml_tpu.utils.journal import tree_crc
+        rec = self.journal.recover()
+        if rec is None:
+            return None
+        if rec.round_idx != self.round_idx:
+            log.warning(
+                "journal holds mid-flight round %d but the checkpoint "
+                "boundary resumes at round %d (checkpoint cadence gap); "
+                "abandoning the journal round — rounds past the last "
+                "checkpoint re-run from the boundary (set "
+                "--checkpoint_every 1 for mid-round recovery)",
+                rec.round_idx, self.round_idx)
+            self.journal.abandon(rec.round_idx, "round mismatch")
+            return None
+        if not rec.resumable:
+            log.error(
+                "round %d crashed mid-flight in non-resumable mode %r "
+                "(secagg rounds are abort-only: resuming a half-masked "
+                "fold would require shares nobody agreed to reveal; "
+                "reservoir rules have no durable draw stream) — "
+                "restarting the round from the boundary, global "
+                "unchanged", rec.round_idx, rec.mode)
+            self.journal.abandon(rec.round_idx,
+                                 f"non-resumable mode {rec.mode}")
+            return None
+        if rec.global_crc is not None \
+                and rec.global_crc != tree_crc(self._host_params()):
+            log.error(
+                "round %d journal opened against a DIFFERENT global than "
+                "the restored checkpoint (crc mismatch); refusing to "
+                "resume the fold — restarting from the boundary",
+                rec.round_idx)
+            self.journal.abandon(rec.round_idx, "global crc mismatch")
+            return None
+        if rec.state is None or not rec.folded:
+            log.warning("round %d crashed before any durable fold "
+                        "snapshot; re-tasking the full cohort from the "
+                        "boundary", rec.round_idx)
+            self.journal.abandon(rec.round_idx, "no durable snapshot")
+            return None
+        log.warning("round %d: resuming MID-ROUND from the journal — %d "
+                    "upload(s) durably folded (silos %s) will not be "
+                    "re-tasked", rec.round_idx, len(rec.folded),
+                    [s for s, _, _ in rec.folded])
+        return rec
 
     def _sampled(self) -> np.ndarray:
         # deterministic per-round sampling, parity with
@@ -477,6 +577,16 @@ class FedAvgServerActor(ServerManager):
         # receive barrier must track the actual cohort size, not the config
         self._num_silos = len(ids)
         cohort = set(range(1, self._num_silos + 1))
+        # mid-round recovery (start() banked it): the durably-folded
+        # silos are NOT re-tasked — their uploads already live in the
+        # restored fold state — and they satisfy the barrier immediately
+        resume = None
+        if self._pending_resume is not None \
+                and self._pending_resume.round_idx == self.round_idx:
+            resume = self._pending_resume
+        self._pending_resume = None
+        folded = ({int(s): float(w) for s, w, _ in resume.folded}
+                  if resume is not None else {})
         dead: Set[int] = set()
         if self.failure_detector is not None:
             for silo in cohort:
@@ -535,7 +645,31 @@ class FedAvgServerActor(ServerManager):
             # stream mode: open the fold state against the new global
             # (the round's clip reference)
             self.stream_agg.reset(self.params)
+            if resume is not None:
+                # continue the crashed round's fold exactly where the
+                # last durable snapshot left it — the sequential mean
+                # fold is order-preserving, so prefix + re-trained
+                # suffix equals the uncrashed reduction bit for bit
+                with self._perf_phase("journal"):
+                    self.stream_agg.load_state_dict(resume.state)
+                    # note_resume re-arms the fresh journal instance's
+                    # round state (fold prefix included), so the resumed
+                    # round keeps snapshotting on its cadence
+                    self.journal.note_resume(self.round_idx, resume.folded,
+                                             global_crc=resume.global_crc)
         host_params = self._host_params()
+        if self.journal is not None and resume is None:
+            from fedml_tpu.utils.journal import tree_crc
+            if self.secagg is not None:
+                mode, resumable = "secagg", False
+            else:
+                mode = f"stream_{self.stream_agg.method}"
+                resumable = self.stream_agg.method == "mean"
+            with self._perf_phase("journal"):
+                self.journal.round_start(
+                    self.round_idx, mode=mode, resumable=resumable,
+                    global_crc=tree_crc(host_params),
+                    expected=sorted(self._expected))
         if self.health is not None:
             # the health round opens against the SAME host mirror the
             # broadcast ships — no extra device→host transfer; silos
@@ -568,7 +702,7 @@ class FedAvgServerActor(ServerManager):
                 per_silo = {
                     silo: {Message.ARG_CLIENT_INDEX: int(client_idx)}
                     for silo, client_idx in enumerate(ids, start=1)
-                    if silo not in dead}
+                    if silo not in dead and silo not in folded}
                 self.send_many(
                     msg_type, sorted(per_silo),
                     shared_params={Message.ARG_MODEL_PARAMS: host_params,
@@ -578,13 +712,28 @@ class FedAvgServerActor(ServerManager):
             else:
                 # seed path (wire_bench baseline): N full encodes
                 for silo, client_idx in enumerate(ids, start=1):
-                    if silo in dead:
+                    if silo in dead or silo in folded:
                         continue
                     self.send(msg_type, silo,
                               **{Message.ARG_MODEL_PARAMS: host_params,
                                  Message.ARG_CLIENT_INDEX: int(client_idx),
                                  Message.ARG_ROUND: self.round_idx, **extra})
+        if folded:
+            # the restored uploads satisfy the barrier like live reports
+            # (their bytes are already in the fold); a fully-durable
+            # round closes right here — the crash cost the federation
+            # nothing but the restart
+            for silo, weight in folded.items():
+                self._received[silo] = (self._STAGED, weight)
+            if self._barrier_met():
+                self._complete_round()
+                return
         self._arm_timer()
+
+    def _barrier_met(self) -> bool:
+        if self._expected:
+            return self._expected <= set(self._received)
+        return len(self._received) >= self._num_silos
 
     # -- straggler timer ----------------------------------------------------
     def _arm_timer(self) -> None:
@@ -788,6 +937,12 @@ class FedAvgServerActor(ServerManager):
         """Unmask the ring sum, run the post-unmask sum defenses, publish
         (or — on an unrecoverable round — keep the global and say so)."""
         from fedml_tpu.secure.protocol import SecAggError
+        if self.faultline is not None:
+            # shares collected, sum not yet recovered: the abort-only
+            # proof point — recovery must restart the round from the
+            # boundary with the global unchanged, never a partial unmask
+            self.faultline.maybe_crash("mid_unmask",
+                                       round_idx=self.round_idx)
         self._secagg_stage = None
         self._cancel_timer()
         quorum = self._secagg_quorum
@@ -980,6 +1135,11 @@ class FedAvgServerActor(ServerManager):
         per-leaf stacking at all.  In stream mode the upload FOLDS into
         the O(model) running aggregate here instead, and nothing
         model-sized survives the fold."""
+        if entry is not None and self.faultline is not None:
+            # admitted, not yet folded: the crash that loses exactly
+            # this one upload (its fold never happened)
+            self.faultline.maybe_crash("post_admission_pre_fold",
+                                       round_idx=self.round_idx, silo=silo)
         if entry is not None and self.secagg is not None:
             # ring addition IS the fold: the masked upload lands in the
             # O(model) uint32 accumulator at arrival (the PR 7 streaming
@@ -997,20 +1157,46 @@ class FedAvgServerActor(ServerManager):
                             "%d (%s)", self.round_idx, silo, e)
                 entry = None
             else:
+                if self.journal is not None:
+                    # metadata only — a masked fold never snapshots
+                    # (the round is journalled abort-only)
+                    with self._perf_phase("journal"):
+                        self.journal.note_accept(self.round_idx, silo,
+                                                 float(entry[1]))
                 entry = (self._STAGED, entry[1])
         elif entry is not None and self.stream_agg is not None:
             with self._perf_phase("fold"):
                 self.stream_agg.fold(entry[0], entry[1])
+            if self.journal is not None:
+                # the accept record is durable per report; the fold
+                # STATE snapshots on the journal's cadence (mean fold
+                # only — the journal ignores state_fn on abort-only
+                # rounds)
+                state_fn = (self.stream_agg.state_dict
+                            if self.stream_agg.method == "mean" else None)
+                with self._perf_phase("journal"):
+                    self.journal.note_accept(self.round_idx, silo,
+                                             float(entry[1]),
+                                             state_fn=state_fn)
             entry = (self._STAGED, entry[1])
         elif entry is not None and self._staging_active():
             with self._perf_phase("staging"):
                 self._stage(silo, entry[0])
             entry = (self._STAGED, entry[1])
+        elif entry is None and self.journal is not None:
+            # reported-but-inadmissible: journalled so the soak
+            # invariant checker can account every report
+            with self._perf_phase("journal"):
+                self.journal.note_accept(self.round_idx, silo, 0.0,
+                                         folded=False, reason="rejected")
+        if self.faultline is not None:
+            # folded (or recorded), report not yet banked: on resume the
+            # fold is durable up to the snapshot cadence and this silo
+            # re-tasks only past it
+            self.faultline.maybe_crash("post_fold_pre_ack",
+                                       round_idx=self.round_idx, silo=silo)
         self._received[silo] = entry
-        if self._expected:
-            if not self._expected <= set(self._received):
-                return
-        elif len(self._received) < self._num_silos:
+        if not self._barrier_met():
             return
         self._complete_round()
 
@@ -1098,6 +1284,9 @@ class FedAvgServerActor(ServerManager):
         return self._staging, w
 
     def _complete_round(self) -> None:
+        if self.faultline is not None:
+            self.faultline.maybe_crash("barrier_close",
+                                       round_idx=self.round_idx)
         self._cancel_timer()
         now = time.monotonic()
         self._h_quorum.observe(len(self._received))
@@ -1195,6 +1384,12 @@ class FedAvgServerActor(ServerManager):
                                       new_global=self._host_params(),
                                       quorum=quorum)
 
+        if self.faultline is not None:
+            # the aggregate is applied in memory but not yet durable:
+            # the recovery here re-finalizes the round from the journal
+            # snapshot (or re-runs it from the boundary)
+            self.faultline.maybe_crash("mid_checkpoint_write",
+                                       round_idx=self.round_idx)
         if self.checkpointer is not None:
             # thunk: rounds the save_every gate skips pay no device→host
             # copy and no EF serialization (_host_params memoizes the
@@ -1205,6 +1400,14 @@ class FedAvgServerActor(ServerManager):
                     lambda: self._checkpoint_state(
                         self.round_idx, host_params=self._host_params()),
                     last_round=self.round_idx + 1 >= self.num_rounds)
+        if self.journal is not None:
+            # round_end lands AFTER the checkpoint is durable: a crash
+            # between the two leaves an open journal round whose
+            # snapshot re-finalizes to the same global on resume
+            with self._perf_phase("journal"):
+                self.journal.round_end(self.round_idx)
+        if self.faultline is not None:
+            self.faultline.maybe_crash("publish", round_idx=self.round_idx)
         if self.publish is not None:
             # serve-while-train: hand the registry a HOST copy so the
             # serving path never holds references into device buffers the

@@ -326,7 +326,7 @@ class EdgeAggregatorActor:
                  cohort_total: int, client_num_in_total: int,
                  stream_agg, admission=None, root_id: int = 0,
                  timeout_s: Optional[float] = None, health=None,
-                 secagg=None):
+                 secagg=None, journal=None, faultline=None):
         """``health``: a `fedml_tpu.obs.health.HealthAccumulator`
         (statistics-only — ``alarms=False``, no ledger: the root owns
         verdicts); when set, the edge folds its silos' learning-health
@@ -343,7 +343,20 @@ class EdgeAggregatorActor:
         the recovered plaintext PARTIAL MEAN to the root in the existing
         one-frame-per-round format, so the root stays an UNMODIFIED
         `FedAvgServerActor` and mask-agreement traffic drops from
-        O(N²) to O(N²/E).  Mutually exclusive with ``stream_agg``."""
+        O(N²) to O(N²/E).  Mutually exclusive with ``stream_agg``.
+
+        ``journal``: a `fedml_tpu.utils.journal.RoundJournal` scoped to
+        THIS edge (its own directory) — the edge twin of the servers'
+        mid-round crash consistency.  The plaintext fold snapshots
+        durably (reference INCLUDED: a respawned edge has no live root
+        sync to re-learn the round global from), so `resume()` on a
+        rebuilt edge restores the fold mid-round and re-syncs only the
+        silos whose uploads were not durable.  Masked (secagg) edge
+        rounds journal abort-only: a respawned edge gives the round up
+        and the root's straggler policy closes over it.
+
+        ``faultline``: a `fedml_tpu.robust.faultline.Faultline` — the
+        seeded process-kill injector (test/soak only)."""
         from fedml_tpu.comm.actors import ClientManager, SelfMessageTimer
         from fedml_tpu.obs import telemetry
 
@@ -372,6 +385,8 @@ class EdgeAggregatorActor:
                                          self._on_secagg_shares)
 
         self.secagg = secagg
+        self.journal = journal
+        self.faultline = faultline
         self._mgr = _Mgr(node_id, transport)
         self.node_id = node_id
         self.silos = dict(silos)
@@ -406,6 +421,70 @@ class EdgeAggregatorActor:
     def transport(self):
         return self._mgr.transport
 
+    def resume(self) -> bool:
+        """Mid-round recovery for a RESPAWNED edge (the root never
+        re-syncs an edge it believes alive): restore the journal's open
+        round — the snapshot carries the round reference, the fold
+        state, and the durable fold list — re-sync only the silos whose
+        uploads were not durable, and flush immediately when everything
+        already folded.  Non-resumable rounds (masked, reservoir, no
+        snapshot) are given up: the edge stays silent and the root's
+        straggler policy closes over it like any dropped silo.  Returns
+        True when a mid-round recovery engaged."""
+        from fedml_tpu.comm.message import Message
+        if self.journal is None:
+            return False
+        rec = self.journal.recover()
+        if rec is None:
+            return False
+        if (not rec.resumable or rec.state is None or not rec.folded
+                or rec.state.get("reference") is None):
+            logger.warning(
+                "edge %d: round %d crashed mid-flight without a "
+                "resumable snapshot (mode=%s); giving the round up — "
+                "the root's straggler policy closes over this edge",
+                self.node_id, rec.round_idx, rec.mode)
+            self.journal.abandon(rec.round_idx, "not resumable on edge")
+            return False
+        from fedml_tpu.algorithms.cross_silo import MsgType
+        self.stream_agg.load_state_dict(rec.state)
+        self.round_idx = rec.round_idx
+        self._round_params = jax.tree.map(np.asarray,
+                                          self.stream_agg.reference)
+        self._flushed = False
+        self._received = {int(s) for s, _, _ in rec.folded}
+        # re-arms the journal's round state (fold prefix included) so
+        # the resumed block keeps snapshotting on its cadence
+        self.journal.note_resume(rec.round_idx, rec.folded,
+                                 global_crc=rec.global_crc)
+        if self.health is not None:
+            # health is soft state: the recovery round reopens with the
+            # fairness denominator intact; folded silos' payload stats
+            # are gone with the process (advisory, never load-bearing)
+            self.health.round_start(rec.round_idx, self._round_params,
+                                    expected=sorted(self.silos))
+        ids = sample_clients(rec.round_idx, self.client_num_in_total,
+                             self.cohort_total)
+        per_silo = {
+            silo: {Message.ARG_CLIENT_INDEX: int(ids[g - 1])}
+            for silo, g in sorted(self.silos.items())
+            if g - 1 < len(ids) and silo not in self._received}
+        logger.warning("edge %d: resuming round %d mid-round — %d fold(s) "
+                       "restored, re-syncing silos %s", self.node_id,
+                       rec.round_idx, len(self._received),
+                       sorted(per_silo))
+        if per_silo:
+            self._mgr.send_many(
+                MsgType.S2C_SYNC, sorted(per_silo),
+                shared_params={
+                    Message.ARG_MODEL_PARAMS: self._round_params,
+                    Message.ARG_ROUND: rec.round_idx},
+                per_receiver_params=per_silo)
+            self._arm_timer()
+        if self._received >= set(self.silos):
+            self._flush()
+        return True
+
     # -- root-facing side ----------------------------------------------------
     def _on_finish(self, msg) -> None:
         from fedml_tpu.algorithms.cross_silo import MsgType
@@ -424,6 +503,16 @@ class EdgeAggregatorActor:
         # the round's reference global, kept for the admission screen —
         # the edge's own handle, not a reach into stream_agg internals
         self._round_params = params
+        if self.journal is not None:
+            from fedml_tpu.utils.journal import tree_crc
+            self.journal.round_start(
+                round_idx,
+                mode=("secagg" if self.secagg is not None
+                      else f"stream_{self.stream_agg.method}"),
+                resumable=(self.secagg is None
+                           and self.stream_agg.method == "mean"),
+                global_crc=tree_crc(params),
+                expected=sorted(self.silos))
         shared_extra = {}
         if self.secagg is not None:
             # the edge IS the secagg server for its block: the re-
@@ -547,6 +636,9 @@ class EdgeAggregatorActor:
         mean to the root — the SAME one-frame-per-round format, so the
         root never knows its 'silo' spoke a masked protocol downstream."""
         from fedml_tpu.secure.protocol import SecAggError
+        if self.faultline is not None:
+            self.faultline.maybe_crash("mid_unmask",
+                                       round_idx=self.round_idx)
         self._secagg_stage = None
         self._timer.cancel()
         try:
@@ -568,6 +660,11 @@ class EdgeAggregatorActor:
         self._secagg_stage = None
         self._flushed = True
         self._timer.cancel()
+        if self.journal is not None:
+            # the round is OVER for this edge (lost, global untouched):
+            # a respawn must not try to resume it
+            self.journal.abandon(self.round_idx, why)
+            self.journal.round_end(self.round_idx)
         if self.health is not None:
             self.health.round_end(self.round_idx)
 
@@ -616,6 +713,10 @@ class EdgeAggregatorActor:
                 self.health.observe_admitted(msg.sender_id, upload,
                                              float(num_samples),
                                              norm=upload_norm)
+            if self.faultline is not None:
+                self.faultline.maybe_crash("post_admission_pre_fold",
+                                           round_idx=self.round_idx,
+                                           silo=msg.sender_id)
             if self.secagg is not None:
                 from fedml_tpu.secure.protocol import SecAggError
                 if self._secagg_stage != "upload":
@@ -630,8 +731,32 @@ class EdgeAggregatorActor:
                         logger.warning("edge %d: rejecting masked upload "
                                        "from silo %d (%s)", self.node_id,
                                        msg.sender_id, e)
+                    else:
+                        if self.journal is not None:
+                            # metadata only: masked edge rounds are
+                            # journalled abort-only (never snapshotted)
+                            self.journal.note_accept(self.round_idx,
+                                                     msg.sender_id,
+                                                     float(num_samples))
             else:
                 self.stream_agg.fold(upload, float(num_samples))
+                if self.journal is not None:
+                    # the reference rides INSIDE the edge snapshot: a
+                    # respawned edge has no live root sync to re-learn
+                    # the round global from
+                    self.journal.note_accept(
+                        self.round_idx, msg.sender_id, float(num_samples),
+                        state_fn=(
+                            (lambda: self.stream_agg.state_dict(
+                                include_reference=True))
+                            if self.stream_agg.method == "mean" else None))
+        elif self.journal is not None:
+            self.journal.note_accept(self.round_idx, msg.sender_id, 0.0,
+                                     folded=False, reason="rejected")
+        if self.faultline is not None:
+            self.faultline.maybe_crash("post_fold_pre_ack",
+                                       round_idx=self.round_idx,
+                                       silo=msg.sender_id)
         if self.secagg is not None:
             # the masked barrier closes over the ROSTER (silos that never
             # advertised can never upload) by REPORTS, not folds — a
@@ -652,6 +777,9 @@ class EdgeAggregatorActor:
         pre-reduced mean immediately.  Masked: the fold is still
         ciphertext — begin the unmask phase instead (the frame ships
         from `_finalize_secagg` once the share reveals land)."""
+        if self.faultline is not None:
+            self.faultline.maybe_crash("barrier_close",
+                                       round_idx=self.round_idx)
         self._timer.cancel()
         if self.secagg is not None:
             if self.secagg.count == 0:
@@ -665,6 +793,8 @@ class EdgeAggregatorActor:
             # policy closes over this edge like any dropped silo
             logger.warning("edge %d round %s: no admissible uploads; not "
                         "reporting", self.node_id, self.round_idx)
+            if self.journal is not None:
+                self.journal.round_end(self.round_idx)
             if self.health is not None:
                 # still close the health round: the per-silo fairness
                 # ledger must record who never showed
@@ -697,3 +827,11 @@ class EdgeAggregatorActor:
                Message.ARG_ROUND: self.round_idx,
                Message.ARG_EDGE_COUNT: int(count),
                **extra})
+        if self.journal is not None:
+            # round_end AFTER the send: a crash between the two makes
+            # the resumed edge re-finalize and re-ship — the root's
+            # duplicate-report guard discards the second frame, so the
+            # contract is at-least-once with root-side dedupe (the
+            # reverse order would silently LOSE the block on a crash
+            # between round_end and the send)
+            self.journal.round_end(self.round_idx)

@@ -113,6 +113,10 @@ class StreamingAggregator:
         self.norm_clip = norm_clip
         self.noise_std = noise_std
         self.reservoir_k = reservoir_k
+        # the template's structure, kept for state_dict/load_state_dict:
+        # a crash-resumed fold rebuilds its trees from flat snapshot
+        # leaves without the caller re-supplying the round reference
+        self._treedef = jax.tree.structure(template)
         # defended = the label contract obs/perf.py documents: the
         # finalize span is "defended_aggregate" only when a defense
         # actually runs (clip, noise, or a Byzantine rule)
@@ -204,6 +208,64 @@ class StreamingAggregator:
     # -- recompile-sentry probe (PerfRecorder.register_jit contract) ----------
     def _cache_size(self) -> int:
         return int(self._hot_jit._cache_size())
+
+    # -- crash consistency (utils/journal.py) --------------------------------
+    @property
+    def reference(self):
+        """The round's clip reference (None between rounds) — the edge
+        actors' resume path reads the restored round global here."""
+        return self._reference
+
+    def state_dict(self, include_reference: bool = False) -> dict:
+        """Host snapshot of the MEAN fold state — the payload of the
+        round journal's periodic durable snapshot.  Bit-exact contract:
+        the accumulator leaves round-trip through numpy in their own
+        ``acc_dtype``, ``wsum`` stays f32, so a restored fold continues
+        the exact sequential reduction the uncrashed run would have.
+        Reservoir (order-statistic) rules refuse: the Algorithm-R draw
+        stream is not part of the durable contract — those rounds are
+        abort-only (journal ``resumable=False``)."""
+        if self.method != "mean":
+            raise RuntimeError(
+                f"state_dict: only the streaming MEAN fold snapshots; "
+                f"{self.method!r} rounds are abort-only on crash")
+        out = {
+            "acc": (None if self._acc is None else
+                    [np.asarray(l) for l in jax.tree.leaves(self._acc)]),
+            "wsum": (np.float32(0.0) if self._wsum is None
+                     else np.asarray(self._wsum, np.float32)[()]),
+            "count": int(self.count),
+            "weight_total": float(self.weight_total)}
+        if include_reference:
+            # edge actors snapshot the reference too: a respawned edge
+            # has no live root sync to re-learn the round global from
+            out["reference"] = [np.asarray(l)
+                                for l in jax.tree.leaves(self._reference)]
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a `state_dict` snapshot mid-round.  When the snapshot
+        carries a ``reference`` the round is re-opened from it; otherwise
+        the caller must have ``reset()`` the round first (the sync
+        server restores the reference from its checkpointed global)."""
+        if self.method != "mean":
+            raise RuntimeError("load_state_dict: reservoir rounds are "
+                               "abort-only; nothing to restore")
+        if state.get("reference") is not None:
+            self.reset(jax.tree.unflatten(
+                self._treedef,
+                [jnp.asarray(a) for a in state["reference"]]))
+        if self._reference is None:
+            raise RuntimeError("load_state_dict before reset(): the "
+                               "round's clip reference is not set and "
+                               "the snapshot carries none")
+        if state.get("acc") is not None:
+            self._acc = jax.tree.unflatten(
+                jax.tree.structure(self._reference),
+                [jnp.asarray(a) for a in state["acc"]])
+            self._wsum = jnp.float32(state["wsum"])
+        self.count = int(state["count"])
+        self.weight_total = float(state["weight_total"])
 
     # -- round lifecycle -----------------------------------------------------
     def reset(self, reference) -> None:

@@ -350,6 +350,30 @@ class ExperimentConfig:
     #                                      partner)
     chaos_seed: int = 0                  # fault-schedule seed
 
+    # ---- crash consistency (utils/journal.py + robust/faultline.py) ----
+    journal: bool = False            # durable round journal on the
+    #                                  streaming-fold receive path: per-
+    #                                  accept records appended crash-safe
+    #                                  + periodic atomic fold-state
+    #                                  snapshots, so a server killed
+    #                                  MID-ROUND resumes the same round
+    #                                  and re-tasks only silos whose
+    #                                  uploads were not durably folded
+    #                                  (bit-identical resume on the
+    #                                  defended-mean stream path; secagg
+    #                                  rounds are abort-only).  Requires
+    #                                  --agg_mode stream (or --secagg);
+    #                                  pair with --checkpoint_every 1 for
+    #                                  mid-round recovery to engage
+    journal_dir: Optional[str] = None  # explicit journal directory
+    #                                  (implies --journal; default
+    #                                  run_dir/journal; edges get
+    #                                  journal/edge{e} subdirs)
+    journal_snapshot_every: int = 4  # fold-state snapshot cadence in
+    #                                  accepted folds (1 = every fold
+    #                                  durable — tightest recovery window
+    #                                  at one O(model) write per upload)
+
     # ---- checkpoint / resume (orbax round-level, SURVEY §5.4) ----------
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 10

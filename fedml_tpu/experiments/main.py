@@ -161,6 +161,67 @@ def _make_health(cfg: ExperimentConfig, kind: str,
                              suppress_payload=suppress_payload)
 
 
+def _make_journal(cfg: ExperimentConfig, subdir: Optional[str] = None):
+    """Durable round journal (utils/journal.py) for the live actor
+    modes: crash-safe per-accept records + periodic atomic fold-state
+    snapshots under ``--journal_dir`` (or ``run_dir/journal`` under
+    ``--journal``).  Only the SERVER node journals; under the edge
+    topology each edge gets its own ``edge{e}`` subdirectory."""
+    if not (cfg.journal or cfg.journal_dir):
+        return None
+    if cfg.silo_backend != "local" and cfg.node_id != 0:
+        return None  # a gRPC silo has no fold state to journal
+    import os
+    from fedml_tpu.utils.journal import RoundJournal
+    base = cfg.journal_dir or os.path.join(
+        cfg.metrics_dir or cfg.run_dir or ".", "journal")
+    path = os.path.join(base, subdir) if subdir else base
+    if not cfg.checkpoint_dir:
+        logger.warning("--journal without --checkpoint_dir: mid-round "
+                       "recovery needs the round-boundary checkpoint to "
+                       "resume against; the journal will record but a "
+                       "restarted server starts from round 0")
+    elif cfg.checkpoint_every != 1:
+        logger.warning("--journal with --checkpoint_every %d: mid-round "
+                       "recovery only engages when the crashed round "
+                       "directly follows a checkpointed one; set "
+                       "--checkpoint_every 1 for full coverage",
+                       cfg.checkpoint_every)
+    return RoundJournal(path, snapshot_every=cfg.journal_snapshot_every,
+                        node=subdir or f"node{cfg.node_id}")
+
+
+def _compose_extra_state(named):
+    """Fold several named ``(get_fn, set_fn)`` pairs into the one
+    ``extra_state`` checkpoint hook: the saved tree is a dict keyed by
+    name (fixed shapes per entry, so the whole composite still doubles
+    as the orbax restore template).  A restored tree missing a name (a
+    checkpoint from before that subsystem existed) warns and restores
+    what is there."""
+    named = [(n, gs) for n, gs in named if gs is not None]
+    if not named:
+        return None
+
+    def get():
+        return {name: g() for name, (g, _) in named}
+
+    def set_(tree):
+        if not hasattr(tree, "get"):
+            logger.warning("checkpoint extra-state is not the named-dict "
+                           "schema (pre-composition checkpoint?); "
+                           "skipping extra-state restore")
+            return
+        for name, (_, s) in named:
+            sub = tree.get(name)
+            if sub is None:
+                logger.warning("checkpoint extra-state has no %r entry; "
+                               "that subsystem starts fresh", name)
+                continue
+            s(sub)
+
+    return (get, set_)
+
+
 def _make_slo(cfg: ExperimentConfig):
     """SLO evaluator over the telemetry registry (obs/perf.py) backing
     the serve frontend's ``/healthz?deep=1``; ``--slo`` overrides the
@@ -780,6 +841,14 @@ def run_async_fl(cfg, data, mesh, sink):
             history.append(stats)
             sink.log(stats, step=version)
 
+    # version-checkpoint extra state: the trust ledger survives crashes
+    # (the sync runner's composition, mirrored)
+    trust_extra = None
+    if admission is not None:
+        trust_extra = (lambda: admission.trust.state_dict(n_silos),
+                       admission.trust.load_state_dict)
+    extra_state = _compose_extra_state([("trust", trust_extra)])
+
     hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
     server = AsyncFedServerActor(
         hub.transport(0), init, data.client_num, n_silos,
@@ -789,7 +858,8 @@ def run_async_fl(cfg, data, mesh, sink):
         seed=cfg.seed, checkpointer=_make_checkpointer(cfg),
         retask_timeout_s=cfg.retask_timeout_s or None,
         admission=admission, defended_aggregate=defended,
-        stream_agg=stream, perf=perf, health=health)
+        stream_agg=stream, perf=perf, health=health,
+        extra_state=extra_state, journal=_make_journal(cfg))
     server.register_handlers()
     silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
                                encode_upload=delta_encoder)
@@ -1096,6 +1166,19 @@ def run_cross_silo(cfg, data, mesh, sink):
                 _th.Thread(target=lambda: batcher.warmup(_sample_x),
                            daemon=True, name="serve-warmup").start()
 
+    # round-checkpoint extra state, composed by name: silo-side EF
+    # residuals (PR 3) + the admission trust ledger (ISSUE 12 — a
+    # resumed server must keep strikes, quarantine sentences, and
+    # probation clocks, or every crash releases jailed attackers early)
+    trust_extra = None
+    if admission is not None:
+        n_trust = n_edges if n_edges > 0 else n_silos
+        trust_extra = (lambda: admission.trust.state_dict(n_trust),
+                       admission.trust.load_state_dict)
+    extra_state = _compose_extra_state([("ef", ef_extra),
+                                        ("trust", trust_extra)])
+    journal = _make_journal(cfg)
+
     def make_server(transport):
         # under the edge topology the root's cohort IS the edge tier:
         # straggler policy, admission, trust, and both agg modes apply
@@ -1108,10 +1191,10 @@ def run_cross_silo(cfg, data, mesh, sink):
             round_timeout_s=timeout, min_silo_frac=cfg.min_silo_frac,
             decode_upload=decode, failure_detector=detector,
             checkpointer=_make_checkpointer(cfg),
-            publish=publish, extra_state=ef_extra,
+            publish=publish, extra_state=extra_state,
             admission=admission, aggregate_fn=defended,
             stream_agg=stream, perf=perf, health=health,
-            secagg=secagg_root)
+            secagg=secagg_root, journal=journal)
         s.register_handlers()
         return s
 
@@ -1222,6 +1305,7 @@ def run_cross_silo(cfg, data, mesh, sink):
                         health=edge_health,
                         secagg=(make_edge_secagg(f"edge{e}")
                                 if make_edge_secagg is not None else None),
+                        journal=_make_journal(cfg, subdir=f"edge{e}"),
                         # the edge must flush its partial fold BEFORE
                         # the root's round timer fires, or an on-time
                         # block is discarded with its one straggler —
@@ -1251,6 +1335,12 @@ def run_cross_silo(cfg, data, mesh, sink):
             if not chaos_on:
                 for a in edges + silos:
                     a.register_handlers()
+                for e_actor in edges:
+                    # mid-round recovery for a journaled edge: a restart
+                    # that left an edge's block mid-flight restores the
+                    # durable fold and re-syncs only the missing silos
+                    # (no-op without a journal or an open round)
+                    e_actor.resume()
                 server.start()
                 hub.pump()
                 return history[-1] if history else {}
@@ -1262,6 +1352,8 @@ def run_cross_silo(cfg, data, mesh, sink):
                        for a in edges + silos]
             for th in threads:
                 th.start()
+            for e_actor in edges:
+                e_actor.resume()
             server.start()
             server.transport.run()  # blocks until the final round's FINISH
             for th in threads:
@@ -1710,6 +1802,26 @@ def main(argv=None) -> Dict[str, Any]:
                 f"smallest masking group ({group_min} silos"
                 f"{' per edge block' if cfg.secagg == 'grouped' else ''}): "
                 f"reconstruction could never gather that many shares")
+    # crash consistency (utils/journal.py): the journal snapshots the
+    # STREAMING fold state — on a stack-mode (or non-live) run the flag
+    # would parse and then silently journal nothing, which is the exact
+    # "we thought we were crash-safe" blindness this subsystem ends
+    if cfg.journal or cfg.journal_dir:
+        if cfg.algo not in ("cross_silo", "async_fl"):
+            raise ValueError(
+                f"--journal is mid-round crash consistency for the live "
+                f"actor modes and applies to --algo cross_silo/async_fl "
+                f"only; --algo {cfg.algo} would silently journal nothing "
+                f"and label the run as crash-consistent.")
+        if cfg.agg_mode != "stream" and cfg.secagg == "off":
+            raise ValueError(
+                "--journal rides the streaming-fold receive path: pass "
+                "--agg_mode stream (the stack path has no incremental "
+                "fold state to snapshot).  Secagg rounds journal "
+                "abort-only.")
+    if cfg.journal_snapshot_every < 1:
+        raise ValueError(f"--journal_snapshot_every must be >= 1, got "
+                         f"{cfg.journal_snapshot_every}")
     if cfg.serve_port > 0 and cfg.algo != "cross_silo":
         raise ValueError(
             "--serve_port starts the serve-while-train frontend, which is "

@@ -327,6 +327,7 @@ class HealthAccumulator:
         self.kind = kind
         self.node = node
         self.path = ledger_path
+        self._ledger_disabled = False
         self.thresholds = {**HEALTH_SLOS, **(thresholds or {})}
         self.starve_after = starve_after
         self.alarms_enabled = alarms
@@ -615,12 +616,21 @@ class HealthAccumulator:
             self._g["delta_norm"].set(line["global_delta_norm"])
 
     def _write(self, line: dict) -> None:
+        if self._ledger_disabled:
+            return
+        from fedml_tpu.utils.journal import durable_append
         data = json.dumps(line, sort_keys=True) + "\n"
         # one write() on an O_APPEND fd (the perf.jsonl contract): a
-        # crash tears at most the tail, which every reader tolerates
-        with open(self.path, "a") as f:
-            f.write(data)
-            f.flush()
+        # crash tears at most the tail, which every reader tolerates.
+        # A disk fault (ENOSPC/EIO) warns ONCE and disables the ledger —
+        # it must never kill the receive thread or the round loop; the
+        # in-memory stats, gauges, and alarms keep working.
+        try:
+            durable_append(self.path, data, channel="health_ledger")
+        except OSError as e:
+            self._ledger_disabled = True
+            log.warning("health ledger append failed (%s); disabling the "
+                        "ledger — stats and alarms continue in memory", e)
 
     # -- queries --------------------------------------------------------------
     def round_summary(self) -> Optional[dict]:

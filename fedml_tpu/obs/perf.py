@@ -50,6 +50,7 @@ from typing import Callable, Dict, Optional
 
 from fedml_tpu.obs import telemetry
 from fedml_tpu.obs.health import HEALTH_SLOS
+from fedml_tpu.utils.journal import durable_append
 
 log = logging.getLogger(__name__)
 
@@ -67,7 +68,11 @@ PHASES = ("broadcast_serialize", "straggler_wait", "staging", "fold",
           # Phase names are open vocabulary to every reader
           # (trend.phase_medians keys on whatever a ledger carries), so
           # pre-secagg ledgers keep validating and gating unchanged.
-          "mask_agreement", "unmask")
+          "mask_agreement", "unmask",
+          # crash consistency (utils/journal.py): the durable round
+          # journal's record appends + periodic fold-state snapshots on
+          # the receive path — host-side I/O, never a trace
+          "journal")
 
 
 # ---------------------------------------------------------------------------
@@ -344,6 +349,7 @@ class PerfRecorder:
         self._c_rounds = reg.counter("fedml_perf_rounds_total")
         self._h_phase: Dict[str, object] = {}
         self._closed = False
+        self._ledger_disabled = False
 
     # -- registration --------------------------------------------------------
     def register_jit(self, name: str, fn) -> bool:
@@ -455,11 +461,20 @@ class PerfRecorder:
         return line
 
     def _write(self, line: dict) -> None:
+        if self._ledger_disabled:
+            return
         data = json.dumps(line, sort_keys=True) + "\n"
-        # one write() on an O_APPEND fd: a crash tears at most the tail
-        with open(self.path, "a") as f:
-            f.write(data)
-            f.flush()
+        # one write() on an O_APPEND fd: a crash tears at most the tail.
+        # A disk fault (ENOSPC/EIO — real or injected through the
+        # utils.journal seam) must never kill the round loop: warn ONCE
+        # and disable the ledger; the lines already on disk stay a valid
+        # (truncated) trend-gate input.
+        try:
+            durable_append(self.path, data, channel="perf_ledger")
+        except OSError as e:
+            self._ledger_disabled = True
+            log.warning("perf ledger append failed (%s); disabling the "
+                        "ledger — training continues unledgered", e)
 
     def close(self) -> None:
         """Stop the sampler thread; safe to call twice.  An open round

@@ -19,10 +19,16 @@ from fedml_tpu.robust.adversary import (ATTACK_KINDS, Attack,
                                         make_malicious_train_fn,
                                         parse_adversary_spec)
 from fedml_tpu.robust.defense import ROBUST_AGG_METHODS, make_defended_aggregate
+from fedml_tpu.robust.faultline import (CRASH_POINTS, DISK_CHANNELS,
+                                        ActorKilled, CrashSpec,
+                                        DiskFaultInjector, DiskFaultSpec,
+                                        Faultline, kill_actor)
 
 __all__ = [
     "AdmissionPipeline", "AdmissionVerdict", "TrustTracker",
     "params_fingerprint", "make_defended_aggregate", "ROBUST_AGG_METHODS",
     "Attack", "ATTACK_KINDS", "parse_adversary_spec",
     "make_malicious_train_fn", "make_backdoor_shard_transform",
+    "CRASH_POINTS", "DISK_CHANNELS", "ActorKilled", "CrashSpec",
+    "DiskFaultInjector", "DiskFaultSpec", "Faultline", "kill_actor",
 ]

@@ -148,12 +148,18 @@ class TrustTracker:
     screen's ``norm_window`` deque, so the whole admission subsystem
     holds O(window + silos) state regardless of cohort size.
 
-    Trust is SOFT state, deliberately not checkpointed — exactly like
-    the `FailureDetector` health registry it mirrors: a crash-resumed
-    server re-learns a quarantine within ``strikes_to_quarantine``
-    rounds of fresh evidence (and the norm screen re-arms after its
-    warm-up window).  Only state that affects numerical resume
-    equivalence (params, EF residuals) rides checkpoints.
+    Trust is DURABLE state: `state_dict` / `load_state_dict` ride the
+    server's ``extra_state`` checkpoint hook, so a crash-resumed server
+    keeps every strike, quarantine sentence, and probation clock.  It
+    was originally left soft ("re-learn within strikes_to_quarantine
+    rounds of fresh evidence"), but that contract releases a jailed
+    attacker EARLY on every server crash — a crash-loop (or an attacker
+    who can induce one) resets all sentences, so quarantine must survive
+    the process (tests/test_crash_recovery.py pins a quarantined silo
+    staying jailed across a kill, probation clock intact).  The bounded
+    ``events`` audit log and the norm screen's rolling history stay
+    soft — they affect no admission verdict's correctness, only
+    reporting and the screen's warm-up.
     """
 
     TRUSTED = "trusted"
@@ -239,6 +245,43 @@ class TrustTracker:
                 self.events.append((round_idx, silo, "trusted"))
         elif state == self.TRUSTED and self._strikes.get(silo, 0) > 0:
             self._strikes[silo] -= 1
+
+    def state_dict(self, n_silos: int) -> Dict[str, np.ndarray]:
+        """Fixed-shape host snapshot for the round-checkpoint
+        ``extra_state`` hook (restart-independent shapes — the same
+        structure doubles as the orbax restore template): slot ``i``
+        holds silo ``i+1``'s strikes / first-free-round (-1 = not
+        quarantined) / probation rounds left.  Silos beyond ``n_silos``
+        (none in a fixed deployment) are dropped with a warning rather
+        than silently truncated."""
+        strikes = np.zeros(n_silos, np.int64)
+        until = np.full(n_silos, -1, np.int64)
+        probation = np.zeros(n_silos, np.int64)
+        for tgt, src in ((strikes, self._strikes),
+                         (until, self._quarantine_until),
+                         (probation, self._probation_left)):
+            for silo, v in src.items():
+                if 1 <= silo <= n_silos:
+                    tgt[silo - 1] = int(v)
+                else:
+                    log.warning("trust state_dict: silo %d outside 1..%d "
+                                "not persisted", silo, n_silos)
+        return {"strikes": strikes, "quarantine_until": until,
+                "probation_left": probation}
+
+    def load_state_dict(self, state) -> None:
+        """Restore a `state_dict` snapshot (resume path): sentences and
+        probation clocks continue from where the crashed process left
+        them — a quarantined attacker stays jailed."""
+        strikes = np.asarray(state["strikes"])
+        until = np.asarray(state["quarantine_until"])
+        probation = np.asarray(state["probation_left"])
+        self._strikes = {i + 1: int(v) for i, v in enumerate(strikes)
+                         if v > 0}
+        self._quarantine_until = {i + 1: int(v)
+                                  for i, v in enumerate(until) if v >= 0}
+        self._probation_left = {i + 1: int(v)
+                                for i, v in enumerate(probation) if v > 0}
 
     def quarantined(self, round_idx: int, silos=None) -> set:
         """The silos serving quarantine at ``round_idx`` (sweeps states,

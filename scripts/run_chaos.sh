@@ -18,7 +18,15 @@ cd "$(dirname "$0")/.."
 env JAX_PLATFORMS=cpu python scripts/wire_bench.py --smoke \
     --out /tmp/BENCH_wire_smoke.json
 
+# process-kill arm (ISSUE 12): the seeded kill/disk-fault matrix with
+# the invariant checker — link chaos above exercises the WIRE; this
+# exercises process death, crash-at-a-point, and disk faults against
+# the round journal's recovery contract
+env JAX_PLATFORMS=cpu python scripts/soak.py --smoke \
+    --out /tmp/soak_smoke.json
+
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_resilient.py tests/test_recovery.py \
     tests/test_robust_round.py tests/test_wire.py \
+    tests/test_crash_recovery.py \
     -q -p no:cacheprovider "$@"

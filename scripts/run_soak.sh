@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Seeded process-level fault-injection soak (ISSUE 12): the kill/disk-
+# fault matrix with the invariant checker (scripts/soak.py), plus a
+# LIVE journaled CLI federation proving the observability acceptance —
+# the journal phase ledgers, the recompile sentry stays silent under
+# --perf_strict (the journal is host-side), and the perf trend gate
+# passes with journaling enabled.
+#
+# Usage: scripts/run_soak.sh [--smoke] [extra soak.py args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUN=$(mktemp -d /tmp/fedml_soak.XXXXXX)
+trap 'rm -rf "$RUN"' EXIT
+
+# --- arm 1: the seeded fault matrix (exit 1 on any invariant violation)
+env JAX_PLATFORMS=cpu python scripts/soak.py --out "$RUN/soak.json" "$@"
+
+# --- arm 2: journaling on the LIVE CLI loop under the strict recompile
+# sentry; the trend gate must pass the journaled ledger against itself
+# (journal phase present, 0 recompiles — a journal that re-traced a hot
+# jit would fail right here)
+env JAX_PLATFORMS=cpu python -m fedml_tpu \
+    --algo cross_silo --model lr --dataset mnist \
+    --client_num_in_total 4 --client_num_per_round 4 \
+    --comm_round 4 --epochs 1 --batch_size 8 --ci 1 \
+    --agg_mode stream --norm_clip 5.0 \
+    --journal true --journal_snapshot_every 1 \
+    --checkpoint_dir "$RUN/ck" --checkpoint_every 1 \
+    --run_dir "$RUN" --perf true --perf_strict true \
+    --log_stdout false
+
+python - "$RUN/perf.jsonl" <<'EOF'
+import json, sys
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+assert rows, "no ledger lines"
+for r in rows:
+    assert "journal" in r["phases"], f"round {r['round']}: no journal phase"
+    assert r["recompiles"] == 0, f"round {r['round']}: recompiled"
+print(f"[soak] journal phase on all {len(rows)} ledger lines, 0 recompiles")
+EOF
+
+env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
+    --ledger "$RUN/perf.jsonl" --baseline "$RUN/perf.jsonl"
+
+echo "[soak] PASS: fault matrix clean + journaled trend gate green"

@@ -1,0 +1,584 @@
+#!/usr/bin/env python
+"""Seeded process-level fault-injection soak campaign (ISSUE 12).
+
+Drives small in-process federations through a matrix of fault arms —
+process kills at every registered crash point, link chaos, disk faults,
+defenses under attack, the edge tree, async, and secagg — with an
+in-process respawn harness (catch `ActorKilled`, cancel the corpse's
+timers, rebuild the server from its checkpoint + journal on a fresh
+transport endpoint) and an INVARIANT CHECKER:
+
+  I1  never a mis-aggregated global — killed-then-resumed finals equal
+      the uncrashed reference bit-for-bit on the defended-mean stream
+      path (allclose on secagg, whose abort-only rounds may legally
+      lose work but never publish a partial unmask);
+  I2  bounded progress — every arm completes within its respawn budget
+      (no deadlock, no crash loop);
+  I3  trust monotone across crashes — a quarantined attacker's sentence
+      survives every respawn (never released early by a restart);
+  I4  every ledger still parses — perf.jsonl / health.jsonl / the
+      journal all load after kills and injected disk faults.
+
+Any violation exits 1 with the arm and invariant named.  Determinism:
+all faults derive from --seed (the `ChaosTransport` / `Faultline`
+replay contract), so a failing campaign re-runs identically.
+
+Usage:
+  python scripts/soak.py [--smoke] [--seed N] [--out PATH]
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+from fedml_tpu.algorithms.cross_silo import (FedAvgClientActor,  # noqa: E402
+                                             FedAvgServerActor)
+from fedml_tpu.comm.local import LocalHub  # noqa: E402
+from fedml_tpu.core.stream_agg import StreamingAggregator  # noqa: E402
+from fedml_tpu.robust.faultline import (CRASH_POINTS, ActorKilled,  # noqa: E402
+                                        CrashSpec, DiskFaultInjector,
+                                        DiskFaultSpec, Faultline,
+                                        kill_actor)
+from fedml_tpu.utils.checkpoint import RoundCheckpointer  # noqa: E402
+from fedml_tpu.utils.journal import RoundJournal  # noqa: E402
+
+MAX_RESPAWNS = 10
+
+
+class Violation(Exception):
+    def __init__(self, invariant, detail):
+        super().__init__(f"{invariant}: {detail}")
+        self.invariant = invariant
+
+
+def _params(seed=3):
+    rng = np.random.RandomState(seed)
+    return {"dense": {"kernel": rng.randn(6, 4).astype(np.float32),
+                      "bias": rng.randn(4).astype(np.float32)}}
+
+
+def _train_fn(silo, nan_silos=()):
+    def fn(params, client_idx, round_idx):
+        if silo in nan_silos:
+            return jax.tree.map(
+                lambda v: np.full_like(np.asarray(v), np.nan), params), 10
+        rng = np.random.RandomState(1000 * silo + int(round_idx or 0))
+        return jax.tree.map(
+            lambda v: v + rng.randn(*np.shape(v)).astype(np.float32) * 0.1,
+            params), 10 + silo
+    return fn
+
+
+def _bit_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def _check_ledgers(workdir):
+    """I4: every artifact the run left must still parse."""
+    from fedml_tpu.obs.trend import load_ledger
+    for root, _, files in os.walk(workdir):
+        for f in files:
+            p = os.path.join(root, f)
+            if f.endswith("perf.jsonl") or f.endswith("health.jsonl"):
+                load_ledger(p)  # raises on mid-file corruption
+            elif f == "journal.jsonl":
+                RoundJournal(root).read_records()
+
+
+def _run_sync(workdir, rounds=3, n=3, ck=True, jr=True, fl=None,
+              nan_silos=(), admission=None, extra_state=None,
+              perf_path=None, chaos_plan=None, straggler=None):
+    """One sync federation attempt (pump or threaded drive)."""
+    perf = None
+    if perf_path:
+        from fedml_tpu.obs.perf import PerfRecorder
+        perf = PerfRecorder(perf_path, strict_recompiles=True,
+                            rss_interval_s=10.0)
+    init = _params(3)
+    hub = LocalHub(codec_roundtrip=True)
+    wrap = (lambda t: t)
+    threaded = chaos_plan is not None
+    if chaos_plan is not None:
+        from fedml_tpu.comm.chaos import ChaosTransport
+        wrap = lambda t: ChaosTransport(t, chaos_plan)  # noqa: E731
+    stream = StreamingAggregator(init, method="mean", kind="params",
+                                 norm_clip=1.0, seed=0,
+                                 sentry=perf.sentry if perf else None)
+    kw = {}
+    if straggler:
+        kw = dict(straggler_policy="drop", round_timeout_s=straggler,
+                  min_silo_frac=0.5)
+    server = FedAvgServerActor(
+        wrap(hub.transport(0)), init, n, n, rounds,
+        checkpointer=(RoundCheckpointer(os.path.join(workdir, "ck"),
+                                        save_every=1) if ck else None),
+        stream_agg=stream,
+        journal=(RoundJournal(os.path.join(workdir, "j"),
+                              snapshot_every=1) if jr else None),
+        faultline=fl, admission=admission, extra_state=extra_state,
+        perf=perf, **kw)
+    silos = [FedAvgClientActor(i, wrap(hub.transport(i)),
+                               _train_fn(i, nan_silos))
+             for i in range(1, n + 1)]
+    server.register_handlers()
+    for s in silos:
+        s.register_handlers()
+    try:
+        if threaded:
+            import threading
+            threads = [threading.Thread(target=a.run, daemon=True)
+                       for a in silos]
+            for t in threads:
+                t.start()
+            server.start()
+            server.transport.run()
+            for t in threads:
+                t.join(timeout=10)
+        else:
+            server.start()
+            hub.pump()
+    finally:
+        if perf is not None:
+            perf.close()
+    return server
+
+
+def _respawn_loop(run_once, specs, seed, on_respawn=None):
+    """The in-process kill -9 harness: one attempt per remaining spec,
+    bounded by MAX_RESPAWNS (I2)."""
+    fl = Faultline(crashes=specs, seed=seed)
+    for attempt in range(MAX_RESPAWNS + 1):
+        try:
+            return run_once(fl, attempt), fl
+        except ActorKilled as e:
+            fl.respawn()
+            if on_respawn is not None:
+                on_respawn(e, attempt)
+    raise Violation("I2_bounded_progress",
+                    f"still crashing after {MAX_RESPAWNS} respawns")
+
+
+# ---------------------------------------------------------------------------
+# the arms
+# ---------------------------------------------------------------------------
+
+def arm_sync_kill_every_point(seed, smoke=False):
+    """Kill the sync server at EVERY registered crash point (one per
+    round across respawns); final global must be bit-identical to the
+    uncrashed reference (I1) with ledgers parsing (I4)."""
+    points = [p for p in CRASH_POINTS if p != "mid_unmask"]
+    if smoke:
+        points = points[:2]
+    with tempfile.TemporaryDirectory() as ref_dir:
+        ref = _run_sync(ref_dir, jr=False, ck=False).params
+    with tempfile.TemporaryDirectory() as d:
+        specs = [CrashSpec(point=p, hit=1, round_idx=i % 3)
+                 for i, p in enumerate(points)]
+
+        def once(fl, attempt):
+            return _run_sync(
+                d, fl=fl,
+                perf_path=os.path.join(d, f"a{attempt}-perf.jsonl"))
+
+        server, fl = _respawn_loop(once, specs, seed)
+        if server.round_idx != 3:
+            raise Violation("I2_bounded_progress",
+                            f"finished at round {server.round_idx}")
+        if not _bit_equal(server.params, ref):
+            raise Violation("I1_misaggregation",
+                            "resumed global != uncrashed reference")
+        _check_ledgers(d)
+        return {"kills": fl.kills, "respawns": fl.respawns,
+                "points": points}
+
+
+def arm_sync_link_chaos_plus_kill(seed, smoke=False):
+    """Link chaos (dup + reorder + corrupt-free drop with the drop
+    policy) composed with a process kill: the federation must complete
+    (I2) with a finite global and parsing ledgers (I4).  Bit-identity
+    is NOT asserted — the drop policy legally loses uploads."""
+    from fedml_tpu.algorithms.cross_silo import MsgType
+    from fedml_tpu.comm.chaos import ChaosPlan, LinkChaos
+    plan = ChaosPlan(
+        seed=seed,
+        default=LinkChaos(drop_prob=0.05, dup_prob=0.1, reorder_prob=0.1,
+                          max_delay_s=0.02),
+        immune_types=(MsgType.S2C_FINISH, MsgType.ROUND_TIMEOUT))
+    with tempfile.TemporaryDirectory() as d:
+        specs = [CrashSpec(point="post_fold_pre_ack", hit=1, round_idx=1)]
+
+        def once(fl, attempt):
+            return _run_sync(d, fl=fl, chaos_plan=plan, straggler=2.0)
+
+        server, fl = _respawn_loop(once, specs, seed)
+        if server.round_idx != 3:
+            raise Violation("I2_bounded_progress",
+                            f"finished at round {server.round_idx}")
+        if not all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree.leaves(server.params)):
+            raise Violation("I1_misaggregation", "non-finite global")
+        _check_ledgers(d)
+        return {"kills": fl.kills, "faults": "chaos+kill"}
+
+
+def arm_trust_monotone_under_kills(seed, smoke=False):
+    """A NaN-spewing attacker is quarantined; the server is killed twice
+    mid-federation.  I3: every respawn restores the attacker's sentence
+    — the trust state is monotone across crashes (never released early),
+    pinned against the checkpointed extra_state."""
+    from fedml_tpu.robust import AdmissionPipeline, TrustTracker
+
+    def make_admission():
+        return AdmissionPipeline(
+            _params(3), kind="params",
+            trust=TrustTracker(strikes_to_quarantine=1,
+                               quarantine_rounds=5, probation_rounds=2))
+
+    with tempfile.TemporaryDirectory() as d:
+        state = {"adm": None, "sentence": None}
+
+        def once(fl, attempt):
+            adm = make_admission()
+            state["adm"] = adm
+            extra = (lambda: adm.trust.state_dict(3),
+                     adm.trust.load_state_dict)
+            server = _run_sync(d, rounds=5, fl=fl, nan_silos=(3,),
+                               admission=adm, extra_state=extra)
+            return server
+
+        def on_respawn(e, attempt):
+            pre = state["adm"].trust._quarantine_until.get(3)
+            if state["sentence"] is None:
+                state["sentence"] = pre
+            elif pre is not None and state["sentence"] is not None \
+                    and pre < state["sentence"]:
+                raise Violation("I3_trust_monotone",
+                                f"sentence shrank {state['sentence']} -> "
+                                f"{pre}")
+
+        specs = [CrashSpec(point="post_fold_pre_ack", hit=1, round_idx=1),
+                 CrashSpec(point="barrier_close", hit=1, round_idx=3)]
+        server, fl = _respawn_loop(once, specs, seed,
+                                   on_respawn=on_respawn)
+        if server.round_idx != 5:
+            raise Violation("I2_bounded_progress",
+                            f"finished at round {server.round_idx}")
+        trust = state["adm"].trust
+        until = trust._quarantine_until.get(3)
+        probation = trust._probation_left.get(3)
+        if until is None and probation is None \
+                and trust.state(3, server.round_idx - 1) == "trusted" \
+                and state["sentence"] is not None \
+                and server.round_idx - 1 < state["sentence"]:
+            raise Violation("I3_trust_monotone",
+                            "attacker fully trusted before its original "
+                            "sentence expired")
+        return {"kills": fl.kills, "sentence_until": state["sentence"]}
+
+
+def arm_edge_tree_root_kill(seed, smoke=False):
+    """The edge topology with the ROOT killed mid-round: the root's
+    journal restores the durably-folded edge frames and re-syncs only
+    the missing edges (whose silos retrain deterministically) — final
+    global bit-identical to the uncrashed tree (I1)."""
+    from fedml_tpu.algorithms.hierarchical import EdgeAggregatorActor
+    init = _params(3)
+
+    def build(workdir, fl):
+        hub = LocalHub(codec_roundtrip=True)
+        root = FedAvgServerActor(
+            hub.transport(0), init, 4, 2, 2,
+            checkpointer=(RoundCheckpointer(
+                os.path.join(workdir, "ck"), save_every=1)
+                if workdir else None),
+            stream_agg=StreamingAggregator(init, method="mean",
+                                           kind="params", seed=0),
+            journal=(RoundJournal(os.path.join(workdir, "j"),
+                                  snapshot_every=1) if workdir else None),
+            faultline=fl)
+        edges = [EdgeAggregatorActor(
+            e, hub.transport(e), {2 + g: g for g in block},
+            cohort_total=4, client_num_in_total=4,
+            stream_agg=StreamingAggregator(init, method="mean",
+                                           kind="params", seed=0))
+            for e, block in ((1, (1, 2)), (2, (3, 4)))]
+        silos = [FedAvgClientActor(2 + g, hub.transport(2 + g),
+                                   _train_fn(g),
+                                   server_id=(1 if g <= 2 else 2))
+                 for g in (1, 2, 3, 4)]
+        root.register_handlers()
+        for a in edges + silos:
+            a.register_handlers()
+        return hub, root
+
+    hub, root = build(None, None)
+    root.start()
+    hub.pump()
+    ref = root.params
+    with tempfile.TemporaryDirectory() as d:
+        specs = [CrashSpec(point="post_fold_pre_ack", hit=1, round_idx=0)]
+
+        def once(fl, attempt):
+            hub, root = build(d, fl)
+            root.start()
+            hub.pump()
+            return root
+
+        root2, fl = _respawn_loop(once, specs, seed)
+        if root2.round_idx != 2:
+            raise Violation("I2_bounded_progress",
+                            f"finished at round {root2.round_idx}")
+        if not _bit_equal(root2.params, ref):
+            raise Violation("I1_misaggregation",
+                            "edge-tree resumed global != reference")
+        return {"kills": fl.kills}
+
+
+def arm_async_kill(seed, smoke=False):
+    """The async server killed mid-version resumes the SAME version
+    (buffer + fold restored) and completes every version (I2) with a
+    finite global."""
+    from fedml_tpu.algorithms.async_fl import (AsyncFedServerActor,
+                                               delta_encoder)
+    init = _params(7)
+    with tempfile.TemporaryDirectory() as d:
+
+        def once(fl, attempt):
+            hub = LocalHub(codec_roundtrip=True)
+            srv = AsyncFedServerActor(
+                hub.transport(0), init, 3, 3, num_versions=3,
+                aggregation_goal=3,
+                checkpointer=RoundCheckpointer(os.path.join(d, "ck"),
+                                               save_every=1),
+                stream_agg=StreamingAggregator(init, method="mean",
+                                               kind="delta", seed=0),
+                journal=RoundJournal(os.path.join(d, "j"),
+                                     snapshot_every=1),
+                faultline=fl)
+            silos = [FedAvgClientActor(i, hub.transport(i), _train_fn(i),
+                                       encode_upload=delta_encoder)
+                     for i in (1, 2, 3)]
+            srv.register_handlers()
+            for s in silos:
+                s.register_handlers()
+            srv.start()
+            hub.pump()
+            return srv
+
+        specs = [CrashSpec(point="post_fold_pre_ack", hit=2, round_idx=1),
+                 CrashSpec(point="mid_checkpoint_write", hit=1,
+                           round_idx=2)]
+        srv, fl = _respawn_loop(once, specs, seed)
+        if srv.version != 3:
+            raise Violation("I2_bounded_progress",
+                            f"finished at version {srv.version}")
+        if not all(np.isfinite(np.asarray(l)).all()
+                   for l in jax.tree.leaves(srv.params)):
+            raise Violation("I1_misaggregation", "non-finite global")
+        _check_ledgers(d)
+        return {"kills": fl.kills}
+
+
+def arm_secagg_abort_only(seed, smoke=False):
+    """Secagg with kills at mid_unmask and barrier_close: crashed rounds
+    ABORT to the boundary (the journal marks them non-resumable) and the
+    completed federation matches the clean secagg run — a partially
+    unmasked sum never publishes (I1)."""
+    from fedml_tpu.robust import AdmissionPipeline
+    from fedml_tpu.secure.protocol import (SecAggClient, SecAggServer,
+                                           masked_template)
+    init = {"w": np.zeros(6, np.float32)}
+
+    def run(workdir, fl):
+        hub = LocalHub(codec_roundtrip=True)
+        server = FedAvgServerActor(
+            hub.transport(0), init, 4, 4, 2,
+            admission=AdmissionPipeline(masked_template(init),
+                                        kind="masked"),
+            secagg=SecAggServer(threshold=0, clip=64.0, weight_cap=10.0),
+            checkpointer=(RoundCheckpointer(
+                os.path.join(workdir, "ck"), save_every=1)
+                if workdir else None),
+            journal=(RoundJournal(os.path.join(workdir, "j"))
+                     if workdir else None),
+            faultline=fl)
+        server.register_handlers()
+        for i in range(1, 5):
+            def tf(i=i):
+                def fn(params, client_idx, round_idx):
+                    return jax.tree.map(
+                        lambda v: np.asarray(v) + 0.1 * i, params), 4.0 + i
+                return fn
+            c = FedAvgClientActor(i, hub.transport(i), tf(),
+                                  secagg=SecAggClient(i))
+            c.register_handlers()
+        server.start()
+        hub.pump()
+        return server
+
+    ref = run(None, None).params
+    with tempfile.TemporaryDirectory() as d:
+        specs = [CrashSpec(point="mid_unmask", hit=1, round_idx=0),
+                 CrashSpec(point="barrier_close", hit=1, round_idx=1)]
+        server, fl = _respawn_loop(
+            specs=specs, seed=seed,
+            run_once=lambda fl, attempt: run(d, fl))
+        if server.round_idx != 2:
+            raise Violation("I2_bounded_progress",
+                            f"finished at round {server.round_idx}")
+        if not all(np.allclose(np.asarray(a), np.asarray(b))
+                   for a, b in zip(jax.tree.leaves(server.params),
+                                   jax.tree.leaves(ref))):
+            raise Violation("I1_misaggregation",
+                            "secagg resumed global != clean secagg run")
+        jr = RoundJournal(os.path.join(d, "j"))
+        kinds = {r["kind"] for r in jr.read_records()}
+        if jr.recover() is not None and "abandon" not in kinds:
+            raise Violation("I1_misaggregation",
+                            "crashed secagg round neither closed nor "
+                            "abandoned")
+        return {"kills": fl.kills}
+
+
+def arm_disk_faults(seed, smoke=False):
+    """ENOSPC on the perf ledger, EIO on the health ledger, a TORN
+    journal append, and a failed snapshot — all during a killed-and-
+    resumed run: one warning each, the round loop survives, the ledger
+    prefixes parse (I4), and recovery from the torn prefix stays
+    bit-identical (I1)."""
+    import errno
+    from fedml_tpu.obs.health import HealthAccumulator
+    with tempfile.TemporaryDirectory() as ref_dir:
+        ref = _run_sync(ref_dir, jr=False, ck=False).params
+    with tempfile.TemporaryDirectory() as d:
+        inj = DiskFaultInjector([
+            DiskFaultSpec(channel="perf_ledger", hit=2),
+            DiskFaultSpec(channel="health_ledger", hit=1,
+                          err=errno.EIO),
+            DiskFaultSpec(channel="journal", hit=40, torn=True),
+            DiskFaultSpec(channel="journal_snapshot", hit=30),
+        ]).install()
+        try:
+            specs = [CrashSpec(point="barrier_close", hit=1,
+                               round_idx=1)]
+
+            def once(fl, attempt):
+                # a health accumulator rides along so the health-ledger
+                # channel sees real appends
+                health = HealthAccumulator(
+                    kind="params",
+                    ledger_path=os.path.join(d, f"a{attempt}-health.jsonl"))
+                init = _params(3)
+                hub = LocalHub(codec_roundtrip=True)
+                from fedml_tpu.obs.perf import PerfRecorder
+                perf = PerfRecorder(
+                    os.path.join(d, f"a{attempt}-perf.jsonl"),
+                    rss_interval_s=10.0)
+                server = FedAvgServerActor(
+                    hub.transport(0), init, 3, 3, 3,
+                    checkpointer=RoundCheckpointer(
+                        os.path.join(d, "ck"), save_every=1),
+                    stream_agg=StreamingAggregator(
+                        init, method="mean", kind="params",
+                        norm_clip=1.0, seed=0),
+                    journal=RoundJournal(os.path.join(d, "j"),
+                                         snapshot_every=1),
+                    faultline=fl, perf=perf, health=health)
+                silos = [FedAvgClientActor(i, hub.transport(i),
+                                           _train_fn(i))
+                         for i in (1, 2, 3)]
+                server.register_handlers()
+                for s in silos:
+                    s.register_handlers()
+                try:
+                    server.start()
+                    hub.pump()
+                finally:
+                    perf.close()
+                return server
+
+            server, fl = _respawn_loop(once, specs, seed)
+        finally:
+            inj.remove()
+        if server.round_idx != 3:
+            raise Violation("I2_bounded_progress",
+                            f"finished at round {server.round_idx}")
+        if not _bit_equal(server.params, ref):
+            raise Violation("I1_misaggregation",
+                            "global diverged under disk faults")
+        if inj.injected < 2:
+            raise Violation("I4_ledgers_parse",
+                            f"only {inj.injected} disk faults landed — "
+                            f"the arm did not exercise the seam")
+        _check_ledgers(d)
+        return {"kills": fl.kills, "disk_faults": inj.injected}
+
+
+ARMS = {
+    "sync_kill_every_point": arm_sync_kill_every_point,
+    "sync_link_chaos_plus_kill": arm_sync_link_chaos_plus_kill,
+    "trust_monotone_under_kills": arm_trust_monotone_under_kills,
+    "edge_tree_root_kill": arm_edge_tree_root_kill,
+    "async_kill": arm_async_kill,
+    "secagg_abort_only": arm_secagg_abort_only,
+    "disk_faults": arm_disk_faults,
+}
+
+SMOKE_ARMS = ("sync_kill_every_point", "secagg_abort_only", "disk_faults")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="reduced matrix for CI (3 arms, fewer points)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--arms", type=str, default="",
+                    help="comma list to restrict (default: all)")
+    ap.add_argument("--out", type=str, default="",
+                    help="write the JSON summary here")
+    args = ap.parse_args(argv)
+
+    names = (args.arms.split(",") if args.arms
+             else (SMOKE_ARMS if args.smoke else list(ARMS)))
+    results, violations = {}, []
+    for name in names:
+        t0 = time.monotonic()
+        print(f"[soak] arm {name} ...", flush=True)
+        try:
+            detail = ARMS[name](args.seed, smoke=args.smoke)
+            results[name] = {"ok": True, "s": round(
+                time.monotonic() - t0, 2), **detail}
+            print(f"[soak]   ok ({results[name]['s']}s) {detail}")
+        except Violation as v:
+            results[name] = {"ok": False, "invariant": v.invariant,
+                             "detail": str(v)}
+            violations.append((name, v))
+            print(f"[soak]   VIOLATION {v}", file=sys.stderr)
+    summary = {"seed": args.seed, "smoke": args.smoke,
+               "arms": results,
+               "violations": [f"{n}: {v}" for n, v in violations]}
+    print(json.dumps(summary, indent=2))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=2)
+    if violations:
+        print(f"[soak] {len(violations)} invariant violation(s)",
+              file=sys.stderr)
+        return 1
+    print(f"[soak] {len(results)} arm(s), zero invariant violations")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

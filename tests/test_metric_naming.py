@@ -112,6 +112,19 @@ EXPECTED = {
     "fedml_secagg_sum_rejected_total",
     "fedml_secagg_agreement_seconds",
     "fedml_secagg_unmask_seconds",
+    # PR 12: crash consistency — the durable round journal
+    # (utils/journal.py: crash-safe accept records, atomic fold-state
+    # snapshots, mid-round recoveries/abandonments) and the process-
+    # level fault injector (robust/faultline.py: seeded kills at named
+    # crash points, in-process respawns, injected disk faults)
+    "fedml_journal_records_total",
+    "fedml_journal_snapshots_total",
+    "fedml_journal_recoveries_total",
+    "fedml_journal_abandoned_total",
+    "fedml_journal_snapshot_seconds",
+    "fedml_fault_kills_total",
+    "fedml_fault_respawns_total",
+    "fedml_fault_disk_faults_total",
 }
 
 
