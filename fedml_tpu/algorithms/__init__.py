@@ -23,6 +23,9 @@ from fedml_tpu.algorithms.split_nn import (
     SplitNNClientActor, SplitNNServerActor,
 )
 from fedml_tpu.algorithms.fedgkt import FedGKT, FedGKTConfig, kd_kl_loss
+from fedml_tpu.algorithms.cross_device import (
+    CrossDevice, CrossDeviceConfig,
+)
 from fedml_tpu.algorithms.vertical_fl import (
     VerticalFL, VFLConfig, VFLGuest, VFLHost, run_vfl_protocol,
 )

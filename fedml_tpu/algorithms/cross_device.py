@@ -1,0 +1,463 @@
+"""Mega-cohort cross-device federation: compiled client waves folded
+live into the streaming spine (ROADMAP item 1).
+
+The reference FedML's headline benchmark is cross-device FL — thousands
+of sampled lightweight clients per round — but the live path here was
+still cross-silo (~8 real actors).  This engine makes one round train
+1k-100k *sampled* clients by fusing the pieces that already existed and
+had never been wired together:
+
+* the deterministic sampler picks the round's cohort
+  (`core/sampling.sample_clients`, reference-bit-exact numpy by default;
+  ``--sampler jax`` opts into the on-device variant — the choice is
+  recorded in every metrics.jsonl row so curves are never silently
+  cross-compared);
+* `device_cohort.plan_waves` pads the cohort into static device-sized
+  WAVES; each wave trains as ONE compiled program
+  (`device_cohort.make_wave_fn`: vmap on one chip, shard_map over
+  `parallel/mesh.py`'s ``clients`` axis on a mesh — FedJAX's vmapped
+  client simulation, arXiv 2108.02117, grafted onto the live loop);
+* each wave's stacked updates fold DEVICE-SIDE into the PR 7
+  `StreamingAggregator` at wave completion (`fold_wave`: a sequential
+  slot-order scan, bit-identical to per-upload folds and to a
+  single-wave round) — never a ``[cohort, ...]`` host stack, so server
+  memory stays O(model) + one O(wave) device buffer at ANY cohort size;
+* per-wave admission screens (structure / finite / norm against the
+  wave summary, `device_cohort.WaveAdmission`), the PR 8 health sketch
+  and PR 9 compile ledger ride every wave, and perf.jsonl gains a
+  ``wave`` phase — drift and re-jits at 100k scale are named, not
+  guessed;
+* ``--local_alg {sgd,fedprox,scaffold,fednova}`` selects the per-client
+  trainer INSIDE the compiled wave ("Can 5th Generation Local Training
+  Methods Support Client Sampling?", arXiv 2212.14370): fedprox rides
+  the prox-term local trainer; scaffold keeps its control variates as
+  host-stacked per-client state (the `algorithms/fedavg.py` convention)
+  gathered/scattered per wave; fednova folds normalized pseudo-updates
+  and closes the round with the tau_eff server step accumulated across
+  waves.
+
+Aggregation is stream-only BY CONSTRUCTION (the whole point is never
+holding the cohort); ``--agg_mode`` remains an actor-mode knob.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import (FedAvg, FedAvgConfig,
+                                         gather_client_rows,
+                                         scatter_client_rows,
+                                         zeros_client_state)
+from fedml_tpu.core.sampling import sample_clients, sample_clients_jax
+from fedml_tpu.core.stream_agg import StreamingAggregator
+from fedml_tpu.data.stacking import gather_cohort
+from fedml_tpu.device_cohort import (WaveAdmission, make_scaffold_wave_fn,
+                                     make_wave_fn, plan_waves)
+from fedml_tpu.obs import telemetry
+from fedml_tpu.parallel.cohort import train_cohort
+from fedml_tpu.trainer.local_sgd import make_local_trainer
+from fedml_tpu.trainer.workload import make_client_optimizer
+
+logger = logging.getLogger(__name__)
+
+LOCAL_ALGS = ("sgd", "fedprox", "scaffold", "fednova")
+SAMPLERS = ("numpy", "jax")
+
+
+@dataclasses.dataclass
+class CrossDeviceConfig(FedAvgConfig):
+    wave_size: int = 0            # 0 = auto (min(cohort, 256), rounded
+    #                               up to a mesh-axis multiple)
+    local_alg: str = "sgd"        # per-client trainer inside the wave
+    sampler: str = "numpy"        # numpy (reference-bit-exact) | jax
+    mu: float = 0.1               # fedprox proximal strength
+    norm_clip: float = 0.0        # streaming defended mean: clip each
+    #                               client update against the round global
+    agg_noise_std: float = 0.0    # weak-DP noise at finalize
+    admission: str = "auto"       # auto/on: per-wave norm screen armed;
+    #                               off: structure/finite only
+    norm_screen_k: float = 6.0
+    norm_screen_window: int = 64
+    norm_screen_min_history: int = 8
+
+
+class CrossDevice(FedAvg):
+    """FedAvg's chassis (init / seeded sampling chain / chunked eval /
+    checkpoint-resume) with the round replaced by the wave loop.  The
+    optional ``mesh`` shards WAVE TRAINING over its ``clients`` axis;
+    eval stays the chunked single-chip sweep (`eval_chunk_clients`
+    bounds its memory), so cohort size never needs to divide the mesh —
+    only ``wave_size`` does."""
+
+    def __init__(self, workload, data, config: CrossDeviceConfig,
+                 mesh=None, sink=None, perf=None, health=None, slo=None):
+        cfg = config
+        if cfg.local_alg not in LOCAL_ALGS:
+            raise ValueError(f"--local_alg must be one of {LOCAL_ALGS}, "
+                             f"got {cfg.local_alg!r}")
+        if cfg.sampler not in SAMPLERS:
+            raise ValueError(f"--sampler must be one of {SAMPLERS}, "
+                             f"got {cfg.sampler!r}")
+        n_dev = mesh.shape["clients"] if mesh is not None else 1
+        if cfg.wave_size == 0:
+            auto = min(max(cfg.client_num_per_round, 1), 256)
+            # a COPY, not an in-place write: a caller reusing one config
+            # for two engines (single-chip + mesh) must get each mesh's
+            # own auto-derivation, not the first engine's resolved size
+            cfg = config = dataclasses.replace(
+                cfg, wave_size=-(-auto // n_dev) * n_dev)
+        if cfg.wave_size < 1:
+            raise ValueError(f"--wave_size must be >= 1, got {cfg.wave_size}")
+        if mesh is not None and cfg.wave_size % n_dev:
+            raise ValueError(
+                f"--wave_size {cfg.wave_size} must be a multiple of the "
+                f"mesh clients axis ({n_dev}): waves are static-shape "
+                f"shard_map programs")
+        if cfg.local_alg in ("scaffold", "fednova"):
+            if mesh is not None:
+                raise ValueError(
+                    f"--local_alg {cfg.local_alg} rides the single-chip "
+                    f"vmap wave engine for now (its per-client state / "
+                    f"normalized server step need the stateful mesh wrap "
+                    f"of parallel/cohort.make_sharded_stateful_round); "
+                    f"drop --mesh_clients")
+            if cfg.client_axis != "vmap":
+                raise ValueError(f"--client_axis is not wired into the "
+                                 f"{cfg.local_alg} wave; drop the flag")
+        if cfg.local_alg == "scaffold":
+            if cfg.client_optimizer != "sgd":
+                raise ValueError(
+                    "scaffold's local update is plain SGD with "
+                    "control-variate correction; --client_optimizer sgd "
+                    "only (Karimireddy'20)")
+            if getattr(workload, "stateful", False):
+                raise ValueError(
+                    "scaffold does not support stateful (BatchNorm) "
+                    "workloads: control variates over running statistics "
+                    "are undefined — use a GroupNorm model")
+        # eval/init/checkpoint chassis stays single-chip: the mesh below
+        # is the WAVE mesh only (cohort size need not divide it)
+        super().__init__(workload, data, config, mesh=None, sink=sink)
+        self.wave_mesh = mesh
+        self.perf = perf
+        self.health = health
+        self.slo = slo
+        # lazily bound on first round (they need the params template)
+        self.stream: Optional[StreamingAggregator] = None
+        self.admission: Optional[WaveAdmission] = None
+        # scaffold per-client state (host-stacked, fedavg.py convention)
+        self.c_global = None
+        self.c_locals = None
+
+        reg = telemetry.get_registry()
+        self._c_rounds = reg.counter("fedml_cohort_rounds_total")
+        self._c_waves = reg.counter("fedml_cohort_waves_total")
+        self._c_clients = reg.counter("fedml_cohort_clients_total")
+        self._h_wave = reg.histogram("fedml_cohort_wave_seconds")
+        self._h_fold = reg.histogram("fedml_cohort_fold_seconds")
+
+        self._wave_fn = self._build_wave_fn(workload, cfg, mesh)
+        if perf is not None:
+            # the wave program is THE hot jit of this engine: recompile
+            # sentry + (under --device_obs) compile ledger / MFU gauge
+            self._wave_fn = perf.instrument_jit("wave_train", self._wave_fn)
+
+    # -- wave program construction ------------------------------------------
+    def _build_wave_fn(self, workload, cfg, mesh):
+        if cfg.local_alg in ("sgd", "fedprox"):
+            opt = make_client_optimizer(cfg.client_optimizer, cfg.lr,
+                                        cfg.wd)
+            local = make_local_trainer(
+                workload, opt, cfg.epochs,
+                prox_mu=cfg.mu if cfg.local_alg == "fedprox" else 0.0)
+
+            def make_stacked(params, wave_data, rng, offset):
+                stacked, _ = train_cohort(local, params, wave_data, rng,
+                                          index_offset=offset,
+                                          client_axis=cfg.client_axis)
+                return stacked, {}
+
+            return make_wave_fn(make_stacked, mesh=mesh)
+
+        if cfg.local_alg == "fednova":
+            # plain normalized averaging (momentum/prox/gmf off: the gmf
+            # server buffer is cross-round state outside this engine's
+            # O(model) contract; algorithms/fednova.py carries the full
+            # variant).  tau_src = a_i (the mu=0 branch).
+            from fedml_tpu.algorithms.fednova import (
+                FedNovaConfig, make_fednova_local_trainer)
+            ncfg = FedNovaConfig(lr=cfg.lr, epochs=cfg.epochs, wd=cfg.wd,
+                                 batch_size=cfg.batch_size, seed=cfg.seed)
+            nova_local = make_fednova_local_trainer(workload, ncfg)
+
+            def make_stacked(params, wave_data, rng, offset):
+                _, aux = train_cohort(nova_local, params, wave_data, rng,
+                                      index_offset=offset)
+                a = jnp.maximum(aux["a_i"], 1e-12)
+                # pseudo-params y_i = x − cum_grad_i/a_i: their weighted
+                # stream mean is x − Σ p_i d_i, so the one mean spine
+                # serves Nova too; the tau_eff server step closes the
+                # round host-side from the aux weighted sums
+                pseudo = jax.tree.map(
+                    lambda p, cg: p[None] - cg
+                    / a.reshape((-1,) + (1,) * (cg.ndim - 1)),
+                    params, aux["cum_grad"])
+                return pseudo, {"tau": aux["a_i"]}
+
+            return make_wave_fn(make_stacked, mesh=mesh)
+
+        # scaffold
+        from fedml_tpu.algorithms.scaffold import make_scaffold_local
+        local = make_scaffold_local(workload, cfg.lr, cfg.epochs)
+        return make_scaffold_wave_fn(local, cfg.lr)
+
+    # -- sampling -------------------------------------------------------------
+    def _sample_round(self, round_idx: int) -> np.ndarray:
+        """Cohort ids for one round.  ``numpy`` is the reference's
+        bit-exact seeded chain (curves line up with published
+        baselines); ``jax`` is the on-device permutation sampler.  THE
+        TWO DIVERGE — same (round, N, m) yields different cohorts
+        (pinned in tests/test_cross_device.py) — which is why the
+        choice lands in every metrics row.  Both resample
+        deterministically — numpy in the ROUND INDEX alone (reference
+        parity: ``--seed`` varies init, never the cohort schedule),
+        jax in (seed, round) — so a resumed run re-samples the exact
+        cohorts the crashed run would have."""
+        cfg = self.cfg
+        if cfg.sampler == "jax":
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(cfg.seed), 0x5A4D50),
+                round_idx)
+            return np.asarray(sample_clients_jax(
+                key, self.data.client_num, cfg.client_num_per_round))
+        return sample_clients(round_idx, self.data.client_num,
+                              cfg.client_num_per_round)
+
+    # -- lazy round machinery -------------------------------------------------
+    def _ensure_bound(self, params) -> None:
+        if self.stream is None:
+            cfg = self.cfg
+            self.stream = StreamingAggregator(
+                params, method="mean", kind="params",
+                norm_clip=cfg.norm_clip, noise_std=cfg.agg_noise_std,
+                seed=cfg.seed,
+                sentry=self.perf.sentry if self.perf else None,
+                device=self.perf.device if self.perf else None)
+            self.admission = WaveAdmission(
+                jax.tree.map(np.asarray, params),
+                norm_k=cfg.norm_screen_k,
+                norm_window=cfg.norm_screen_window,
+                norm_min_history=cfg.norm_screen_min_history,
+                norm_screen=cfg.admission != "off")
+        if self.cfg.local_alg == "scaffold" and self.c_global is None:
+            self.c_global = jax.tree.map(jnp.zeros_like, params)
+            self.c_locals = zeros_client_state(
+                jax.tree.map(np.asarray, params), self.data.client_num)
+
+    def _perf_phase(self, name: str, seconds: float) -> None:
+        if self.perf is not None:
+            self.perf.add_phase(name, seconds)
+
+    # -- the wave loop --------------------------------------------------------
+    def _pin_placement(self, params):
+        """Mesh runs: commit the round's params to ONE replicated
+        sharding.  Round 0's host-fed params and round N's finalize
+        outputs otherwise arrive with different committed shardings and
+        key SEPARATE wave-jit cache entries — a per-round retrace the
+        strict sentry rightly fails (caught live on the CLI mesh path)."""
+        if self.wave_mesh is None:
+            return params
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(params,
+                              NamedSharding(self.wave_mesh, P()))
+
+    def _run_round(self, params, ids, round_rng, round_idx):
+        cfg = self.cfg
+        W = cfg.wave_size
+        waves = plan_waves(ids, W)
+        params = self._pin_placement(params)
+        self._ensure_bound(params)
+        self.admission.round_start()
+        host_params = jax.tree.map(np.asarray, params)
+        if self.health is not None:
+            self.health.round_start(round_idx, host_params,
+                                    expected=range(1, len(waves) + 1))
+        self.stream.reset(params)
+        tau_acc = 0.0                  # fednova: Σ n_i·tau_i across waves
+        c_delta_acc = None             # scaffold: Σ live·(c_i+ − c_i)
+        folded = live_clients = 0
+
+        for wi, wave in enumerate(waves):
+            if wave.n_live == 0:
+                continue  # empty-cohort edge: nothing sampled
+            t0 = time.perf_counter()
+            wave_data = gather_cohort(self.data.train, wave.ids, pad_to=W)
+            offset = jnp.int32(wave.offset)
+            if cfg.local_alg == "scaffold":
+                c_cohort = gather_client_rows(self.c_locals, wave.ids, W)
+                (stacked, w, mean, total, new_c, c_delta,
+                 _m) = self._wave_fn(params, wave_data, round_rng, offset,
+                                     self.c_global, c_cohort)
+                aux_sums = {}
+            else:
+                stacked, w, mean, total, aux_sums = self._wave_fn(
+                    params, wave_data, round_rng, offset)
+            wave_weight = float(total)  # blocks: the wave ran to completion
+            dt = time.perf_counter() - t0
+            self._c_waves.inc()
+            self._h_wave.observe(dt)
+            self._perf_phase("wave", dt)
+            if wave_weight <= 0:
+                # a wave of only weightless clients (all-pad / all-empty
+                # shards): folds as weight 0 — skipped entirely, never a
+                # 0/0 in the normalizer (pinned in tests)
+                continue
+            t0 = time.perf_counter()
+            mean_host = jax.tree.map(np.asarray, mean)
+            verdict = self.admission.screen(mean_host, host_params)
+            self._perf_phase("admission", time.perf_counter() - t0)
+            if not verdict.ok:
+                logger.warning("round %d wave %d REJECTED (%s): %d "
+                               "clients' work discarded", round_idx, wi,
+                               verdict.reason, wave.n_live)
+                if self.health is not None:
+                    self.health.observe_rejected(wi + 1, verdict.reason)
+                continue
+            t0 = time.perf_counter()
+            self.stream.fold_wave(stacked, w)
+            dt = time.perf_counter() - t0
+            self._h_fold.observe(dt)
+            self._perf_phase("fold", dt)
+            folded += 1
+            live_clients += wave.n_live
+            self._c_clients.inc(wave.n_live)
+            if self.health is not None:
+                t0 = time.perf_counter()
+                self.health.observe_admitted(wi + 1, mean_host,
+                                             wave_weight,
+                                             norm=verdict.norm)
+                self._perf_phase("health", time.perf_counter() - t0)
+            if cfg.local_alg == "fednova":
+                tau_acc += float(aux_sums["tau"])
+            elif cfg.local_alg == "scaffold":
+                # admitted waves only: a rejected wave's work — params
+                # AND variates — is discarded for the round
+                self.c_locals = scatter_client_rows(
+                    self.c_locals, wave.ids, jax.tree.map(np.asarray,
+                                                          new_c))
+                c_delta_acc = (c_delta if c_delta_acc is None else
+                               jax.tree.map(jnp.add, c_delta_acc, c_delta))
+
+        if self.stream.count == 0:
+            logger.warning("round %d: every wave empty or rejected — "
+                           "global unchanged", round_idx)
+            new_params = params
+        else:
+            t0 = time.perf_counter()
+            new_params = self.stream.finalize(round_idx)
+            self._perf_phase("fold", time.perf_counter() - t0)
+            if cfg.local_alg == "fednova":
+                # x+ = x − tau_eff·Σ p_i d_i, with mean = x − Σ p_i d_i
+                tau_eff = tau_acc / self.stream.weight_total
+                new_params = jax.tree.map(
+                    lambda p, m: (p.astype(jnp.float32) - tau_eff
+                                  * (p.astype(jnp.float32)
+                                     - m.astype(jnp.float32))
+                                  ).astype(p.dtype),
+                    params, new_params)
+            elif cfg.local_alg == "scaffold" and c_delta_acc is not None:
+                # c+ = c + (|S|/N)·mean(c_i+ − c_i) = c + Σdelta/N
+                n_total = float(self.data.client_num)
+                self.c_global = jax.tree.map(
+                    lambda cg, dv: cg + dv / n_total,
+                    self.c_global, c_delta_acc)
+        self._c_rounds.inc()
+        if self.health is not None:
+            self.health.round_end(
+                round_idx, new_global=jax.tree.map(np.asarray, new_params),
+                cohort=len(ids), waves=len(waves), folded_waves=folded)
+        return new_params, {"waves": len(waves), "folded_waves": folded,
+                            "clients": live_clients}
+
+    # -- run loop -------------------------------------------------------------
+    def run(self, params=None, rng: Optional[jax.Array] = None,
+            checkpointer=None):
+        cfg = self.cfg
+        rng = rng if rng is not None else jax.random.key(cfg.seed)
+        if params is None:
+            # the FedAvg.run rng chain, mirrored exactly: parity runs on
+            # the same seed start from the same init and round rngs
+            rng, init_rng = jax.random.split(rng)
+            params = self.workload.init(init_rng, jax.tree.map(
+                lambda v: v[0, 0], {k: self.data.train[k]
+                                    for k in ("x", "y", "mask")}))
+        params, rng, start_round = self._maybe_resume(checkpointer, params,
+                                                      rng)
+        # normalize to device arrays once: a numpy round-0 global and
+        # later jax outputs must key ONE wave jit entry (the PR 5
+        # double-compile class)
+        params = jax.tree.map(jnp.asarray, params)
+        for round_idx in range(start_round, cfg.comm_round):
+            t0 = time.time()
+            if self.perf is not None:
+                self.perf.round_start(round_idx)
+            ids = self._sample_round(round_idx)
+            rng, round_rng = jax.random.split(rng)
+            params, info = self._run_round(params, ids, round_rng,
+                                           round_idx)
+            jax.block_until_ready(params)
+            round_s = time.time() - t0
+            if self.perf is not None:
+                self.perf.round_end(round_idx, cohort=len(ids),
+                                    wave_size=cfg.wave_size, **info)
+            if self.slo is not None:
+                self.slo.evaluate()
+            if (round_idx % cfg.frequency_of_the_test == 0
+                    or round_idx == cfg.comm_round - 1):
+                stats = self.evaluate_global(params)
+                stats.update(round=round_idx, round_s=round_s,
+                             cohort=len(ids), waves=info["waves"],
+                             folded_waves=info["folded_waves"],
+                             wave_size=cfg.wave_size,
+                             # provenance: which sampler/trainer made
+                             # this curve — never silently cross-compare
+                             sampler=cfg.sampler,
+                             local_alg=cfg.local_alg)
+                logger.info("round %d: %s", round_idx, stats)
+                self.history.append(stats)
+                if self.sink is not None:
+                    self.sink.log(stats, step=round_idx)
+            if checkpointer is not None:
+                checkpointer.maybe_save(
+                    round_idx, self._ckpt_state(params, rng, round_idx),
+                    last_round=round_idx == cfg.comm_round - 1)
+        if checkpointer is not None:
+            checkpointer.flush()
+        return params
+
+    # -- checkpoint extra state (scaffold control variates) -------------------
+    def _extra_state(self) -> Dict[str, Any]:
+        if self.cfg.local_alg != "scaffold" or self.c_global is None:
+            return {}
+        return {"c_global": self.c_global, "c_locals": self.c_locals}
+
+    def _extra_state_template(self, params) -> Dict[str, Any]:
+        if self.cfg.local_alg != "scaffold":
+            return {}
+        return {"c_global": jax.tree.map(jnp.zeros_like, params),
+                "c_locals": zeros_client_state(
+                    jax.tree.map(np.asarray, params),
+                    self.data.client_num)}
+
+    def _load_extra_state(self, extra) -> None:
+        if self.cfg.local_alg != "scaffold":
+            return
+        self.c_global = extra["c_global"]
+        self.c_locals = jax.tree.map(np.asarray, extra["c_locals"])

@@ -32,7 +32,10 @@ from jax.sharding import PartitionSpec as P
 
 from fedml_tpu.core.topology import SymmetricTopologyManager
 from fedml_tpu.data.stacking import FederatedData
-from fedml_tpu.parallel.cohort import cohort_eval
+from fedml_tpu.parallel.cohort import (cohort_eval,
+                                       compat_axis_size,
+                                       compat_pcast_varying,
+                                       compat_shard_map)
 from fedml_tpu.trainer.local_sgd import make_local_trainer, make_evaluator
 from fedml_tpu.trainer.workload import Workload, make_client_optimizer
 
@@ -67,7 +70,17 @@ def ring_mix_sharded(local: Pytree, axis_name: str, w_self: float,
                      w_left: float, w_right: float) -> Pytree:
     """Ring gossip over a mesh axis with two `ppermute`s — the ICI-native
     neighbor exchange (one node per device)."""
-    n = jax.lax.axis_size(axis_name)
+    n = compat_axis_size(axis_name)
+    if not isinstance(n, int):
+        # the traced psum-of-ones last resort serves arithmetic-only
+        # callers (hierarchical's copy divisor); the ppermute tables
+        # below need a CONCRETE size — name the requirement instead of
+        # letting range(tracer) die deep inside tracing
+        raise RuntimeError(
+            "ring_mix_sharded needs a STATIC mesh-axis size to build "
+            "its ppermute tables, and this jax exposes neither "
+            "jax.lax.axis_size nor the axis-env probe; upgrade jax "
+            "(the dense mix_stacked path works everywhere)")
     perm_fwd = [(i, (i + 1) % n) for i in range(n)]
     perm_bwd = [(i, (i - 1) % n) for i in range(n)]
 
@@ -147,7 +160,7 @@ class DecentralizedGossip:
             w_self, w_left, w_right = _ring_weights(np.asarray(self.W))
 
             def per_device(stacked_params, data_stacked, rng):
-                rng = jax.lax.pcast(rng, ("clients",), to="varying")
+                rng = compat_pcast_varying(rng, ("clients",))
                 i = jax.lax.axis_index("clients")
                 local_params = jax.tree.map(lambda x: x[0], stacked_params)
                 local_data = jax.tree.map(lambda x: x[0], data_stacked)
@@ -159,7 +172,7 @@ class DecentralizedGossip:
                                          w_self, w_left, w_right)
                 return jax.tree.map(lambda x: x[None], mixed)
 
-            self._round = jax.jit(jax.shard_map(
+            self._round = jax.jit(compat_shard_map(
                 per_device, mesh=mesh,
                 in_specs=(P("clients"), P("clients"), P()),
                 out_specs=P("clients")))

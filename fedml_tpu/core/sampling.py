@@ -26,7 +26,17 @@ def sample_clients(round_idx: int, client_num_in_total: int,
 
 def sample_clients_jax(key: jax.Array, client_num_in_total: int,
                        client_num_per_round: int) -> jax.Array:
-    """On-device sampler (trace-safe): permutation-based choice w/o replacement."""
+    """On-device sampler (trace-safe): permutation-based choice w/o
+    replacement.
+
+    NOT the same sequence as `sample_clients` — the numpy chain is the
+    reference's bit-exact RandomState draw, this is a threefry
+    permutation; same (round, N, m) yields DIFFERENT cohorts (pinned in
+    tests/test_cross_device.py).  Runs selecting between them must
+    record the choice (the cross-device engine stamps ``sampler`` into
+    every metrics.jsonl row) so accuracy curves from the two chains are
+    never silently cross-compared.  Both are deterministic in their
+    seed material alone, so either resumes bit-exactly mid-run."""
     num = min(client_num_per_round, client_num_in_total)
     perm = jax.random.permutation(key, client_num_in_total)
     return perm[:num]

@@ -167,8 +167,31 @@ class StreamingAggregator:
                     out = add_gaussian_noise(out, key, noise_std)
                 return out
 
+            def _fold_wave(acc, wsum, stacked, weights, reference):
+                # the WAVE fold (cross-device engine): a sequential
+                # lax.scan over the wave's slot axis running EXACTLY the
+                # per-upload fold body per slot — so a wave-chunked
+                # round, a single-wave round, and per-upload folds of
+                # the same updates in slot order all land bit-identical
+                # accumulators (weight-0 padded slots contribute an
+                # exact +0.0, the stack-scan convention)
+                def body(carry, xs):
+                    a, ws = carry
+                    upload, weight = xs
+                    if norm_clip > 0:
+                        upload = clip_update(upload, reference, norm_clip)
+                    a = jax.tree.map(
+                        lambda ai, ui: ai + ui.astype(ai.dtype)
+                        * weight.astype(ai.dtype), a, upload)
+                    return (a, ws + weight), None
+                (acc, wsum), _ = jax.lax.scan(
+                    body, (acc, wsum), (stacked, weights))
+                return acc, wsum
+
             self._fold_fn = jax.jit(
                 _fold, donate_argnums=(0, 1) if donate else ())
+            self._fold_wave_fn = jax.jit(
+                _fold_wave, donate_argnums=(0, 1) if donate else ())
             self._finalize_fn = jax.jit(_finalize)
             if device is not None:
                 # per-arrival hot path: every fold call feeds the
@@ -182,10 +205,16 @@ class StreamingAggregator:
                 self._fold_fn = device.instrument(
                     f"stream_fold[{method}]", self._fold_fn, sentry=sentry,
                     sentry_name=f"stream_agg[{method}]")
+                self._fold_wave_fn = device.instrument(
+                    f"stream_fold_wave[{method}]", self._fold_wave_fn,
+                    sentry=sentry, sentry_name=f"stream_agg[{method}]")
                 self._finalize_fn = device.instrument(
                     f"stream_finalize[{method}]", self._finalize_fn)
             self._hot_jit = self._fold_fn
         else:
+            # order-statistic rules fold per upload into the reservoir
+            # only — a pre-summed wave has no per-client population
+            self._fold_wave_fn = None
             # reservoir regime: the bounded stack IS the memory bound;
             # the finalize reuses the one-jit defended aggregate over the
             # static [K, ...] shape, so clip + rule + noise stay one
@@ -207,7 +236,14 @@ class StreamingAggregator:
 
     # -- recompile-sentry probe (PerfRecorder.register_jit contract) ----------
     def _cache_size(self) -> int:
-        return int(self._hot_jit._cache_size())
+        n = int(self._hot_jit._cache_size())
+        if self._fold_wave_fn is not None \
+                and self._fold_wave_fn is not self._hot_jit:
+            # the wave fold is part of the same monitored hot family: an
+            # uncalled jit contributes 0, so per-upload-only rounds keep
+            # the historical cache==1 pin and wave-only rounds read 1 too
+            n += int(self._fold_wave_fn._cache_size())
+        return n
 
     # -- crash consistency (utils/journal.py) --------------------------------
     @property
@@ -356,6 +392,45 @@ class StreamingAggregator:
             buf[slot] = np.asarray(leaf)
         self._res_weights[slot] = np.float32(weight)
         self._g_reservoir.set(int((self._res_weights > 0).sum()))
+
+    def fold_wave(self, stacked, weights) -> None:
+        """Fold one compiled WAVE's stacked client updates at wave
+        completion (the cross-device engine's seam): a device-side
+        sequential scan over the ``[wave, ...]`` slot axis running the
+        per-upload fold body per slot, so the fold order is the global
+        cohort-slot order regardless of wave boundaries — wave-chunked,
+        single-wave, and per-upload folds of the same updates land
+        BIT-IDENTICAL accumulators.  Weight-0 padded slots contribute an
+        exact ``+0.0`` (and do not count as folds); a wave of ALL pad
+        slots folds as weight 0 instead of perturbing the normalizer.
+        Standing memory stays O(model) — the wave stack is the caller's
+        static device buffer, never banked here."""
+        if self._reference is None:
+            raise RuntimeError("fold_wave() before reset(): the round's "
+                               "clip reference is not set")
+        if self.method != "mean":
+            raise RuntimeError(
+                f"fold_wave: only the streaming MEAN folds pre-stacked "
+                f"waves; order-statistic rules ({self.method!r}) need the "
+                f"per-client population — fold() each upload into the "
+                f"reservoir instead")
+        w_host = np.asarray(weights, np.float32)
+        live = int((w_host > 0).sum())
+        if self._acc is None:
+            self._acc = jax.tree.map(
+                lambda r: jnp.zeros(jnp.shape(r),
+                                    acc_dtype(jnp.asarray(r).dtype)),
+                self._reference)
+            self._wsum = jnp.float32(0.0)
+        self._acc, self._wsum = self._fold_wave_fn(
+            self._acc, self._wsum, stacked,
+            jnp.asarray(weights, jnp.float32), self._reference)
+        self._c_folds.inc(live)
+        self.count += live
+        # slot-order sequential host adds — the per-upload path's exact
+        # weight_total arithmetic (np.sum's pairwise order would differ)
+        for w in w_host:
+            self.weight_total += float(w)
 
     def finalize(self, step):
         """Close the round: the streamed mean (or the reservoir's robust

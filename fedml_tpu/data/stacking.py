@@ -131,8 +131,24 @@ def gather_cohort(stacked: Dict[str, Array], client_ids: Sequence[int],
                   pad_to: Optional[int] = None) -> Dict[str, Any]:
     """Select the sampled cohort's rows; optionally pad with weight-0 dummy
     clients to a static cohort size (kills per-round re-jit, SURVEY.md §7
-    "hard parts" (a))."""
+    "hard parts" (a)).
+
+    The padded-slot contract, which the static-wave cross-device path
+    makes the COMMON case rather than the edge case (pinned in
+    tests/test_cross_device.py): a padded slot aliases client 0's rows
+    but carries ``mask 0`` and ``num_samples 0``, so the local trainer
+    freezes its params at the round global (every batch fully padded)
+    and any weighted reduction sees an exact ``+0.0`` — a wave of ALL
+    pad slots therefore folds as weight 0, never a 0/0 normalizer.  A
+    cohort LARGER than ``pad_to`` is a caller bug (the jit downstream
+    would silently retrace on the odd-sized stack) and fails loudly."""
     ids = np.asarray(client_ids, dtype=np.int64)
+    if pad_to is not None and len(ids) > pad_to:
+        raise ValueError(
+            f"gather_cohort: {len(ids)} sampled clients exceed "
+            f"pad_to={pad_to}; the static cohort shape cannot hold them "
+            f"(chunk the cohort — device_cohort.plan_waves — or raise "
+            f"pad_to)")
     if pad_to is not None and len(ids) < pad_to:
         ids = np.concatenate([ids, np.zeros(pad_to - len(ids), np.int64)])
         live = np.concatenate([np.ones(len(client_ids)), np.zeros(pad_to - len(client_ids))])

@@ -256,6 +256,37 @@ class ExperimentConfig:
     #                                   "2:scale:20,3:sign_flip" (kinds:
     #                                   sign_flip scale gauss nan_bomb
     #                                   inflate backdoor)
+    # ---- cross-device mega-cohort engine (algorithms/cross_device.py) --
+    cross_device: bool = False        # train the round as compiled client
+    #                                   WAVES (vmap single-chip, shard_map
+    #                                   on a --mesh_clients mesh) with each
+    #                                   wave's stacked updates folded
+    #                                   device-side into the streaming
+    #                                   spine at wave completion — 1k-100k
+    #                                   sampled clients per round at
+    #                                   O(model) server memory.  Shorthand
+    #                                   for --algo cross_device (both
+    #                                   spellings work; combining it with
+    #                                   any other --algo fails loudly)
+    wave_size: int = 0                # clients per compiled wave (static
+    #                                   shape; last wave pads with
+    #                                   weight-0 slots).  0 = auto:
+    #                                   min(cohort, 256) rounded up to a
+    #                                   mesh-axis multiple
+    local_alg: str = "sgd"            # per-client trainer inside the
+    #                                   compiled wave: sgd | fedprox
+    #                                   (--mu) | scaffold (host-stacked
+    #                                   control variates) | fednova
+    #                                   (normalized averaging)
+    sampler: str = "numpy"            # cross_device cohort sampler:
+    #                                   numpy (reference-bit-exact
+    #                                   RandomState chain — the baseline-
+    #                                   comparable default) | jax (on-
+    #                                   device permutation).  THE TWO
+    #                                   DIVERGE; the choice is recorded
+    #                                   in every metrics.jsonl row so
+    #                                   curves are never silently
+    #                                   cross-compared
     async_goal: int = 0               # async_fl: aggregate every K uploads
     #                                   (0 = n_silos // 2, FedBuff style)
     staleness_exponent: float = 0.5   # async_fl: (1+s)^-alpha discount

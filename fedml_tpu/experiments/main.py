@@ -1403,6 +1403,42 @@ def run_cross_silo(cfg, data, mesh, sink):
             frontend.stop(drain=True)
 
 
+@runner("cross_device")
+def run_cross_device(cfg, data, mesh, sink):
+    """Mega-cohort cross-device federation (algorithms/cross_device.py):
+    the seeded sampler picks 1k-100k clients, static device-sized waves
+    each train as ONE compiled program (vmap single-chip, shard_map over
+    the --mesh_clients ``clients`` axis), and every wave's stacked
+    updates fold device-side into the PR 7 streaming spine at wave
+    completion — O(model) server memory at any cohort size, with the
+    per-wave admission screens and the perf/health/device observatories
+    riding the loop."""
+    from fedml_tpu.algorithms.cross_device import (CrossDevice,
+                                                   CrossDeviceConfig)
+    perf = _make_perf(cfg)
+    slo = _make_slo(cfg)
+    # wave summaries are params-like trees: health norms/alignment read
+    # them against the round's global exactly like cross-silo uploads
+    health = _make_health(cfg, kind="params")
+    wl = _make_workload(cfg, data)
+    algo = CrossDevice(
+        wl, data, CrossDeviceConfig(
+            wave_size=cfg.wave_size, local_alg=cfg.local_alg,
+            sampler=cfg.sampler, mu=cfg.mu, norm_clip=cfg.norm_clip,
+            agg_noise_std=cfg.agg_noise_std, admission=cfg.admission,
+            norm_screen_k=cfg.norm_screen_k,
+            norm_screen_window=cfg.norm_screen_window,
+            norm_screen_min_history=cfg.norm_screen_min_history,
+            **_fedavg_cfg_kwargs(cfg)),
+        mesh=mesh, sink=sink, perf=perf, health=health, slo=slo)
+    try:
+        algo.run(checkpointer=_make_checkpointer(cfg))
+    finally:
+        if perf is not None:
+            perf.close()  # join the RSS sampler thread
+    return algo.history[-1] if algo.history else {}
+
+
 @runner("turboaggregate")
 def run_turboaggregate(cfg, data, mesh, sink):
     import jax
@@ -1644,6 +1680,17 @@ def main(argv=None) -> Dict[str, Any]:
     logging.basicConfig(
         level=logging.INFO,
         format=f"[proc {cfg.process_id}] %(asctime)s %(name)s: %(message)s")
+    # --cross_device is shorthand for --algo cross_device (the compiled
+    # wave engine); pairing it with any OTHER algorithm would silently
+    # pick one of the two — fail instead
+    if cfg.cross_device and cfg.algo not in ("fedavg", "cross_device"):
+        raise ValueError(
+            f"--cross_device IS an algorithm selection (the compiled "
+            f"wave engine, --algo cross_device); it cannot combine with "
+            f"--algo {cfg.algo}")
+    if cfg.cross_device or cfg.algo == "cross_device":
+        cfg = dataclasses.replace(cfg, algo="cross_device",
+                                  cross_device=True)
     setup_platform(cfg)
 
     from fedml_tpu.parallel.mesh import init_distributed, make_mesh
@@ -1682,7 +1729,7 @@ def main(argv=None) -> Dict[str, Any]:
     _DTYPE_RUNNERS = {"fedavg", "fedprox", "fedopt", "fednova",
                       "fedavg_robust", "hierarchical", "centralized",
                       "decentralized", "turboaggregate", "ditto",
-                      "feddyn", "dp_fedavg", "fedac"}
+                      "feddyn", "dp_fedavg", "fedac", "cross_device"}
     if cfg.compute_dtype and cfg.algo not in _DTYPE_RUNNERS:
         raise ValueError(
             f"--compute_dtype is not wired into --algo {cfg.algo}; "
@@ -1717,8 +1764,11 @@ def main(argv=None) -> Dict[str, Any]:
     # the live-path payload defense + adversary harness (fedml_tpu/robust)
     # rides the distributed actor modes only; on the cohort-simulation
     # algorithms the flags would silently do nothing and label plain runs
-    # as defended/attacked ones
-    if cfg.algo not in ("cross_silo", "async_fl") and (
+    # as defended/attacked ones.  cross_device composes the SUBSET that
+    # makes sense inside compiled waves (--norm_clip/--agg_noise_std on
+    # the streamed mean + the built-in per-wave screens) — its own gates
+    # below refuse the rest with reasons.
+    if cfg.algo not in ("cross_silo", "async_fl", "cross_device") and (
             cfg.robust_agg != "mean" or cfg.norm_clip or cfg.agg_noise_std
             or cfg.adversary or cfg.admission == "on"):
         raise ValueError(
@@ -1727,6 +1777,52 @@ def main(argv=None) -> Dict[str, Any]:
             f"(fedml_tpu/robust) and apply to --algo cross_silo/async_fl "
             f"only; got --algo {cfg.algo}.  For the single-chip cohort "
             f"simulation use --algo fedavg_robust --defense ... instead.")
+    # cross-device wave engine: every unsupported combo fails AT CONFIG
+    # TIME with its reason — a silently-ignored flag would mislabel the
+    # run (the secagg gate convention)
+    if cfg.algo == "cross_device":
+        if cfg.secagg != "off":
+            raise ValueError(
+                "--cross_device trains sampled clients INSIDE compiled "
+                "wave programs — there are no per-client uploads on a "
+                "wire to mask, so --secagg would label an unmasked "
+                "simulation as private; secure aggregation lives on the "
+                "actor path (--algo cross_silo --secagg ...)")
+        if cfg.edge_aggregators > 0:
+            raise ValueError(
+                "--edge_aggregators is a transport-actor topology; the "
+                "cross-device engine's hierarchy is the wave tree itself "
+                "(waves pre-reduce on device), so the flag would "
+                "silently run a flat engine labeled as an edge tree")
+        if cfg.silo_backend != "local":
+            raise ValueError(
+                f"--cross_device is the compiled single-process engine; "
+                f"--silo_backend {cfg.silo_backend!r} (transport actors) "
+                f"would be silently ignored — scale out with "
+                f"--mesh_clients (+ --coordinator_address on pods) "
+                f"instead")
+        if cfg.robust_agg != "mean":
+            raise ValueError(
+                f"--robust_agg {cfg.robust_agg}: order-statistic rules "
+                f"need the per-client population, but cross-device waves "
+                f"pre-reduce to a weighted partial mean on device.  The "
+                f"defenses that compose are the per-wave structure/"
+                f"finite/norm screens + --norm_clip/--agg_noise_std on "
+                f"the streamed mean; for per-upload robust rules use "
+                f"--algo cross_silo --agg_mode stream "
+                f"--stream_reservoir K")
+        if cfg.adversary:
+            raise ValueError(
+                "--adversary wraps per-silo train fns over the real "
+                "message path (robust/adversary.py); the compiled wave "
+                "has no per-silo message seam — run attack scenarios on "
+                "--algo cross_silo")
+        if cfg.rounds_per_dispatch > 1:
+            raise ValueError(
+                "--rounds_per_dispatch is the fedavg HBM-resident "
+                "multi-round scan; the cross-device wave loop folds per "
+                "wave on the host each round and would silently ignore "
+                "it")
     if cfg.error_feedback and cfg.wire_compression == "none":
         raise ValueError("--error_feedback requires --wire_compression "
                          "topk or int8")
@@ -1833,15 +1929,15 @@ def main(argv=None) -> Dict[str, Any]:
     # round lifecycle; on the cohort-simulation algorithms the flags
     # would parse and then never record/evaluate anything — an empty
     # ledger and un-evaluated objectives masquerading as a healthy run
-    if cfg.algo not in ("cross_silo", "async_fl") and (
+    if cfg.algo not in ("cross_silo", "async_fl", "cross_device") and (
             cfg.perf or cfg.perf_ledger or cfg.perf_strict or cfg.slo
             or cfg.device_obs or cfg.health or cfg.health_ledger):
         raise ValueError(
             f"--perf/--perf_ledger/--perf_strict/--device_obs/--slo/"
-            f"--health/--health_ledger instrument the live actor modes' "
-            f"round lifecycle and apply to --algo cross_silo/async_fl "
-            f"only; --algo {cfg.algo} would silently write no ledger and "
-            f"never evaluate the objectives.")
+            f"--health/--health_ledger instrument the live round "
+            f"lifecycle and apply to --algo cross_silo/async_fl/"
+            f"cross_device only; --algo {cfg.algo} would silently write "
+            f"no ledger and never evaluate the objectives.")
     # decentralized_online consumes a streaming dataset (UCI SUSY/RO or a
     # synthetic stream) that the registry doesn't serve — its runner builds
     # it; loading here would KeyError on --dataset SUSY

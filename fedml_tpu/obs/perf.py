@@ -72,7 +72,12 @@ PHASES = ("broadcast_serialize", "straggler_wait", "staging", "fold",
           # crash consistency (utils/journal.py): the durable round
           # journal's record appends + periodic fold-state snapshots on
           # the receive path — host-side I/O, never a trace
-          "journal")
+          "journal",
+          # cross-device mega-cohort engine (algorithms/cross_device.py):
+          # one compiled wave's gather + train + summary, accumulated
+          # across the round's waves (fold/admission/health keep their
+          # own phases, shared with the actor paths)
+          "wave")
 
 
 # ---------------------------------------------------------------------------

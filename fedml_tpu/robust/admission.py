@@ -124,6 +124,24 @@ def _norm(tree) -> float:
     return math.sqrt(total)
 
 
+def norm_outlier_threshold(norms, k: float,
+                           min_history: int) -> Optional[float]:
+    """THE norm-outlier threshold formula: ``median + k * max(MAD, 5% of
+    median, 1e-12)`` over the banked accepted norms, or None while fewer
+    than ``min_history`` are banked (warm-up stays silent).  Robust
+    statistics — up to half the history being poisoned cannot drag the
+    threshold up; the MAD floor keeps a freakishly-uniform history from
+    rejecting benign jitter.  Shared by the per-upload screen below and
+    the per-wave screen (`device_cohort.WaveAdmission`), so the two can
+    never drift apart."""
+    if len(norms) < min_history:
+        return None
+    arr = np.asarray(norms, np.float64)
+    med = float(np.median(arr))
+    mad = float(np.median(np.abs(arr - med)))
+    return med + k * max(mad, 0.05 * med, 1e-12)
+
+
 class TrustTracker:
     """Per-silo strike ledger: TRUSTED → QUARANTINED → PROBATION → TRUSTED.
 
@@ -407,12 +425,8 @@ class AdmissionPipeline:
         return self._ref_cache[1]
 
     def norm_threshold(self) -> Optional[float]:
-        if len(self._norms) < self.norm_min_history:
-            return None
-        arr = np.asarray(self._norms, np.float64)
-        med = float(np.median(arr))
-        mad = float(np.median(np.abs(arr - med)))
-        return med + self.norm_k * max(mad, 0.05 * med, 1e-12)
+        return norm_outlier_threshold(self._norms, self.norm_k,
+                                      self.norm_min_history)
 
     def admit(self, silo: int, upload, num_samples, global_params,
               round_idx: int) -> AdmissionVerdict:

@@ -25,5 +25,6 @@ cd "$(dirname "$0")/.."
 [ -f tests/test_device_obs.py ]    # ...and the device-observatory suite
 [ -f tests/test_secagg_live.py ]   # ...and the live secure-aggregation suite
 [ -f tests/test_crash_recovery.py ]  # ...and the crash-consistency suite
+[ -f tests/test_cross_device.py ]  # ...and the cross-device wave suite
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

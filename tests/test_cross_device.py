@@ -1,0 +1,450 @@
+"""Cross-device mega-cohort engine (ISSUE 13): wave-chunked streaming
+folds, sampler provenance, per-wave admission, observability, and the
+config gates.
+
+Fast tier.  The load-bearing pins:
+
+* wave-chunked fold == single-wave run BIT-identical (the `fold_wave`
+  sequential-scan contract), and == per-upload folds of the same slots;
+* `gather_cohort` weight-0 padded slots contribute an exact +0.0, and a
+  wave of ALL pad slots folds as weight 0 (never a 0/0 normalizer);
+* vmap-vs-scan `client_axis` parity, mesh-vs-single-chip parity;
+* numpy vs jax sampler DIVERGE (pinned) and the choice is recorded in
+  metrics.jsonl;
+* seeded sampler determinism across checkpoint resume (both samplers);
+* perf.jsonl gains the `wave` phase with 0 recompiles under strict,
+  health.jsonl lands one line per round;
+* every unsupported flag combo fails loudly at config time.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.cross_device import CrossDevice, CrossDeviceConfig
+from fedml_tpu.core.sampling import sample_clients, sample_clients_jax
+from fedml_tpu.core.stream_agg import StreamingAggregator
+from fedml_tpu.data import load_data
+from fedml_tpu.data.stacking import gather_cohort
+from fedml_tpu.device_cohort import WaveAdmission, plan_waves
+from fedml_tpu.experiments.config import ExperimentConfig
+from fedml_tpu.experiments.models import create_workload, sample_shape_of
+
+
+@pytest.fixture(scope="module")
+def data():
+    return load_data("mnist", data_dir=None, batch_size=4, num_clients=24,
+                     seed=0)
+
+
+@pytest.fixture(scope="module")
+def workload(data):
+    return create_workload("lr", "mnist", data.class_num,
+                           sample_shape_of(data))
+
+
+def _cfg(**kw):
+    base = dict(comm_round=2, client_num_per_round=12, epochs=1,
+                batch_size=4, wave_size=5, seed=0,
+                frequency_of_the_test=10)
+    base.update(kw)
+    return CrossDeviceConfig(**base)
+
+
+def _run(workload, data, **kw):
+    return CrossDevice(workload, data, _cfg(**kw)).run()
+
+
+def _leaves(tree):
+    return [np.asarray(l) for l in jax.tree.leaves(tree)]
+
+
+def _bit_equal(a, b):
+    return all(np.array_equal(x, y) for x, y in zip(_leaves(a), _leaves(b)))
+
+
+def _max_diff(a, b):
+    return max(float(np.abs(x.astype(np.float64)
+                            - y.astype(np.float64)).max())
+               for x, y in zip(_leaves(a), _leaves(b)))
+
+
+# ---------------------------------------------------------------------------
+# the fold contract
+# ---------------------------------------------------------------------------
+
+def test_wave_chunked_fold_bit_identical_to_single_wave(workload, data):
+    """Chunking the cohort into waves must not change a single bit: the
+    fold is the same sequential slot-order reduction either way."""
+    single = _run(workload, data, wave_size=12)
+    chunked = _run(workload, data, wave_size=5)   # padded last wave
+    assert _bit_equal(single, chunked)
+
+
+def test_cross_device_matches_fedavg_cohort_engine(workload, data):
+    """Same seed, same rng chain: the wave engine lands within float
+    noise of the plain FedAvg cohort step (aggregation order differs —
+    stream scan vs fused weighted mean — so allclose, not bitwise)."""
+    from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+    p_wave = _run(workload, data, wave_size=5, comm_round=3)
+    fa = FedAvg(workload, data, FedAvgConfig(
+        comm_round=3, client_num_per_round=12, epochs=1, batch_size=4,
+        seed=0, frequency_of_the_test=10))
+    assert _max_diff(p_wave, fa.run()) < 1e-5
+
+
+def test_vmap_vs_scan_client_axis_parity(workload, data):
+    assert _bit_equal(_run(workload, data, client_axis="vmap"),
+                      _run(workload, data, client_axis="scan"))
+
+
+def test_mesh_wave_bit_identical_to_single_chip(workload, data):
+    from fedml_tpu.parallel.mesh import make_mesh
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices (conftest forces 8)")
+    mesh = make_mesh(client_axis=4, devices=jax.devices()[:4])
+    single = _run(workload, data, wave_size=8)
+    sharded = CrossDevice(workload, data, _cfg(wave_size=8),
+                          mesh=mesh).run()
+    assert _bit_equal(single, sharded)
+
+
+def test_fold_wave_matches_per_upload_folds():
+    """One fold_wave over [W, ...] == W per-upload fold() calls in slot
+    order, bit for bit — including weight-0 padded slots, which the wave
+    scan folds as an exact +0.0 and the per-upload path never sees."""
+    rng = np.random.RandomState(0)
+    tmpl = {"w": np.zeros((7, 3), np.float32), "b": np.zeros(5, np.float32)}
+    ups = [{"w": rng.standard_normal((7, 3)).astype(np.float32),
+            "b": rng.standard_normal(5).astype(np.float32)}
+           for _ in range(6)]
+    weights = np.asarray([3.0, 1.0, 0.0, 2.0, 0.0, 5.0], np.float32)
+
+    a = StreamingAggregator(tmpl, method="mean", norm_clip=0.5)
+    a.reset(tmpl)
+    for u, w in zip(ups, weights):
+        if w > 0:
+            a.fold(u, np.float32(w))
+    b = StreamingAggregator(tmpl, method="mean", norm_clip=0.5)
+    b.reset(tmpl)
+    stacked = {k: np.stack([u[k] for u in ups]) for k in ("w", "b")}
+    b.fold_wave(stacked, weights)
+
+    assert b.count == 4 == a.count
+    assert a.weight_total == b.weight_total
+    out_a, out_b = a.finalize(0), b.finalize(0)
+    assert _bit_equal(out_a, out_b)
+
+
+def test_fold_wave_chunk_boundaries_are_invisible():
+    rng = np.random.RandomState(1)
+    tmpl = {"k": np.zeros(11, np.float32)}
+    stacked = {"k": rng.standard_normal((8, 11)).astype(np.float32)}
+    w = np.asarray([1, 2, 3, 0, 4, 5, 0, 6], np.float32)
+
+    one = StreamingAggregator(tmpl, method="mean")
+    one.reset(tmpl)
+    one.fold_wave(stacked, w)
+    two = StreamingAggregator(tmpl, method="mean")
+    two.reset(tmpl)
+    two.fold_wave({"k": stacked["k"][:3]}, w[:3])
+    two.fold_wave({"k": stacked["k"][3:]}, w[3:])
+    assert _bit_equal(one.finalize(0), two.finalize(0))
+
+
+def test_all_pad_wave_folds_as_weight_zero():
+    """A wave of only weight-0 slots adds exactly nothing: the
+    normalizer is untouched (no 0/0 NaN) and a later real wave's
+    finalize is unaffected."""
+    tmpl = {"k": np.zeros(4, np.float32)}
+    agg = StreamingAggregator(tmpl, method="mean")
+    agg.reset(tmpl)
+    garbage = {"k": np.full((3, 4), 7.25, np.float32)}
+    agg.fold_wave(garbage, np.zeros(3, np.float32))
+    assert agg.count == 0 and agg.weight_total == 0.0
+    real = {"k": np.ones((2, 4), np.float32) * np.asarray([[2.0], [4.0]],
+                                                          np.float32)}
+    agg.fold_wave(real, np.asarray([1.0, 3.0], np.float32))
+    out = np.asarray(agg.finalize(0)["k"])
+    assert np.allclose(out, (2.0 + 3 * 4.0) / 4.0)
+    assert np.isfinite(out).all()
+
+
+def test_fold_wave_rejected_for_order_statistic_rules():
+    tmpl = {"k": np.zeros(4, np.float32)}
+    agg = StreamingAggregator(tmpl, method="krum", reservoir_k=4)
+    agg.reset(tmpl)
+    with pytest.raises(RuntimeError, match="per-client population"):
+        agg.fold_wave({"k": np.zeros((2, 4), np.float32)},
+                      np.ones(2, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# gather_cohort pad contract (satellite audit)
+# ---------------------------------------------------------------------------
+
+def test_gather_cohort_pad_slots_are_exact_zero_weight(data):
+    wave = gather_cohort(data.train, [3, 5], pad_to=4)
+    ns = np.asarray(wave["num_samples"])
+    mask = np.asarray(wave["mask"])
+    assert ns.shape == (4,)
+    assert ns[2] == 0.0 and ns[3] == 0.0           # exact zeros
+    assert not mask[2:].any()                       # no live samples
+    assert ns[:2].min() > 0
+
+
+def test_gather_cohort_oversized_cohort_fails_loudly(data):
+    with pytest.raises(ValueError, match="exceed pad_to"):
+        gather_cohort(data.train, list(range(6)), pad_to=4)
+
+
+def test_plan_waves_shapes():
+    waves = plan_waves(np.arange(11), 4)
+    assert [w.n_live for w in waves] == [4, 4, 3]
+    assert [w.offset for w in waves] == [0, 4, 8]
+    with pytest.raises(ValueError):
+        plan_waves(np.arange(4), 0)
+
+
+# ---------------------------------------------------------------------------
+# sampler provenance (satellite)
+# ---------------------------------------------------------------------------
+
+def test_numpy_and_jax_samplers_diverge_and_are_deterministic():
+    """The two chains are BOTH deterministic and NOT interchangeable —
+    the engine records which one made a curve for exactly this reason."""
+    n, m = 100, 10
+    np_ids = [sample_clients(r, n, m) for r in range(4)]
+    jx_ids = [np.asarray(sample_clients_jax(
+        jax.random.fold_in(jax.random.key(0), r), n, m))
+        for r in range(4)]
+    assert any(not np.array_equal(np.sort(a), np.sort(b))
+               for a, b in zip(np_ids, jx_ids))
+    assert all(np.array_equal(a, sample_clients(r, n, m))
+               for r, a in enumerate(np_ids))
+    assert all(np.array_equal(b, np.asarray(sample_clients_jax(
+        jax.random.fold_in(jax.random.key(0), r), n, m)))
+        for r, b in enumerate(jx_ids))
+
+
+def test_sampler_choice_recorded_in_metrics(tmp_path):
+    from fedml_tpu.experiments.main import main
+    cfg = ExperimentConfig(
+        algo="cross_device", model="lr", dataset="mnist",
+        client_num_in_total=16, client_num_per_round=6, wave_size=3,
+        comm_round=2, frequency_of_the_test=1, batch_size=4,
+        sampler="jax", run_dir=str(tmp_path), log_stdout=False)
+    main(cfg)
+    rows = [json.loads(l) for l in
+            open(os.path.join(tmp_path, "metrics.jsonl"))]
+    per_round = [r for r in rows if "sampler" in r]
+    assert per_round, "no per-round rows carry the sampler tag"
+    assert all(r["sampler"] == "jax" and r["local_alg"] == "sgd"
+               for r in per_round)
+
+
+def test_resume_rederives_same_cohorts(workload, data, tmp_path):
+    """Kill-and-resume must re-sample the exact cohorts: final params
+    bit-equal to the uncrashed run (both samplers; the scaffold leg
+    also pins the control-variate state riding the extra_state hook —
+    a resume that dropped c_global/c_locals would diverge here)."""
+    from fedml_tpu.utils.checkpoint import RoundCheckpointer
+    for sampler, alg in (("numpy", "sgd"), ("jax", "sgd"),
+                         ("numpy", "scaffold")):
+        straight = _run(workload, data, comm_round=4, sampler=sampler,
+                        local_alg=alg)
+        d = str(tmp_path / f"{sampler}-{alg}")
+        CrossDevice(workload, data,
+                    _cfg(comm_round=2, sampler=sampler,
+                         local_alg=alg)).run(
+            checkpointer=RoundCheckpointer(d, save_every=1))
+        resumed = CrossDevice(workload, data,
+                              _cfg(comm_round=4, sampler=sampler,
+                                   local_alg=alg)).run(
+            checkpointer=RoundCheckpointer(d, save_every=1))
+        assert _bit_equal(straight, resumed), (sampler, alg)
+
+
+# ---------------------------------------------------------------------------
+# local_alg variants inside the wave
+# ---------------------------------------------------------------------------
+
+def test_fedprox_wave_matches_sequential_fedprox(workload, data):
+    from fedml_tpu.algorithms.fedprox import FedProx, FedProxConfig
+    p = _run(workload, data, comm_round=3, local_alg="fedprox", mu=0.1)
+    q = FedProx(workload, data, FedProxConfig(
+        mu=0.1, comm_round=3, client_num_per_round=12, epochs=1,
+        batch_size=4, seed=0, frequency_of_the_test=10)).run()
+    assert _max_diff(p, q) < 1e-5
+
+
+def test_scaffold_wave_matches_sequential_scaffold(workload, data):
+    from fedml_tpu.algorithms.scaffold import Scaffold, ScaffoldConfig
+    p = _run(workload, data, comm_round=3, local_alg="scaffold")
+    q = Scaffold(workload, data, ScaffoldConfig(
+        comm_round=3, client_num_per_round=12, epochs=1, batch_size=4,
+        seed=0, frequency_of_the_test=10)).run()
+    assert _max_diff(p, q) < 1e-5
+
+
+def test_fednova_wave_matches_sequential_fednova(workload, data):
+    from fedml_tpu.algorithms.fednova import FedNova, FedNovaConfig
+    p = _run(workload, data, comm_round=3, local_alg="fednova")
+    q = FedNova(workload, data, FedNovaConfig(
+        mu=0.0, comm_round=3, client_num_per_round=12, epochs=1,
+        batch_size=4, seed=0, frequency_of_the_test=10)).run()
+    assert _max_diff(p, q) < 1e-5
+
+
+def test_local_algs_actually_differ_from_sgd(workload, data):
+    base = _run(workload, data, comm_round=2)
+    for alg in ("fedprox", "scaffold", "fednova"):
+        assert not _bit_equal(base, _run(workload, data, comm_round=2,
+                                         local_alg=alg)), alg
+
+
+# ---------------------------------------------------------------------------
+# per-wave admission
+# ---------------------------------------------------------------------------
+
+def test_wave_admission_screens():
+    tmpl = {"w": np.zeros(8, np.float32)}
+    adm = WaveAdmission(tmpl, norm_k=2.0, norm_min_history=3)
+    adm.round_start()
+    g = {"w": np.zeros(8, np.float32)}
+    # structure mismatch
+    assert adm.screen({"w": np.zeros(4, np.float32)}, g).reason \
+        == "fingerprint"
+    # non-finite
+    bad = {"w": np.full(8, np.nan, np.float32)}
+    assert adm.screen(bad, g).reason == "nonfinite"
+    # bank a tight history, then an outlier
+    for s in (1.0, 1.05, 0.95, 1.02):
+        v = adm.screen({"w": np.full(8, s / np.sqrt(8), np.float32)}, g)
+        assert v.ok and v.norm is not None
+    out = adm.screen({"w": np.full(8, 50.0, np.float32)}, g)
+    assert out.reason == "norm_outlier"
+    assert adm.rejected["norm_outlier"] == 1
+    # per-round reset: the history clears, the screen disarms
+    adm.round_start()
+    assert adm.norm_threshold() is None
+    assert adm.screen({"w": np.full(8, 50.0, np.float32)}, g).ok
+
+
+def test_engine_rejects_poisoned_wave(workload, data):
+    """A wave whose summary turns non-finite is discarded whole: the
+    fold never sees it and the round closes over the remaining waves."""
+    algo = CrossDevice(workload, data, _cfg(comm_round=1,
+                                            frequency_of_the_test=1))
+    inner = algo._wave_fn
+    poisoned = {"n": 0}
+
+    def poison(params, wave_data, rng, offset):
+        stacked, w, mean, total, aux = inner(params, wave_data, rng,
+                                             offset)
+        if poisoned["n"] == 1:  # poison the second wave only
+            mean = jax.tree.map(lambda x: x * jnp.nan, mean)
+        poisoned["n"] += 1
+        return stacked, w, mean, total, aux
+
+    algo._wave_fn = poison
+    algo.run()
+    assert algo.admission.rejected["nonfinite"] == 1
+    assert algo.history[-1]["folded_waves"] == algo.history[-1]["waves"] - 1
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def test_perf_and_health_ledgers_per_round(tmp_path):
+    """Every round lands one perf line (with the `wave` phase and 0
+    recompiles under --perf_strict) and one health line; the trend gate
+    validates the ledger shape."""
+    from fedml_tpu.experiments.main import main
+    cfg = ExperimentConfig(
+        algo="cross_device", model="lr", dataset="mnist",
+        client_num_in_total=16, client_num_per_round=8, wave_size=4,
+        comm_round=3, frequency_of_the_test=10, batch_size=4,
+        run_dir=str(tmp_path), perf=True, perf_strict=True, health=True,
+        log_stdout=False)
+    main(cfg)
+    from fedml_tpu.obs.trend import load_ledger, validate_ledger
+    perf_path = os.path.join(tmp_path, "perf.jsonl")
+    rows = load_ledger(perf_path)
+    assert len(rows) == 3
+    errors = validate_ledger(rows)
+    assert not errors, errors
+    for r in rows:
+        assert "wave" in r["phases"] and "fold" in r["phases"]
+        assert r["recompiles"] == 0
+        assert r["cohort"] == 8 and r["waves"] == 2
+    # jit caches steady: the wave program and the stream fold family
+    sizes = [r["jit_cache_sizes"] for r in rows]
+    assert all(s == sizes[0] for s in sizes)
+    assert sizes[0]["wave_train"] == 1
+    health_rows = [json.loads(l)
+                   for l in open(os.path.join(tmp_path, "health.jsonl"))]
+    assert len(health_rows) == 3
+    assert all(h["accepted"] == 2 and h["expected"] == 2
+               for h in health_rows)
+
+
+# ---------------------------------------------------------------------------
+# fail-loud config gates
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bad,match", [
+    (dict(secagg="pairwise", agg_mode="stream"), "secagg"),
+    (dict(edge_aggregators=2), "edge_aggregators"),
+    (dict(silo_backend="grpc"), "silo_backend"),
+    (dict(robust_agg="krum"), "order-statistic"),
+    (dict(adversary="2:scale:20"), "adversary"),
+    (dict(rounds_per_dispatch=4), "rounds_per_dispatch"),
+])
+def test_cross_device_config_gates(bad, match):
+    from fedml_tpu.experiments.main import main
+    cfg = ExperimentConfig(algo="cross_device", model="lr",
+                           dataset="mnist", log_stdout=False, **bad)
+    with pytest.raises(ValueError, match=match):
+        main(cfg)
+
+
+def test_cross_device_flag_conflicts_with_other_algo():
+    from fedml_tpu.experiments.main import main
+    cfg = ExperimentConfig(algo="async_fl", cross_device=True,
+                           log_stdout=False)
+    with pytest.raises(ValueError, match="cannot combine"):
+        main(cfg)
+
+
+def test_engine_constructor_gates(workload, data):
+    with pytest.raises(ValueError, match="local_alg"):
+        CrossDevice(workload, data, _cfg(local_alg="ditto"))
+    with pytest.raises(ValueError, match="sampler"):
+        CrossDevice(workload, data, _cfg(sampler="torch"))
+    with pytest.raises(ValueError, match="wave_size"):
+        CrossDevice(workload, data, _cfg(wave_size=-2))
+    from fedml_tpu.parallel.mesh import make_mesh
+    if len(jax.devices()) >= 4:
+        mesh = make_mesh(client_axis=4, devices=jax.devices()[:4])
+        with pytest.raises(ValueError, match="multiple of the"):
+            CrossDevice(workload, data, _cfg(wave_size=6), mesh=mesh)
+        with pytest.raises(ValueError, match="single-chip"):
+            CrossDevice(workload, data,
+                        _cfg(local_alg="scaffold", wave_size=8),
+                        mesh=mesh)
+    with pytest.raises(ValueError, match="sgd"):
+        CrossDevice(workload, data,
+                    _cfg(local_alg="scaffold", client_optimizer="adam"))
+
+
+def test_wave_size_auto_derivation(workload, data):
+    algo = CrossDevice(workload, data, _cfg(wave_size=0,
+                                            client_num_per_round=12))
+    assert algo.cfg.wave_size == 12  # min(cohort, 256)

@@ -125,6 +125,15 @@ EXPECTED = {
     "fedml_fault_kills_total",
     "fedml_fault_respawns_total",
     "fedml_fault_disk_faults_total",
+    # PR 13: the cross-device mega-cohort engine
+    # (algorithms/cross_device.py + device_cohort/): compiled client
+    # waves, per-wave admission rejections, wave/fold wall time
+    "fedml_cohort_rounds_total",
+    "fedml_cohort_waves_total",
+    "fedml_cohort_clients_total",
+    "fedml_cohort_wave_rejected_total",
+    "fedml_cohort_wave_seconds",
+    "fedml_cohort_fold_seconds",
 }
 
 
