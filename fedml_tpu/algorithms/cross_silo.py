@@ -164,7 +164,8 @@ class FedAvgServerActor(ServerManager):
                  health=None,
                  secagg=None,
                  journal=None,
-                 faultline=None):
+                 faultline=None,
+                 shard_wire=None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -310,6 +311,26 @@ class FedAvgServerActor(ServerManager):
         likewise abort-only.  Requires ``stream_agg`` or ``secagg``:
         the stack path has no incremental fold state to snapshot.
 
+        ``shard_wire``: a `fedml_tpu.shard_spine.ShardSpine` — the
+        sharded global-model round (``--model_shards S``).  The
+        broadcast ships S per-shard slice frames per silo (ONE
+        encode-once `SharedPayload` per shard for the whole cohort;
+        shard 0 carries the plan spec + per-silo params), uploads
+        arrive as S slice frames screened PER SHARD by the spine's
+        `ShardAdmission` (structural fingerprint against the shard
+        template at arrival; the combined-norm outlier screen at silo
+        completion), and an admitted silo's slices fold per shard into
+        the spine's `ShardedStreamingAggregator` — ``stream_agg`` must
+        BE that aggregator.  One bad slice rejects the whole silo at
+        weight 0 before anything folds (the replicated rejection
+        granularity).  The barrier counts SILOS, not slices: a silo
+        satisfies it when its last slice completes admission (or its
+        first slice fails it).  Requires ``stream_agg``; mutually
+        exclusive with ``secagg`` (a masked ring word cannot be
+        re-sliced), ``aggregate_fn`` (the stack path is whole-model by
+        construction), and ``decode_upload`` (the delta codec
+        reconstructs against the whole global).
+
         ``faultline``: a `fedml_tpu.robust.faultline.Faultline` — the
         seeded process-kill injector (test/soak only).  The round loop
         is threaded with the named crash points
@@ -369,6 +390,30 @@ class FedAvgServerActor(ServerManager):
                 "stack path has no incremental fold state to snapshot")
         self.journal = journal
         self.faultline = faultline
+        self.shard_wire = shard_wire
+        if shard_wire is not None:
+            if secagg is not None:
+                raise ValueError(
+                    "shard_wire (--model_shards) and secagg are mutually "
+                    "exclusive: a pairwise-masked uint32 ring word "
+                    "cannot be re-sliced per shard without breaking "
+                    "mask cancellation")
+            if aggregate_fn is not None or decode_upload is not None:
+                raise ValueError(
+                    "shard_wire (--model_shards) requires the streaming "
+                    "fold: the stack path and the wire-compression "
+                    "decoder are whole-model by construction")
+            if stream_agg is None:
+                raise ValueError(
+                    "shard_wire without its sharded stream_agg: pass "
+                    "the spine's ShardedStreamingAggregator as "
+                    "stream_agg (they are one subsystem)")
+            if shard_wire.admission is None:
+                raise ValueError(
+                    "shard_wire without its ShardAdmission: the "
+                    "per-shard structural screens ARE the sharded wire "
+                    "protocol (slices route by screened structure) — "
+                    "build the spine with admission_on=True")
         # a mid-round recovery found by start(): consumed by the next
         # _broadcast of the matching round
         self._pending_resume = None
@@ -479,6 +524,17 @@ class FedAvgServerActor(ServerManager):
             return
         self._broadcast(MsgType.S2C_INIT)
 
+    def _journal_mode(self) -> str:
+        """The journal's round-mode tag for THIS configuration.
+        Recovery refuses a journal written under a different one
+        (plain <-> sharded, a different shard count, secagg) instead of
+        unflattening foreign fold state into the wrong slots."""
+        if self.secagg is not None:
+            return "secagg"
+        if self.shard_wire is not None:
+            return self.shard_wire.journal_mode()
+        return f"stream_{self.stream_agg.method}"
+
     def _journal_recovery(self):
         """Inspect the journal for a round the crash left mid-flight.
         Returns a `utils.journal.Recovery` ONLY when resuming is safe:
@@ -503,6 +559,18 @@ class FedAvgServerActor(ServerManager):
                 "--checkpoint_every 1 for mid-round recovery)",
                 rec.round_idx, self.round_idx)
             self.journal.abandon(rec.round_idx, "round mismatch")
+            return None
+        if rec.mode != self._journal_mode():
+            log.error(
+                "round %d journal was written in mode %r but this run "
+                "aggregates in mode %r (the --agg_mode/--model_shards/"
+                "--secagg configuration changed across the restart); "
+                "restoring its fold state would land in the wrong "
+                "layout — restarting the round from the boundary, "
+                "global unchanged", rec.round_idx, rec.mode,
+                self._journal_mode())
+            self.journal.abandon(rec.round_idx,
+                                 f"mode mismatch {rec.mode}")
             return None
         if not rec.resumable:
             log.error(
@@ -595,10 +663,15 @@ class FedAvgServerActor(ServerManager):
         # quarantined silos (TrustTracker strikes) are excluded exactly
         # like dead ones: weight 0, never waited on.  The sweep also
         # transitions expired quarantines to probation — a probation
-        # silo is tasked again from THIS broadcast.
-        if self.admission is not None:
-            dead = dead | self.admission.trust.quarantined(
-                self.round_idx, cohort)
+        # silo is tasked again from THIS broadcast.  On the sharded
+        # wire the spine's ShardAdmission owns the (same-protocol)
+        # trust ledger.
+        trust = (self.admission.trust if self.admission is not None
+                 else self.shard_wire.admission.trust
+                 if self.shard_wire is not None
+                 and self.shard_wire.admission is not None else None)
+        if trust is not None:
+            dead = dead | trust.quarantined(self.round_idx, cohort)
         if dead == cohort:
             # every silo dead/quarantined: fall back to expecting the
             # full cohort (the classic timeout path), so a rejoin can
@@ -658,13 +731,18 @@ class FedAvgServerActor(ServerManager):
                     self.journal.note_resume(self.round_idx, resume.folded,
                                              global_crc=resume.global_crc)
         host_params = self._host_params()
+        if self.shard_wire is not None:
+            # per-round spine state: the admission's f64 reference
+            # slices + cleared upload holds (works on the resume path
+            # too — re-tasked silos' slices screen against this round's
+            # reference like any other)
+            with self._perf_phase("admission"):
+                self.shard_wire.round_start(host_params)
         if self.journal is not None and resume is None:
             from fedml_tpu.utils.journal import tree_crc
-            if self.secagg is not None:
-                mode, resumable = "secagg", False
-            else:
-                mode = f"stream_{self.stream_agg.method}"
-                resumable = self.stream_agg.method == "mean"
+            mode = self._journal_mode()
+            resumable = (self.secagg is None
+                         and self.stream_agg.method == "mean")
             with self._perf_phase("journal"):
                 self.journal.round_start(
                     self.round_idx, mode=mode, resumable=resumable,
@@ -696,7 +774,35 @@ class FedAvgServerActor(ServerManager):
         with self._span("broadcast", parent=self._round_span,
                         round=self.round_idx), \
                 self._perf_phase("broadcast_serialize"):
-            if self.encode_once:
+            if self.shard_wire is not None:
+                # per-shard fan-out: S encode-once SharedPayloads for
+                # the whole cohort (one serialization PER SHARD, never
+                # per receiver).  Shard 0's frames carry the round
+                # metadata, the plan spec, and each silo's client
+                # assignment; the other shards ship only their slice.
+                receivers = sorted(
+                    silo for silo in cohort
+                    if silo not in dead and silo not in folded)
+                per_silo = {
+                    silo: {Message.ARG_CLIENT_INDEX:
+                           int(ids[silo - 1])}
+                    for silo in receivers}
+                n_shards = self.shard_wire.num_shards
+                for s, slice_s in enumerate(
+                        self.shard_wire.broadcast_slices(host_params)):
+                    shared = {Message.ARG_MODEL_PARAMS: slice_s,
+                              Message.ARG_ROUND: self.round_idx,
+                              Message.ARG_SHARD: s,
+                              Message.ARG_SHARD_COUNT: n_shards}
+                    if s == 0:
+                        shared.update(extra)
+                        shared[Message.ARG_SHARD_SPEC] = \
+                            self.shard_wire.spec()
+                    self.send_many(
+                        msg_type, receivers, shared_params=shared,
+                        per_receiver_params=(per_silo if s == 0
+                                             else None))
+            elif self.encode_once:
                 # one payload serialization for the whole cohort: only
                 # the per-silo client assignment varies per frame
                 per_silo = {
@@ -1027,6 +1133,9 @@ class FedAvgServerActor(ServerManager):
             log.info("ignoring duplicate round-%d upload from silo %d",
                      self.round_idx, msg.sender_id)
             return
+        if self.shard_wire is not None:
+            self._on_shard_upload(msg)
+            return
         # barrier semantics: wait for every sampled silo
         # (check_whether_all_receive, FedAvgServerManager.py:51)
         upload = msg.get(Message.ARG_MODEL_PARAMS)
@@ -1119,6 +1228,53 @@ class FedAvgServerActor(ServerManager):
                                              entry[1], norm=upload_norm)
         self._note_upload(msg.sender_id, entry)
 
+    def _on_shard_upload(self, msg: Message) -> None:
+        """One shard slice of a silo's upload (the sharded wire): screen
+        it per shard at arrival; the silo reaches the barrier only when
+        its LAST slice completes admission (or its first slice fails
+        it).  A whole-model upload on the sharded wire (a rejoin
+        warm-up train, a mis-launched silo) is structural damage — it
+        rejects at weight 0 like any fingerprint mismatch instead of
+        wedging the fold."""
+        from fedml_tpu.shard_spine.admission import ACCEPT, WAIT
+        silo = msg.sender_id
+        if self._first_upload_t is None:
+            self._first_upload_t = time.monotonic()
+        shard = msg.get(Message.ARG_SHARD)
+        with self._perf_phase("admission"):
+            if shard is None:
+                log.warning("round %d: silo %d sent a whole-model "
+                            "upload on the sharded wire; rejecting as "
+                            "structural damage", self.round_idx, silo)
+                status, info = self.shard_wire.admission.reject(
+                    silo, self.round_idx, "fingerprint")
+            else:
+                status, info = self.shard_wire.admission.offer(
+                    silo, shard, msg.get(Message.ARG_SHARD_COUNT),
+                    msg.get(Message.ARG_MODEL_PARAMS),
+                    msg.get(Message.ARG_NUM_SAMPLES), self.round_idx)
+        if status == WAIT:
+            return
+        if status != ACCEPT:
+            log.warning("round %d: rejecting sharded upload from silo "
+                        "%d (reason=%s)", self.round_idx, silo,
+                        info.get("reason"))
+            if self.health is not None:
+                with self._perf_phase("health"):
+                    self.health.observe_rejected(silo,
+                                                 info.get("reason"))
+            self._note_upload(silo, None)
+            return
+        if self.health is not None:
+            # the observatory reads the ASSEMBLED update (one host join
+            # per admitted silo — the cosine/norm stats are whole-model
+            # quantities); the fold itself stays per-shard
+            with self._perf_phase("health"):
+                self.health.observe_admitted(
+                    silo, self.shard_wire.join(info["slices"]),
+                    info["num_samples"], norm=info["norm"])
+        self._note_upload(silo, (info["slices"], info["num_samples"]))
+
     # sentinel entry marker: the upload's bytes already live in the
     # staging buffer, so the decoded frame (and the wire buffer it views)
     # can be released immediately instead of held until the barrier
@@ -1166,7 +1322,13 @@ class FedAvgServerActor(ServerManager):
                 entry = (self._STAGED, entry[1])
         elif entry is not None and self.stream_agg is not None:
             with self._perf_phase("fold"):
-                self.stream_agg.fold(entry[0], entry[1])
+                if self.shard_wire is not None:
+                    # the admitted silo's S slices fold per shard —
+                    # each shard's device touches only its O(model/S)
+                    # piece of the update
+                    self.stream_agg.fold_slices(entry[0], entry[1])
+                else:
+                    self.stream_agg.fold(entry[0], entry[1])
             if self.journal is not None:
                 # the accept record is durable per report; the fold
                 # STATE snapshots on the journal's cadence (mean fold
@@ -1326,10 +1488,16 @@ class FedAvgServerActor(ServerManager):
         defended = (self.aggregate_fn is not None
                     or (self.stream_agg is not None
                         and self.stream_agg.defended))
+        # the sharded spine's finalize gets its OWN phase label
+        # (one XLA program or fused Pallas launch per shard) so the
+        # trend gate never compares a sharded round against a
+        # replicated baseline under one name
+        agg_phase = ("shard_finalize" if self.shard_wire is not None
+                     else "defended_aggregate" if defended
+                     else "aggregate")
         with self._span("aggregate", parent=self._round_span,
                         round=self.round_idx, quorum=len(admitted)), \
-                self._perf_phase("defended_aggregate" if defended
-                                 else "aggregate"):
+                self._perf_phase(agg_phase):
             if not admitted:
                 log.warning("round %d: no admissible uploads; the global "
                             "model is unchanged this round", self.round_idx)
@@ -1371,6 +1539,11 @@ class FedAvgServerActor(ServerManager):
         self._staging = self._staging_leaves = self._staging_def = None
         self._staged.clear()
         self._g_staged.set(0)
+        if self.shard_wire is not None:
+            # drop half-assembled straggler slices: the round closed
+            # over them at weight 0, and a late slice must never splice
+            # into the NEXT round's assembly
+            self.shard_wire.round_end()
         if self._round_span is not None:
             self._round_span.end()
             self._round_span = None
@@ -1419,9 +1592,11 @@ class FedAvgServerActor(ServerManager):
             # the server's own round costs, not the eval cadence.  A
             # strict-mode RecompileError raises here, on the event loop,
             # and fails the run loudly (the test-mode contract).
+            extra = ({"shards": self.shard_wire.num_shards}
+                     if self.shard_wire is not None else {})
             self.perf.round_end(self.round_idx, quorum=quorum,
                                 dropped=len(self.dropped_silos.get(
-                                    self.round_idx, [])))
+                                    self.round_idx, [])), **extra)
         if self.on_round_done is not None:
             self.on_round_done(self.round_idx, self.params)
         self.round_idx += 1
@@ -1489,6 +1664,10 @@ class FedAvgClientActor(ClientManager):
         # (round, trained host params, num_samples) awaiting its roster
         self._pending_upload: Optional[tuple] = None
         self._round: Optional[int] = None  # last round synced from server
+        # sharded wire (fedml_tpu/shard_spine): built lazily on the
+        # first sync frame carrying ARG_SHARD — the plan spec rides
+        # shard 0's frame, so the silo needs zero shard configuration
+        self._shard_rx = None
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
 
@@ -1527,6 +1706,9 @@ class FedAvgClientActor(ClientManager):
         super().finish()
 
     def _on_sync(self, msg: Message) -> None:
+        if msg.get(Message.ARG_SHARD) is not None:
+            self._on_shard_sync(msg)
+            return
         params = msg.get(Message.ARG_MODEL_PARAMS)
         client_idx = msg.get(Message.ARG_CLIENT_INDEX)
         round_idx = msg.get(Message.ARG_ROUND)
@@ -1571,6 +1753,53 @@ class FedAvgClientActor(ClientManager):
                       **{Message.ARG_MODEL_PARAMS: upload,
                          Message.ARG_NUM_SAMPLES: int(num_samples),
                          Message.ARG_ROUND: round_idx})
+
+    # -- sharded wire (fedml_tpu/shard_spine) --------------------------------
+    def _on_shard_sync(self, msg: Message) -> None:
+        """Bank one broadcast shard slice; when the round's model is
+        complete, train on the joined tree and upload it back as S
+        slice frames (split by the plan spec shard 0's frame shipped —
+        the silo derives everything from the wire)."""
+        if self.secagg is not None or self.encode_upload is not None:
+            raise ValueError(
+                "sharded sync frames cannot compose with secagg or "
+                "wire compression on the silo (masked/compressed "
+                "payloads are whole-model by construction); this "
+                "combination should have failed at config time")
+        from fedml_tpu.shard_spine import SiloShardAssembler
+        if self._shard_rx is None:
+            self._shard_rx = SiloShardAssembler()
+        round_idx = msg.get(Message.ARG_ROUND)
+        meta = {}
+        if msg.get(Message.ARG_CLIENT_INDEX) is not None:
+            meta["client_idx"] = msg.get(Message.ARG_CLIENT_INDEX)
+        if msg.get(Message.ARG_ACCEPTED) is not None:
+            meta["accepted"] = msg.get(Message.ARG_ACCEPTED)
+        done = self._shard_rx.offer(
+            round_idx, msg.get(Message.ARG_SHARD),
+            msg.get(Message.ARG_SHARD_COUNT),
+            msg.get(Message.ARG_MODEL_PARAMS),
+            msg.get(Message.ARG_SHARD_SPEC), meta=meta)
+        if not done:
+            return
+        params, meta = self._shard_rx.take()
+        self._round = round_idx
+        if self.on_accepted is not None:
+            self.on_accepted(meta.get("accepted"))
+        client_idx = meta.get("client_idx")
+        with self._span("train", deterministic=True, round=round_idx,
+                        client=client_idx):
+            new_params, num_samples = self.train_fn(params, client_idx,
+                                                    round_idx)
+        slices = self._shard_rx.split_upload(new_params)
+        with self._span("upload", deterministic=True, round=round_idx):
+            for s, sl in enumerate(slices):
+                self.send(MsgType.C2S_MODEL, self.server_id,
+                          **{Message.ARG_MODEL_PARAMS: sl,
+                             Message.ARG_NUM_SAMPLES: int(num_samples),
+                             Message.ARG_ROUND: round_idx,
+                             Message.ARG_SHARD: s,
+                             Message.ARG_SHARD_COUNT: len(slices)})
 
     # -- secure aggregation --------------------------------------------------
     def _on_secagg_roster(self, msg: Message) -> None:

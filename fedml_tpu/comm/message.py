@@ -174,6 +174,22 @@ class Message:
     #                                  (obs/health.compact_summary) — the
     #                                  tree stays one-frame-per-round;
     #                                  DIAGNOSTIC-ONLY like ARG_EDGE_COUNT
+    ARG_SHARD = "shard_idx"          # sharded global-model spine
+    #                                  (fedml_tpu/shard_spine): which
+    #                                  shard's slice this frame carries —
+    #                                  broadcasts ship S per-shard
+    #                                  frames (one encode-once
+    #                                  SharedPayload per SHARD, never
+    #                                  per receiver) and uploads arrive
+    #                                  as S slice frames screened per
+    #                                  shard before any fold
+    ARG_SHARD_COUNT = "shard_count"  # S, on every shard frame (a lone
+    #                                  slice is meaningless without it)
+    ARG_SHARD_SPEC = "shard_spec"    # the plan descriptor (plain JSON,
+    #                                  rides shard 0's sync frame) — a
+    #                                  silo rebuilds split/join from it
+    #                                  with zero configuration, like the
+    #                                  secagg masking parameters
     ARG_SECAGG = "secagg"            # secure-aggregation protocol frames
     #                                  (secure/protocol.py): the sync
     #                                  broadcast's masking parameters

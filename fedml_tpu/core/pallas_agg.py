@@ -137,6 +137,116 @@ def _agg_leaf(x3d, g2d, scales, ratios, seed, *, num_clients, noise_std,
     )(scales, ratios, seed, x3d, g2d)
 
 
+def _finalize_kernel(wsum_ref, seed_ref, x_ref, o_ref, *, noise_std, rows):
+    """One [rows, 128] block of a shard's flattened fold accumulator:
+    ``out = acc / wsum (+ sigma * n)`` — the streamed defended-mean
+    finalize as ONE fused pass (division + weak-DP noise, no HBM noise
+    temporaries; the clip already happened at fold time, per arrival)."""
+    from jax.experimental import pallas as pl
+
+    out = x_ref[:].astype(jnp.float32) / wsum_ref[0]
+    if noise_std:
+        block = pl.program_id(0).astype(jnp.uint32)
+        r_iota = jax.lax.broadcasted_iota(jnp.uint32, out.shape, 0)
+        c_iota = jax.lax.broadcasted_iota(jnp.uint32, out.shape, 1)
+        idx = (block * jnp.uint32(rows) + r_iota) * jnp.uint32(_LANES) + c_iota
+        idx_h = _murmur_fmix(idx * jnp.uint32(0x9E3779B9) + jnp.uint32(1))
+        s0 = _murmur_fmix(seed_ref[0].astype(jnp.uint32))
+        s1 = _murmur_fmix(seed_ref[1].astype(jnp.uint32)
+                          ^ jnp.uint32(0x5BD1E995))
+        out = out + noise_std * _gaussian_from_index(idx_h,
+                                                     _murmur_fmix(s0 ^ s1))
+    o_ref[:] = out.astype(o_ref.dtype)
+
+
+def make_fused_shard_finalize(*, noise_std: float = 0.0, seed: int = 0,
+                              shard_salt: int = 0, interpret: bool = False):
+    """Build the fused per-shard finalize of the sharded streaming spine
+    (`fedml_tpu.shard_spine.agg`): ``fn(acc_pieces, wsum, ref_pieces,
+    step) -> out_pieces`` where the pieces are one shard's slice of the
+    fold accumulator, keyed like its wire slice body.
+
+    All float-destined pieces are flattened into ONE padded [rows, 128]
+    f32 buffer and ``clip-at-fold + weighted-sum + noise`` completes as a
+    single `pallas_call` per shard — the one-kernel-launch-per-shard
+    finalize ROADMAP item 2 names.  Integer-destined pieces (step
+    counters) take a scalar XLA epilogue inside the same jit (the plain
+    path never noises them either).  With ``noise_std=0`` the division
+    is elementwise f32 — bit-identical to the XLA compose for f32
+    models; sigma>0 matches the noise distribution with a different
+    stream (the module's counter PRG vs threefry), exactly like
+    `make_fused_robust_aggregate`.
+
+    ``shard_salt`` decorrelates the per-shard noise streams (the fused
+    twin of `add_gaussian_noise`'s per-leaf key split);
+    ``interpret=True`` runs the same kernel through the Pallas
+    interpreter — the CPU/test fallback.
+
+    The returned callable is a fresh ``jax.jit`` (per-instance cache, so
+    the jit-once-per-shard pin and the recompile sentry see this
+    aggregator's compiles only) with ``_cache_size`` forwarded.
+    """
+    seed_word = ((int(seed) & 0xFFFFFFFF)
+                 ^ (((int(shard_salt) & 0xFFFFFFFF) * 0x9E3779B9)
+                    & 0xFFFFFFFF))
+
+    def _finalize(acc_pieces, wsum, ref_pieces, step):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        keys = sorted(acc_pieces)
+        fkeys = [k for k in keys if jnp.issubdtype(
+            jnp.asarray(ref_pieces[k]).dtype, jnp.floating)]
+        out: dict = {}
+        # integer-destined pieces: divide + truncate in XLA (tiny; the
+        # plain finalize's exact math, noise-free by contract)
+        w32 = jnp.asarray(wsum, jnp.float32)
+        for k in keys:
+            if k not in fkeys:
+                a = acc_pieces[k]
+                out[k] = (a / w32.astype(a.dtype)).astype(
+                    jnp.asarray(ref_pieces[k]).dtype)
+        if fkeys:
+            sizes = [int(np.prod(acc_pieces[k].shape or (1,)))
+                     for k in fkeys]
+            flat = jnp.concatenate(
+                [acc_pieces[k].astype(jnp.float32).reshape(-1)
+                 for k in fkeys])
+            total = int(flat.shape[0])
+            leaf_rows = -(-total // _LANES)
+            rows = max(8, min(256, leaf_rows + (-leaf_rows) % 8))
+            pad = (-total) % (rows * _LANES)
+            x2d = jnp.pad(flat, (0, pad)).reshape(-1, _LANES)
+            seed32 = jnp.stack([jnp.int32(np.int32(np.uint32(seed_word))),
+                                jnp.asarray(step, jnp.int32)])
+            kernel = functools.partial(_finalize_kernel,
+                                       noise_std=float(noise_std),
+                                       rows=rows)
+            flat_out = pl.pallas_call(
+                kernel,
+                grid=(x2d.shape[0] // rows,),
+                in_specs=[
+                    pl.BlockSpec(memory_space=pltpu.SMEM),   # wsum[1]
+                    pl.BlockSpec(memory_space=pltpu.SMEM),   # seed[2]
+                    pl.BlockSpec((rows, _LANES), lambda r: (r, 0),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((rows, _LANES), lambda r: (r, 0),
+                                       memory_space=pltpu.VMEM),
+                out_shape=jax.ShapeDtypeStruct(x2d.shape, jnp.float32),
+                interpret=interpret,
+            )(w32.reshape(1), seed32, x2d).reshape(-1)
+            off = 0
+            for k, size in zip(fkeys, sizes):
+                piece = flat_out[off:off + size].reshape(
+                    acc_pieces[k].shape)
+                out[k] = piece.astype(jnp.asarray(ref_pieces[k]).dtype)
+                off += size
+        return out
+
+    return jax.jit(_finalize)
+
+
 def _clip_scales(stacked: Pytree, global_params: Pytree, norm_bound: float,
                  is_weight) -> jax.Array:
     """Per-client min(1, bound/‖x_i−g‖) over weight leaves — the cheap XLA

@@ -60,6 +60,17 @@ log = logging.getLogger(__name__)
 STREAM_MODES = ("stream", "stack")
 
 
+def zeros_acc_like(reference):
+    """A fresh fold accumulator for ``reference``: same shapes, leaves
+    in `acc_dtype` (floats accumulate in their own dtype, ints in f32).
+    Shared with the sharded spine (`fedml_tpu.shard_spine.agg`) — the
+    accumulator-dtype contract must stay one definition or the
+    sharded-vs-replicated bit-identity pins break."""
+    return jax.tree.map(
+        lambda r: jnp.zeros(jnp.shape(r), acc_dtype(jnp.asarray(r).dtype)),
+        reference)
+
+
 class StreamingAggregator:
     """O(model)-memory fold-at-arrival defended aggregation.
 
@@ -366,10 +377,7 @@ class StreamingAggregator:
         self.weight_total += float(weight)
         if self.method == "mean":
             if self._acc is None:
-                self._acc = jax.tree.map(
-                    lambda r: jnp.zeros(jnp.shape(r),
-                                        acc_dtype(jnp.asarray(r).dtype)),
-                    self._reference)
+                self._acc = zeros_acc_like(self._reference)
                 self._wsum = jnp.float32(0.0)
             self._acc, self._wsum = self._fold_fn(
                 self._acc, self._wsum, upload, np.float32(weight),
@@ -417,10 +425,7 @@ class StreamingAggregator:
         w_host = np.asarray(weights, np.float32)
         live = int((w_host > 0).sum())
         if self._acc is None:
-            self._acc = jax.tree.map(
-                lambda r: jnp.zeros(jnp.shape(r),
-                                    acc_dtype(jnp.asarray(r).dtype)),
-                self._reference)
+            self._acc = zeros_acc_like(self._reference)
             self._wsum = jnp.float32(0.0)
         self._acc, self._wsum = self._fold_wave_fn(
             self._acc, self._wsum, stacked,

@@ -218,6 +218,34 @@ class ExperimentConfig:
     #                                   slots the rule sees at finalize
     #                                   (size to the adversary count, not
     #                                   the cohort; exact when cohort<=K)
+    # ---- sharded global-model spine (fedml_tpu/shard_spine) ------------
+    model_shards: int = 0             # >0 (cross_silo + --agg_mode
+    #                                   stream): lay the global model
+    #                                   out as S shards — broadcast and
+    #                                   uploads ship per-shard slices
+    #                                   (one encode per shard, screened
+    #                                   per shard), the streaming fold
+    #                                   state itself is sharded (each
+    #                                   shard's accumulator is
+    #                                   O(model/S), on its own device
+    #                                   when >= S devices exist), and
+    #                                   the defended finalize runs per
+    #                                   shard.  1 = the sharded
+    #                                   machinery with one shard
+    #                                   (bit-identical to the
+    #                                   replicated path — the parity
+    #                                   pin); 0 = off
+    fused_finalize: str = "auto"      # shard finalize backend: auto
+    #                                   (fused Pallas kernel on TPU,
+    #                                   XLA compose on CPU) | on (force
+    #                                   the kernel; interpret mode off-
+    #                                   TPU — the parity/proof mode) |
+    #                                   off (XLA compose everywhere).
+    #                                   One kernel launch per shard:
+    #                                   division + weak-DP noise fused
+    #                                   (sigma=0 bit-identical to XLA
+    #                                   for f32 models).  Requires
+    #                                   --model_shards >= 1
     edge_aggregators: int = 0         # >0: multi-level topology — this
     #                                   many EdgeAggregatorActor tiers
     #                                   between silos and the root; each

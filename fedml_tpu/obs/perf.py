@@ -77,7 +77,14 @@ PHASES = ("broadcast_serialize", "straggler_wait", "staging", "fold",
           # one compiled wave's gather + train + summary, accumulated
           # across the round's waves (fold/admission/health keep their
           # own phases, shared with the actor paths)
-          "wave")
+          "wave",
+          # sharded global-model spine (fedml_tpu/shard_spine): the
+          # per-shard defended finalize (one XLA program or fused
+          # Pallas launch per shard) gets its OWN label so the trend
+          # gate never compares a sharded round against a replicated
+          # baseline under one name; fold/admission/journal phases are
+          # shared with the replicated path
+          "shard_finalize")
 
 
 # ---------------------------------------------------------------------------

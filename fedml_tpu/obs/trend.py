@@ -128,7 +128,15 @@ def validate_ledger(rows: List[dict]) -> List[str]:
     is a per-device list or null (never a fabricated placeholder),
     compile entries name their fn and wall time, and an mfu above 1.0
     is a schema failure (physically impossible — the timing-trust
-    contract applies to the live ledger exactly as to BENCH artifacts)."""
+    contract applies to the live ledger exactly as to BENCH artifacts).
+
+    Phase names are open vocabulary (the `PHASES` comment in obs/perf):
+    a sharded-spine ledger (``shard_finalize`` phase + a ``shards``
+    line field) and a pre-shard ledger both validate — new shapes never
+    orphan old artifacts, old readers never fail on new ones.  A
+    ``shards`` field, where present, must be a positive int (a sharded
+    round with a fabricated shard count would poison the trend
+    comparison's like-for-like check)."""
     problems = []
     if not rows:
         return ["ledger is empty"]
@@ -136,6 +144,11 @@ def validate_ledger(rows: List[dict]) -> List[str]:
         for key in ("round", "phases", "recompiles", "wire"):
             if key not in row:
                 problems.append(f"line {i + 1}: missing {key!r}")
+        if "shards" in row and (not isinstance(row["shards"], int)
+                                or isinstance(row["shards"], bool)
+                                or row["shards"] < 1):
+            problems.append(f"line {i + 1}: shards must be a positive "
+                            f"int, got {row['shards']!r}")
         if "rss" in row and row["rss"] is not None \
                 and "peak_bytes" not in row["rss"]:
             problems.append(f"line {i + 1}: rss without peak_bytes")

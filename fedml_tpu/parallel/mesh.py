@@ -27,10 +27,23 @@ def make_mesh(client_axis: Optional[int] = None, model_axis: int = 1,
     Defaults: every device on the clients axis, no model sharding."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    if model_axis < 1:
+        raise ValueError(
+            f"cannot build a mesh with model_axis={model_axis}: every mesh "
+            f"axis must be >= 1 (got {n} devices)")
     if client_axis is None:
         client_axis = n // model_axis
-    assert client_axis * model_axis == n, (
-        f"mesh {client_axis}x{model_axis} != {n} devices")
+    # a loud, assert-free factorization check: this used to be a bare
+    # ``assert`` that vanishes under ``python -O`` and named no remedy —
+    # a mis-factored launch must fail the same way in every interpreter
+    # mode (the repo's fail-loudly convention)
+    if client_axis < 1 or client_axis * model_axis != n:
+        raise ValueError(
+            f"cannot build a [{client_axis}, {model_axis}] "
+            f"({axis_names[0]} x {axis_names[1]}) mesh from {n} devices: "
+            f"the axes must be >= 1 and their product must equal the "
+            f"device count — pass axis sizes that factor {n}, or a "
+            f"matching devices= subset")
     arr = np.asarray(devices).reshape(client_axis, model_axis)
     return Mesh(arr, axis_names)
 
@@ -45,14 +58,41 @@ def make_two_level_mesh(group_axis: int, client_axis: Optional[int] = None,
     falls on the DCN boundary."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
+    if group_axis < 1:
+        # guard BEFORE the derived division: group_axis=0 used to die as
+        # a bare ZeroDivisionError instead of a named config error
+        raise ValueError(
+            f"cannot build a two-level mesh with group_axis={group_axis}: "
+            f"the groups axis must be >= 1 (got {n} devices)")
     if client_axis is None:
         client_axis = n // group_axis
     if client_axis < 1 or group_axis * client_axis != n:
         raise ValueError(
             f"cannot build a [{group_axis}, {client_axis}] two-level mesh "
-            f"from {n} devices; groups axis must divide the device count")
+            f"from {n} devices: the axes must be >= 1 and their product "
+            f"must equal the device count — the groups axis must divide "
+            f"{n} (pass a client_axis that factors it, or a matching "
+            f"devices= subset)")
     arr = np.asarray(devices).reshape(group_axis, client_axis)
     return Mesh(arr, ("groups", "clients"))
+
+
+def make_model_mesh(num_shards: int,
+                    devices: Optional[Sequence[jax.Device]] = None
+                    ) -> Optional[Mesh]:
+    """A ``[1, num_shards]`` (clients x model) mesh for the sharded
+    global-model spine (`fedml_tpu.shard_spine`): every shard of the
+    round state lives on its own device of the ``model`` axis.  Returns
+    None when fewer than ``num_shards`` devices exist — the spine then
+    runs placement-free on the default device (same math, no per-device
+    memory split), which is the honest posture on a 1-chip host."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if len(devices) < num_shards:
+        return None
+    return make_mesh(client_axis=1, model_axis=num_shards,
+                     devices=devices[:num_shards])
 
 
 def tp_shard_params(params: Any, mesh: Mesh, axis: str = "model",

@@ -104,16 +104,25 @@ def _all_finite(tree) -> bool:
     return True
 
 
-def _update_norm(upload, reference_leaves) -> float:
-    """||upload - reference||_2 over all leaves in f64 (host math; the
-    screen must not be fooled by f32 overflow on a scale attack).
-    ``reference_leaves``: pre-flattened f64 host leaves (the per-round
-    cache below — never a fresh device transfer per upload)."""
+def update_sumsq(upload, reference_leaves) -> float:
+    """f64 ``sum((upload - reference)^2)`` over all leaves — the
+    partial the sharded admission (`fedml_tpu.shard_spine.admission`)
+    computes per shard slice and combines across shards, so the
+    per-silo norm it screens is the SAME quantity this module screens
+    on the replicated path.  ``reference_leaves``: pre-flattened f64
+    host leaves (the per-round cache — never a fresh device transfer
+    per upload)."""
     total = 0.0
     for u, g in zip(_leaves(upload), reference_leaves):
         d = u.astype(np.float64) - g
         total += float(np.sum(d * d))
-    return math.sqrt(total)
+    return total
+
+
+def _update_norm(upload, reference_leaves) -> float:
+    """||upload - reference||_2 over all leaves in f64 (host math; the
+    screen must not be fooled by f32 overflow on a scale attack)."""
+    return math.sqrt(update_sumsq(upload, reference_leaves))
 
 
 def _norm(tree) -> float:
@@ -122,6 +131,13 @@ def _norm(tree) -> float:
         d = u.astype(np.float64)
         total += float(np.sum(d * d))
     return math.sqrt(total)
+
+
+# public aliases for the sharded admission (shard_spine/admission.py),
+# which screens per shard slice with EXACTLY these canonicalizations —
+# aliasing (not copying) means the two screens can never drift apart
+flatten_leaves = _leaves
+all_finite = _all_finite
 
 
 def norm_outlier_threshold(norms, k: float,

@@ -1,0 +1,26 @@
+"""Sharded global-model spine (ROADMAP item 2): the round state, wire
+path, streaming fold, and defended finalize of the live federation,
+laid out per-shard so no device (and no single accumulator) ever holds
+the whole model.
+
+* `plan` — the deterministic, checkpoint-verified leaf→shard layout;
+* `agg` — the sharded `StreamingAggregator` twin (per-shard folds,
+  two-phase clip, fused Pallas finalize);
+* `admission` — per-shard structural screens + the combined-norm
+  outlier screen, over the shared `TrustTracker`;
+* `spine` — the server bundle (`--model_shards`) and the zero-config
+  silo assembler.
+"""
+
+from fedml_tpu.shard_spine.admission import ShardAdmission
+from fedml_tpu.shard_spine.agg import ShardedStreamingAggregator
+from fedml_tpu.shard_spine.plan import (ShardPlan, SiloShardCodec,
+                                        build_shard_plan)
+from fedml_tpu.shard_spine.spine import (ShardSpine, SiloShardAssembler,
+                                         build_shard_spine)
+
+__all__ = [
+    "ShardAdmission", "ShardedStreamingAggregator", "ShardPlan",
+    "ShardSpine", "SiloShardAssembler", "SiloShardCodec",
+    "build_shard_plan", "build_shard_spine",
+]

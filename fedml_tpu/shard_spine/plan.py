@@ -1,0 +1,387 @@
+"""Deterministic leaf→shard layout for the sharded global-model spine.
+
+Every live path used to assume the global model fits one host buffer
+(ROADMAP item 2).  The plan is the contract that breaks that assumption
+without breaking determinism: given ONLY the template's leaf shapes, the
+shard count ``S``, and the split threshold, it derives — identically on
+every process, every restart, and every checkpoint resume — which piece
+of the model each shard owns:
+
+* a leaf with a dimension divisible by ``S`` (and at least
+  ``min_split_elems`` elements) is **split** along the first such
+  dimension: shard ``s`` owns the ``s``-th contiguous block.  Following
+  "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+  Training" (arXiv 2004.13336), it is the *update/aggregation state*
+  that is laid out this way, not just the forward pass;
+* a small (or indivisible) leaf is **replicated** for placement —
+  `NamedSharding` ``P()`` on the mesh's ``model`` axis — but owned by
+  exactly ONE shard for the wire/fold partition (greedy
+  lightest-shard-first, ties to the lowest shard id), so no leaf is
+  ever folded twice.
+
+The plan is pure metadata: O(#leaves), JSON-able (`spec()`), and
+fingerprinted (`fingerprint()`) so checkpoints and journal snapshots can
+record the layout and a resume can *verify* it re-derived the identical
+one instead of silently folding restored state into the wrong slots.
+
+Wire form of one shard's slice::
+
+    {"s<idx>": {"00007": <piece of leaf 7>, ...}}
+
+The shard id is part of the screened STRUCTURE (the outer key), so the
+admission fingerprint rejects a wrong-shard upload even when two shards'
+pieces happen to share shapes (an even split of every leaf makes all
+``S`` slices shape-identical — the key is what tells them apart).
+
+Leaf order: the plan flattens with ``jax.tree`` (sorted dict keys,
+positional lists/tuples).  The wire codec (`comm/message.py
+_flatten_arrays`) canonicalizes identically for the plain-container
+trees model params actually are, and `from_spec` + the codec's
+``structure`` spec let a SILO rebuild split/join from the sync frame
+alone — zero client-side shard configuration, like the secagg sync.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import zlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+Pytree = Any
+
+# wire slice keys: zero-padded so string sort order == leaf order
+_LEAF_KEY_DIGITS = 5
+
+
+def _leaf_key(i: int) -> str:
+    return f"{i:0{_LEAF_KEY_DIGITS}d}"
+
+
+def _shard_key(s: int) -> str:
+    return f"s{s}"
+
+
+@dataclasses.dataclass(frozen=True)
+class LeafPlan:
+    """One leaf's layout: ``mode`` is ``"split"`` (shard ``s`` owns the
+    ``s``-th block of ``dim``) or ``"rep"`` (whole leaf owned by
+    ``owner``, replicated for placement)."""
+    index: int
+    path: str
+    shape: tuple
+    dtype: str
+    is_weight: bool          # counts toward the clip norm (core/robust.py)
+    mode: str                # "split" | "rep"
+    dim: int = -1            # split dimension (mode == "split")
+    owner: int = 0           # owning shard (mode == "rep")
+
+    def to_json(self) -> dict:
+        return {"i": self.index, "path": self.path,
+                "shape": list(self.shape), "dtype": self.dtype,
+                "w": int(self.is_weight), "mode": self.mode,
+                "dim": self.dim, "owner": self.owner}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "LeafPlan":
+        return cls(index=int(d["i"]), path=str(d["path"]),
+                   shape=tuple(int(x) for x in d["shape"]),
+                   dtype=str(d["dtype"]), is_weight=bool(d["w"]),
+                   mode=str(d["mode"]), dim=int(d["dim"]),
+                   owner=int(d["owner"]))
+
+
+def _path_str(path) -> str:
+    from jax.tree_util import DictKey, SequenceKey
+    parts = []
+    for p in path:
+        if isinstance(p, DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, SequenceKey):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return "/".join(parts)
+
+
+class ShardPlan:
+    """The derived layout.  Build with `build_shard_plan` (server side,
+    from the live template) or `ShardPlan.from_spec` (silo side, from
+    the sync frame's descriptor — structure only, no arrays)."""
+
+    def __init__(self, num_shards: int, leaves: Sequence[LeafPlan],
+                 min_split_elems: int):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        self.num_shards = int(num_shards)
+        self.leaves: List[LeafPlan] = list(leaves)
+        self.min_split_elems = int(min_split_elems)
+        # shard -> ordered leaf indices it carries a piece of
+        self.members: List[List[int]] = [[] for _ in range(num_shards)]
+        for lp in self.leaves:
+            if lp.mode == "split":
+                for s in range(num_shards):
+                    self.members[s].append(lp.index)
+            else:
+                self.members[lp.owner].append(lp.index)
+
+    # -- identity ------------------------------------------------------------
+    def descriptor(self) -> dict:
+        """The JSON-able identity of the layout (everything `fingerprint`
+        covers; `spec()` adds the client-facing structure)."""
+        return {"num_shards": self.num_shards,
+                "min_split_elems": self.min_split_elems,
+                "leaves": [lp.to_json() for lp in self.leaves]}
+
+    def fingerprint(self) -> int:
+        """crc32 of the canonical descriptor — stamped into checkpoints
+        and journal snapshots so a resume can verify it re-derived the
+        IDENTICAL layout (restoring sharded fold state into a different
+        plan would mis-aggregate silently)."""
+        blob = json.dumps(self.descriptor(), sort_keys=True).encode()
+        return zlib.crc32(blob)
+
+    def spec(self) -> dict:
+        """What the sync frame ships (shard 0) so a silo can split/join
+        with zero configuration: the descriptor plus the codec-form
+        ``structure`` spec `SiloShardCodec` unflattens with."""
+        return dict(self.descriptor(), structure=self._structure)
+
+    # populated by build_shard_plan / from_spec
+    _structure: Optional[dict] = None
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "ShardPlan":
+        plan = cls(int(spec["num_shards"]),
+                   [LeafPlan.from_json(d) for d in spec["leaves"]],
+                   int(spec["min_split_elems"]))
+        plan._structure = spec.get("structure")
+        return plan
+
+    # -- leaf-list split / join ----------------------------------------------
+    def _piece(self, lp: LeafPlan, arr, shard: int):
+        if lp.mode != "split":
+            return arr
+        n = arr.shape[lp.dim] // self.num_shards
+        idx = [slice(None)] * arr.ndim
+        idx[lp.dim] = slice(shard * n, (shard + 1) * n)
+        return arr[tuple(idx)]
+
+    def piece_shape(self, lp: LeafPlan) -> tuple:
+        if lp.mode != "split":
+            return lp.shape
+        shape = list(lp.shape)
+        shape[lp.dim] //= self.num_shards
+        return tuple(shape)
+
+    def split_leaves(self, leaves: Sequence) -> List[Dict[str, dict]]:
+        """Ordered leaf list → one wire slice dict per shard.  Split
+        pieces are VIEWS of the input arrays (numpy basic slicing) — the
+        single copy per piece happens where the wire encodes it."""
+        if len(leaves) != len(self.leaves):
+            raise ValueError(
+                f"shard plan covers {len(self.leaves)} leaves but the "
+                f"tree has {len(leaves)} — the model does not match the "
+                f"plan's template")
+        out: List[Dict[str, dict]] = [
+            {_shard_key(s): {}} for s in range(self.num_shards)]
+        for lp, leaf in zip(self.leaves, leaves):
+            arr = np.asarray(leaf)
+            if tuple(arr.shape) != lp.shape:
+                raise ValueError(
+                    f"leaf {lp.index} ({lp.path}) has shape {arr.shape} "
+                    f"but the plan expects {lp.shape}")
+            if lp.mode == "split":
+                for s in range(self.num_shards):
+                    out[s][_shard_key(s)][_leaf_key(lp.index)] = \
+                        self._piece(lp, arr, s)
+            else:
+                out[lp.owner][_shard_key(lp.owner)][
+                    _leaf_key(lp.index)] = arr
+        return out
+
+    def join_slices(self, slices: Sequence[Dict[str, dict]]) -> List:
+        """One wire slice per shard → the ordered full leaf list
+        (np.concatenate along the split dim; exact — concatenation does
+        no arithmetic)."""
+        if len(slices) != self.num_shards:
+            raise ValueError(f"join_slices needs {self.num_shards} "
+                             f"slices, got {len(slices)}")
+        inner = []
+        for s, sl in enumerate(slices):
+            body = sl.get(_shard_key(s))
+            if body is None:
+                raise ValueError(
+                    f"slice {s} does not carry the '{_shard_key(s)}' "
+                    f"shard key (wrong-shard or malformed slice)")
+            inner.append(body)
+        leaves: List = []
+        for lp in self.leaves:
+            key = _leaf_key(lp.index)
+            if lp.mode == "split":
+                pieces = [np.asarray(inner[s][key])
+                          for s in range(self.num_shards)]
+                leaves.append(np.concatenate(pieces, axis=lp.dim)
+                              if self.num_shards > 1 else pieces[0])
+            else:
+                leaves.append(np.asarray(inner[lp.owner][key]))
+        return leaves
+
+    def slice_weight_flags(self, shard: int) -> tuple:
+        """Per-piece is_weight flags in the shard slice's KEY ORDER (the
+        order `jax.tree` flattens the slice dict — zero-padded keys sort
+        numerically), for the clip mask inside the per-shard fold jit."""
+        idxs = sorted(self.members[shard])
+        by_index = {lp.index: lp for lp in self.leaves}
+        return tuple(by_index[i].is_weight for i in idxs)
+
+    def slice_ref_dtypes(self, shard: int) -> tuple:
+        idxs = sorted(self.members[shard])
+        by_index = {lp.index: lp for lp in self.leaves}
+        return tuple(by_index[i].dtype for i in idxs)
+
+    def slice_nbytes(self, shard: int) -> int:
+        """Bytes of one shard's slice (the O(model/S) evidence)."""
+        total = 0
+        by_index = {lp.index: lp for lp in self.leaves}
+        for i in self.members[shard]:
+            lp = by_index[i]
+            total += int(np.prod(self.piece_shape(lp) or (1,))
+                         * np.dtype(lp.dtype).itemsize)
+        return total
+
+    # -- placement (NamedSharding over the mesh's model axis) ----------------
+    def leaf_partition_specs(self, axis: str = "model") -> List:
+        """One `PartitionSpec` per leaf for laying the ASSEMBLED global
+        out sharded on a mesh: split leaves put their split dim on
+        ``axis``, replicated leaves are ``P()`` — the `NamedSharding`
+        form of this plan."""
+        from jax.sharding import PartitionSpec as P
+        specs = []
+        for lp in self.leaves:
+            if lp.mode == "split":
+                spec = [None] * len(lp.shape)
+                spec[lp.dim] = axis
+                specs.append(P(*spec))
+            else:
+                specs.append(P())
+        return specs
+
+    def place_global(self, tree: Pytree, mesh, axis: str = "model"):
+        """Lay the assembled global out as `NamedSharding` shards over
+        ``mesh``'s ``axis`` per this plan (the pjit-visible round
+        state).  Identity when ``mesh`` is None."""
+        if mesh is None:
+            return tree
+        import jax
+        from jax.sharding import NamedSharding
+        leaves, treedef = jax.tree.flatten(tree)
+        specs = self.leaf_partition_specs(axis)
+        placed = [jax.device_put(leaf, NamedSharding(mesh, spec))
+                  for leaf, spec in zip(leaves, specs)]
+        return jax.tree.unflatten(treedef, placed)
+
+    def shard_devices(self, mesh, axis: str = "model") -> Optional[list]:
+        """Device of each shard on ``mesh``'s ``axis`` (slice/fold state
+        placement: shard ``s``'s pieces live wholly on device ``s``).
+        None when no mesh — everything stays on the default device."""
+        if mesh is None:
+            return None
+        if mesh.shape[axis] != self.num_shards:
+            raise ValueError(
+                f"mesh {axis} axis has {mesh.shape[axis]} devices but "
+                f"the plan has {self.num_shards} shards")
+        import numpy as _np
+        arr = _np.asarray(mesh.devices)
+        axis_index = mesh.axis_names.index(axis)
+        return [arr.take(s, axis=axis_index).ravel()[0]
+                for s in range(self.num_shards)]
+
+
+def build_shard_plan(template: Pytree, num_shards: int,
+                     min_split_elems: int = 1024) -> ShardPlan:
+    """Derive the plan from a live template tree.  Deterministic in
+    (leaf shapes/dtypes, ``num_shards``, ``min_split_elems``) only — a
+    restart re-derives the identical plan, which `fingerprint()` lets
+    checkpoints verify."""
+    import jax
+    from fedml_tpu.core.robust import default_is_weight_param
+
+    flat = jax.tree_util.tree_leaves_with_path(template)
+    leaves: List[LeafPlan] = []
+    rep_bytes = [0] * num_shards
+    split_bytes = 0
+    for i, (path, leaf) in enumerate(flat):
+        arr = np.asarray(leaf)
+        shape = tuple(int(d) for d in arr.shape)
+        is_w = bool(default_is_weight_param(path))
+        dim = -1
+        if num_shards > 1 and arr.size >= min_split_elems:
+            for d, n in enumerate(shape):
+                if n >= num_shards and n % num_shards == 0:
+                    dim = d
+                    break
+        if dim >= 0:
+            leaves.append(LeafPlan(i, _path_str(path), shape,
+                                   arr.dtype.str, is_w, "split", dim=dim))
+            split_bytes += arr.nbytes
+        else:
+            # greedy balance: lightest shard first, ties to the lowest
+            # id — deterministic given the canonical leaf order
+            owner = int(np.argmin(rep_bytes))
+            rep_bytes[owner] += arr.nbytes
+            leaves.append(LeafPlan(i, _path_str(path), shape,
+                                   arr.dtype.str, is_w, "rep", owner=owner))
+    plan = ShardPlan(num_shards, leaves, min_split_elems)
+    # the client-facing structure: the wire codec's flatten spec of the
+    # template, so a silo can unflatten joined leaves into the params
+    # tree (and flatten its trained tree back) with zero configuration.
+    # The codec and jax.tree canonicalize plain-container trees the same
+    # way; verify leaf-for-leaf here so a tree they'd disagree on fails
+    # at plan build, not as silently-permuted params on a silo
+    from fedml_tpu.comm.message import _flatten_arrays
+    codec_leaves, structure = _flatten_arrays(
+        jax.tree.map(np.asarray, template))
+    if codec_leaves is None or len(codec_leaves) != len(flat) or any(
+            np.asarray(a).shape != np.asarray(b).shape
+            or np.asarray(a).dtype != np.asarray(b).dtype
+            for a, (_, b) in zip(codec_leaves, flat)):
+        raise ValueError(
+            "the model's parameter tree does not canonicalize identically "
+            "through jax.tree and the wire codec; --model_shards needs "
+            "plain dict/list/tuple params (every in-tree model qualifies)")
+    plan._structure = structure
+    return plan
+
+
+class SiloShardCodec:
+    """Silo-side split/join built purely from the sync frame's plan
+    spec: ``join(slices) -> params tree`` for training, ``split(tree) ->
+    slices`` for the upload.  Cached per spec fingerprint by the client
+    actor — the spec is static across rounds."""
+
+    def __init__(self, spec: dict):
+        self.plan = ShardPlan.from_spec(spec)
+        self._structure = spec.get("structure")
+        if self._structure is None:
+            raise ValueError("shard spec carries no structure; the silo "
+                             "cannot rebuild the params tree from slices")
+        self.fingerprint = self.plan.fingerprint()
+
+    @property
+    def num_shards(self) -> int:
+        return self.plan.num_shards
+
+    def join(self, slices: Sequence[dict]):
+        from fedml_tpu.comm.message import _unflatten_arrays
+        return _unflatten_arrays(self._structure,
+                                 self.plan.join_slices(slices))
+
+    def split(self, tree: Pytree) -> List[dict]:
+        from fedml_tpu.comm.message import _flatten_arrays
+        leaves, _ = _flatten_arrays(tree)
+        if leaves is None:
+            raise ValueError("cannot split a tree with no array leaves")
+        return self.plan.split_leaves(leaves)

@@ -463,6 +463,12 @@ def _encode_snapshot(round_idx: int, folds: List[tuple], state: dict,
             "n_ref": len(state.get("reference") or [])}
     if global_crc is not None:
         meta["global_crc"] = int(global_crc)
+    if state.get("shard_fp") is not None:
+        # sharded spine (shard_spine/agg.py): the layout fingerprint
+        # rides the snapshot so recovery can REFUSE to restore sharded
+        # fold state under a different --model_shards layout (restoring
+        # pieces into the wrong slots would mis-aggregate silently)
+        meta["shard_fp"] = int(state["shard_fp"])
     arrays: Dict[str, np.ndarray] = {
         "__wsum__": np.asarray(state["wsum"], np.float32),
         "__weight_total__": np.asarray(state["weight_total"], np.float64)}
@@ -486,4 +492,6 @@ def _decode_snapshot(path: str):
         if meta.get("n_ref"):
             state["reference"] = [z[f"ref_{i}"]
                                   for i in range(meta["n_ref"])]
+        if meta.get("shard_fp") is not None:
+            state["shard_fp"] = int(meta["shard_fp"])
     return meta, state

@@ -204,4 +204,12 @@ EOF
 env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
     --ledger "$STREAM_RUN/perf.jsonl" --baseline "$STREAM_RUN/perf.jsonl"
 echo "stream ledger OK: fold phase recorded, trend gate green"
+
+# sharded-spine smoke (fedml_tpu/shard_spine): per-device memory ~1/S,
+# S=1 bit-parity, fused-finalize kernel named in the compile ledger
+# with a non-null MFU, 0 recompiles under strict — the full gates of
+# scripts/shard_bench.py at CI size (output to /tmp so the committed
+# BENCH_shard.json keeps full-bench numbers)
+env JAX_PLATFORMS=cpu python scripts/shard_bench.py --smoke
+echo "shard spine smoke OK: per-device scaling + fused finalize gates green"
 echo "== obs demo OK ($DIR)"

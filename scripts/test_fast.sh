@@ -26,5 +26,12 @@ cd "$(dirname "$0")/.."
 [ -f tests/test_secagg_live.py ]   # ...and the live secure-aggregation suite
 [ -f tests/test_crash_recovery.py ]  # ...and the crash-consistency suite
 [ -f tests/test_cross_device.py ]  # ...and the cross-device wave suite
+[ -f tests/test_shard_spine.py ]   # ...and the sharded-spine suite
+# the interpret-mode kernel parity suites guard the Pallas kernels the
+# sharded spine promotes to the live path — they must ride the fast
+# tier (neither is @slow; this asserts they exist and stay collected)
+[ -f tests/test_pallas_agg.py ]
+[ -f tests/test_pallas_mask.py ]
+grep -q "fused=True" tests/test_shard_spine.py  # fused-finalize parity too
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

@@ -134,6 +134,16 @@ EXPECTED = {
     "fedml_cohort_wave_rejected_total",
     "fedml_cohort_wave_seconds",
     "fedml_cohort_fold_seconds",
+    # PR 14: the sharded global-model spine (fedml_tpu/shard_spine):
+    # shard slices received/folded, per-silo rejections on the sharded
+    # wire (labeled by the shared REASONS vocabulary), the per-shard
+    # defended finalize's wall time and fused-kernel launches, and the
+    # O(model/S) evidence gauge (largest per-shard accumulator bytes)
+    "fedml_shard_slices_total",
+    "fedml_shard_rejected_total",
+    "fedml_shard_finalize_seconds",
+    "fedml_shard_fused_launches_total",
+    "fedml_shard_acc_bytes",
 }
 
 

@@ -1,0 +1,877 @@
+"""Sharded global-model spine (fedml_tpu/shard_spine) — ROADMAP item 2.
+
+The load-bearing pins:
+
+* **parity matrix** — S=1 is BIT-IDENTICAL to the replicated streaming
+  path (clip and noise included: same op order, same key chain); S>1 is
+  bit-identical unclipped, float-tolerance with clip (the two-phase
+  global-norm scale sums partials in shard order), and sigma>0 draws
+  per-shard streams (same distribution, documented-different bits);
+* per-shard fold == whole-model fold, for `fold`, `fold_slices`, and
+  `fold_wave`;
+* the fused Pallas finalize (sigma=0) == the XLA compose bit-for-bit;
+* the admission fingerprint rejects a wrong-shard upload (the shard id
+  is part of the screened structure);
+* shard-plan checkpoint/journal round-trip: a crash mid-round under
+  --model_shards resumes bit-identical, and a layout mismatch ABANDONS
+  to the boundary instead of restoring into the wrong slots;
+* jit-once per shard under --perf_strict on the live wire;
+* one payload encode per SHARD per broadcast, never per receiver;
+* the config-gate matrix fails loudly with reasons.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.algorithms.cross_silo import (FedAvgClientActor,
+                                             FedAvgServerActor, MsgType)
+from fedml_tpu.comm.local import LocalHub
+from fedml_tpu.comm.message import CODEC_COUNTS, Message
+from fedml_tpu.core.stream_agg import StreamingAggregator
+from fedml_tpu.experiments.config import ExperimentConfig
+from fedml_tpu.robust.faultline import ActorKilled, CrashSpec, Faultline
+from fedml_tpu.shard_spine import (ShardAdmission,
+                                   ShardedStreamingAggregator,
+                                   SiloShardCodec, build_shard_plan,
+                                   build_shard_spine)
+from fedml_tpu.shard_spine.admission import ACCEPT, REJECT, WAIT
+from fedml_tpu.utils.checkpoint import RoundCheckpointer
+from fedml_tpu.utils.journal import RoundJournal
+
+
+def _params(seed=3):
+    rng = np.random.RandomState(seed)
+    return {"dense": {"kernel": rng.randn(16, 12).astype(np.float32),
+                      "bias": rng.randn(12).astype(np.float32)},
+            "conv": {"kernel": rng.randn(3, 3, 4, 8).astype(np.float32)},
+            "step": np.int32(5)}
+
+
+def _uploads(n, seed=7, tmpl=None):
+    rng = np.random.RandomState(seed)
+    tmpl = tmpl if tmpl is not None else _params()
+    ups, ws = [], []
+    for i in range(n):
+        ups.append(jax.tree.map(
+            lambda v: (np.asarray(v)
+                       + rng.randn(*np.shape(v))).astype(
+                           np.asarray(v).dtype), tmpl))
+        ws.append(float(10 * (i + 1)))
+    return ups, ws
+
+
+def _bits_equal(a, b):
+    return all(np.asarray(x).tobytes() == np.asarray(y).tobytes()
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+def _allclose(a, b, rtol=1e-5, atol=1e-6):
+    return all(np.allclose(np.asarray(x), np.asarray(y), rtol=rtol,
+                           atol=atol)
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# ---------------------------------------------------------------------------
+# the plan: deterministic, wire-stable, checkpoint-verifiable
+# ---------------------------------------------------------------------------
+
+class TestShardPlan:
+    @pytest.mark.parametrize("S", [1, 2, 4])
+    def test_split_join_roundtrip_exact(self, S):
+        tmpl = _params()
+        plan = build_shard_plan(tmpl, S, min_split_elems=64)
+        leaves = [np.asarray(x) for x in jax.tree.leaves(tmpl)]
+        back = plan.join_slices(plan.split_leaves(leaves))
+        assert all(np.array_equal(a, b) and a.dtype == b.dtype
+                   for a, b in zip(leaves, back))
+
+    def test_plan_deterministic_and_fingerprinted(self):
+        tmpl = _params()
+        a = build_shard_plan(tmpl, 4, min_split_elems=64)
+        b = build_shard_plan(tmpl, 4, min_split_elems=64)
+        assert a.descriptor() == b.descriptor()
+        assert a.fingerprint() == b.fingerprint()
+        # the identity covers the layout: a different S or threshold is
+        # a different fingerprint
+        assert a.fingerprint() != build_shard_plan(
+            tmpl, 2, min_split_elems=64).fingerprint()
+        assert a.fingerprint() != build_shard_plan(
+            tmpl, 4, min_split_elems=10**9).fingerprint()
+
+    def test_every_leaf_owned_exactly_once(self):
+        tmpl = _params()
+        plan = build_shard_plan(tmpl, 4, min_split_elems=64)
+        owned = [lp.index for lp in plan.leaves if lp.mode == "rep"]
+        assert len(owned) == len(set(owned))
+        # small leaves replicate for placement but own ONE fold slot
+        from jax.sharding import PartitionSpec as P
+        specs = plan.leaf_partition_specs()
+        for lp, spec in zip(plan.leaves, specs):
+            if lp.mode == "rep":
+                assert spec == P()
+
+    def test_slice_nbytes_scale_inverse_in_shards(self):
+        """The memory contract the bench measures from live buffers:
+        the largest shard slice is ~1/S of the model."""
+        tmpl = _params()
+        total = sum(np.asarray(x).nbytes for x in jax.tree.leaves(tmpl))
+        p1 = build_shard_plan(tmpl, 1, min_split_elems=64)
+        p4 = build_shard_plan(tmpl, 4, min_split_elems=64)
+        assert p1.slice_nbytes(0) == total
+        assert max(p4.slice_nbytes(s) for s in range(4)) < 0.4 * total
+
+    def test_silo_codec_roundtrip_through_real_wire(self):
+        """The sync frame's spec is all a silo needs: slices travel
+        through the REAL codec, join into the params tree, split back,
+        and re-join exactly."""
+        tmpl = _params()
+        plan = build_shard_plan(tmpl, 2, min_split_elems=64)
+        spec = json.loads(json.dumps(plan.spec()))  # the JSON header hop
+        codec = SiloShardCodec(spec)
+        assert codec.fingerprint == plan.fingerprint()
+        leaves = [np.asarray(x) for x in jax.tree.leaves(tmpl)]
+        wire_slices = []
+        for s, sl in enumerate(plan.split_leaves(leaves)):
+            msg = Message(MsgType.S2C_SYNC, 0, 1)
+            msg.add(Message.ARG_MODEL_PARAMS, sl)
+            wire_slices.append(Message.from_bytes(msg.to_bytes())
+                               .get(Message.ARG_MODEL_PARAMS))
+        tree = codec.join(wire_slices)
+        assert _bits_equal(tmpl, tree)
+        assert _bits_equal(tmpl, codec.join(codec.split(tree)))
+
+    def test_wrong_shard_slice_fingerprints_differently(self):
+        """Even when an even split makes every shard's pieces
+        shape-identical, the shard id in the structure tells them
+        apart — the admission reject below rides exactly this."""
+        from fedml_tpu.robust.admission import params_fingerprint
+        tmpl = {"w": np.zeros((8, 4), np.float32)}
+        plan = build_shard_plan(tmpl, 2, min_split_elems=4)
+        slices = plan.split_leaves(
+            [np.asarray(x) for x in jax.tree.leaves(tmpl)])
+        assert params_fingerprint(slices[0]) \
+            != params_fingerprint(slices[1])
+
+    def test_mesh_factorization_fails_loudly(self):
+        """Satellite pin: the mesh builders raise named ValueErrors (no
+        bare assert that vanishes under python -O, no bare
+        ZeroDivisionError)."""
+        from fedml_tpu.parallel.mesh import (make_mesh, make_model_mesh,
+                                             make_two_level_mesh)
+        with pytest.raises(ValueError, match="factor"):
+            make_mesh(client_axis=3, model_axis=2,
+                      devices=jax.devices())          # 6 != 8
+        with pytest.raises(ValueError, match="model_axis"):
+            make_mesh(model_axis=0)
+        with pytest.raises(ValueError, match="groups axis must be >= 1"):
+            make_two_level_mesh(group_axis=0)
+        with pytest.raises(ValueError, match="product"):
+            make_two_level_mesh(group_axis=3)          # 3 !| 8
+        with pytest.raises(ValueError, match="num_shards"):
+            make_model_mesh(0)
+        assert make_model_mesh(9999) is None  # too few devices: honest
+
+
+# ---------------------------------------------------------------------------
+# the sharded fold: parity with the replicated streaming spine
+# ---------------------------------------------------------------------------
+
+class TestShardedFoldParity:
+    def _run_pair(self, S, clip, noise, tmpl=None, seed=3):
+        tmpl = tmpl if tmpl is not None else _params()
+        ups, ws = _uploads(5, tmpl=tmpl)
+        plain = StreamingAggregator(tmpl, method="mean", norm_clip=clip,
+                                    noise_std=noise, seed=seed)
+        plain.reset(tmpl)
+        for u, w in zip(ups, ws):
+            plain.fold(u, w)
+        want = plain.finalize(2)
+        plan = build_shard_plan(tmpl, S, min_split_elems=64)
+        agg = ShardedStreamingAggregator(plan, tmpl, norm_clip=clip,
+                                         noise_std=noise, seed=seed)
+        agg.reset(tmpl)
+        for u, w in zip(ups, ws):
+            agg.fold(u, w)
+        got = agg.finalize(2)
+        assert agg.count == plain.count
+        assert agg.weight_total == plain.weight_total
+        return want, got
+
+    @pytest.mark.parametrize("clip,noise", [(0.0, 0.0), (2.5, 0.0),
+                                            (2.5, 0.02)])
+    def test_s1_bit_identical_to_replicated(self, clip, noise):
+        """The S=1 pin covers EVERYTHING: clip (two-phase scale == the
+        in-jit norm, same op order) and noise (same key chain, same
+        per-leaf split)."""
+        want, got = self._run_pair(1, clip, noise)
+        assert _bits_equal(want, got)
+
+    @pytest.mark.parametrize("S", [2, 4])
+    def test_unclipped_bit_identical_any_s(self, S):
+        want, got = self._run_pair(S, 0.0, 0.0)
+        assert _bits_equal(want, got)
+
+    @pytest.mark.parametrize("S", [2, 4])
+    def test_clipped_allclose_sigma0_exact_division(self, S):
+        """S>1 with clip: the scale's partials sum in shard order —
+        float tolerance, with sigma=0 (the defended-mean finalize's
+        division itself stays elementwise-exact)."""
+        want, got = self._run_pair(S, 2.5, 0.0)
+        assert _allclose(want, got)
+
+    def test_sigma_pos_sharded_stream_is_finite_and_distinct(self):
+        """S>1 noise draws per-shard streams: same distribution,
+        different bits (documented divergence — never compared bitwise
+        across S)."""
+        want, got = self._run_pair(2, 0.0, 0.05)
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(got))
+        assert not _bits_equal(want, got)
+
+    def test_fold_slices_equals_fold(self):
+        tmpl = _params()
+        ups, ws = _uploads(4, tmpl=tmpl)
+        plan = build_shard_plan(tmpl, 2, min_split_elems=64)
+        a = ShardedStreamingAggregator(plan, tmpl, norm_clip=2.0)
+        b = ShardedStreamingAggregator(plan, tmpl, norm_clip=2.0)
+        a.reset(tmpl)
+        b.reset(tmpl)
+        for u, w in zip(ups, ws):
+            a.fold(u, w)
+            leaves = [np.asarray(x) for x in jax.tree.leaves(u)]
+            b.fold_slices(plan.split_leaves(leaves), w)
+        assert _bits_equal(a.finalize(0), b.finalize(0))
+
+    @pytest.mark.parametrize("S,clip,expect_bits", [
+        (1, 0.0, True), (4, 0.0, True), (1, 2.0, True), (4, 2.0, False)])
+    def test_fold_wave_matches_replicated_wave(self, S, clip,
+                                               expect_bits):
+        import jax.numpy as jnp
+        tmpl = _params()
+        ups, ws = _uploads(5, tmpl=tmpl)
+        stk = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *ups)
+        plain = StreamingAggregator(tmpl, method="mean", norm_clip=clip)
+        plain.reset(tmpl)
+        plain.fold_wave(jax.tree.map(jnp.asarray, stk),
+                        np.asarray(ws, np.float32))
+        want = plain.finalize(0)
+        plan = build_shard_plan(tmpl, S, min_split_elems=64)
+        agg = ShardedStreamingAggregator(plan, tmpl, norm_clip=clip)
+        agg.reset(tmpl)
+        agg.fold_wave(stk, np.asarray(ws, np.float32))
+        got = agg.finalize(0)
+        assert _allclose(want, got)
+        if expect_bits:
+            assert _bits_equal(want, got)
+        # weight-0 pad slots contribute an exact +0.0
+        agg2 = ShardedStreamingAggregator(plan, tmpl, norm_clip=clip)
+        agg2.reset(tmpl)
+        w0 = np.asarray(ws + [0.0], np.float32)
+        stk0 = jax.tree.map(
+            lambda s, t: np.concatenate([s, np.asarray(t)[None]]),
+            stk, tmpl)
+        agg2.fold_wave(stk0, w0)
+        assert agg2.count == agg.count
+        assert _bits_equal(got, agg2.finalize(0))
+
+    def test_order_statistic_rules_refuse(self):
+        with pytest.raises(ValueError, match="params"):
+            ShardedStreamingAggregator(
+                build_shard_plan(_params(), 2, min_split_elems=64),
+                _params(), kind="delta")
+
+    def test_mesh_places_each_shard_on_its_own_device(self, devices):
+        from fedml_tpu.parallel.mesh import make_model_mesh
+        tmpl = _params()
+        mesh = make_model_mesh(4)
+        plan = build_shard_plan(tmpl, 4, min_split_elems=64)
+        agg = ShardedStreamingAggregator(plan, tmpl, mesh=mesh)
+        agg.reset(tmpl)
+        ups, ws = _uploads(3, tmpl=tmpl)
+        for u, w in zip(ups, ws):
+            agg.fold(u, w)
+        dev_ids = set()
+        for body in agg._acc:
+            ids = {d.id for v in body.values() for d in v.devices()}
+            assert len(ids) == 1  # one shard, one device
+            dev_ids |= ids
+        assert len(dev_ids) == 4
+        plain = StreamingAggregator(tmpl, method="mean")
+        plain.reset(tmpl)
+        for u, w in zip(ups, ws):
+            plain.fold(u, w)
+        assert _bits_equal(plain.finalize(0), agg.finalize(0))
+        # the assembled global lays out as NamedSharding over the mesh
+        placed = plan.place_global(tmpl, mesh)
+        kern = placed["dense"]["kernel"]
+        shards = list(kern.addressable_shards)
+        assert len(shards) == 4
+        assert len({sh.data.nbytes for sh in shards}) == 1
+
+
+# ---------------------------------------------------------------------------
+# the fused Pallas finalize
+# ---------------------------------------------------------------------------
+
+class TestFusedFinalize:
+    @pytest.mark.parametrize("S,clip", [(1, 0.0), (2, 0.0), (2, 2.5)])
+    def test_fused_sigma0_bit_equal_to_xla(self, S, clip):
+        tmpl = _params()
+        ups, ws = _uploads(4, tmpl=tmpl)
+        plan = build_shard_plan(tmpl, S, min_split_elems=64)
+        outs = []
+        for fused in (False, True):
+            agg = ShardedStreamingAggregator(plan, tmpl, norm_clip=clip,
+                                             fused=fused, interpret=True)
+            agg.reset(tmpl)
+            for u, w in zip(ups, ws):
+                agg.fold(u, w)
+            outs.append(agg.finalize(1))
+        assert _bits_equal(*outs)
+
+    def test_fused_noise_statistics_and_step_keying(self):
+        tmpl = {"w": np.zeros((64, 128), np.float32)}
+        ups = [{"w": np.random.RandomState(i).randn(64, 128)
+                .astype(np.float32)} for i in range(3)]
+        plan = build_shard_plan(tmpl, 2, min_split_elems=64)
+        sigma = 0.5
+
+        def run(noise, step):
+            agg = ShardedStreamingAggregator(plan, tmpl,
+                                             noise_std=noise, fused=True,
+                                             interpret=True, seed=9)
+            agg.reset(tmpl)
+            for u in ups:
+                agg.fold(u, 1.0)
+            return np.asarray(agg.finalize(step)["w"])
+
+        base = run(0.0, 1)
+        noised = run(sigma, 1)
+        delta = (noised - base).ravel()
+        assert abs(delta.mean()) < 0.02
+        np.testing.assert_allclose(delta.std(), sigma, rtol=0.1)
+        # same step => same draw; different step => different draw
+        np.testing.assert_array_equal(noised, run(sigma, 1))
+        assert not np.allclose(noised, run(sigma, 2))
+
+
+# ---------------------------------------------------------------------------
+# per-shard admission
+# ---------------------------------------------------------------------------
+
+class TestShardAdmission:
+    def _adm(self, tmpl=None, S=2, **kw):
+        tmpl = tmpl if tmpl is not None else _params()
+        plan = build_shard_plan(tmpl, S, min_split_elems=64)
+        adm = ShardAdmission(plan, tmpl, **kw)
+        adm.round_start(tmpl)
+        return plan, adm
+
+    def _slices(self, plan, tree):
+        return plan.split_leaves(
+            [np.asarray(x) for x in jax.tree.leaves(tree)])
+
+    def test_complete_silo_accepts_with_combined_norm(self):
+        plan, adm = self._adm()
+        up = _uploads(1)[0][0]
+        sl = self._slices(plan, up)
+        status, _ = adm.offer(1, 0, 2, sl[0], 10, 0)
+        assert status == WAIT
+        status, info = adm.offer(1, 1, 2, sl[1], 10, 0)
+        assert status == ACCEPT
+        from fedml_tpu.robust.admission import (_leaves, update_sumsq)
+        ref = [np.asarray(x, np.float64)
+               for x in _leaves(jax.tree.map(np.asarray, _params()))]
+        want = np.sqrt(update_sumsq(
+            {str(i): leaf for i, leaf in
+             enumerate(_leaves(jax.tree.map(np.asarray, up)))}, ref))
+        assert info["norm"] == pytest.approx(float(want), rel=1e-9)
+        assert [f"s{s}" in x for s, x in enumerate(info["slices"])]
+
+    def test_wrong_shard_upload_fingerprint_rejected(self):
+        """THE satellite pin: shard 1's slice posing as shard 0 is a
+        structural reject before anything folds."""
+        plan, adm = self._adm()
+        sl = self._slices(plan, _uploads(1)[0][0])
+        status, info = adm.offer(1, 0, 2, sl[1], 10, 0)
+        assert status == REJECT and info["reason"] == "fingerprint"
+        assert adm.rejected["fingerprint"] == 1
+        # a shard index outside the plan is the same bucket
+        plan2, adm2 = self._adm()
+        sl2 = self._slices(plan2, _uploads(1)[0][0])
+        assert adm2.offer(1, 5, 2, sl2[0], 10, 0)[0] == REJECT
+        assert adm2.offer(2, 0, 3, sl2[0], 10, 0)[0] == REJECT
+
+    def test_one_bad_slice_rejects_the_whole_silo(self):
+        plan, adm = self._adm()
+        up = _uploads(1)[0][0]
+        sl = self._slices(plan, up)
+        bad = {k: {kk: np.full_like(vv, np.nan) if vv.dtype.kind == "f"
+                   else vv for kk, vv in v.items()}
+               for k, v in sl[1].items()}
+        assert adm.offer(1, 0, 2, sl[0], 10, 0)[0] == WAIT
+        status, info = adm.offer(1, 1, 2, bad, 10, 0)
+        assert status == REJECT and info["reason"] == "nonfinite"
+        assert not adm.pending_silos()  # the hold is dropped whole
+
+    def test_inconsistent_num_samples_rejected(self):
+        plan, adm = self._adm()
+        sl = self._slices(plan, _uploads(1)[0][0])
+        assert adm.offer(1, 0, 2, sl[0], 10, 0)[0] == WAIT
+        status, info = adm.offer(1, 1, 2, sl[1], 999, 0)
+        assert status == REJECT and info["reason"] == "bad_num_samples"
+
+    def test_duplicate_slice_is_banked_once(self):
+        plan, adm = self._adm()
+        sl = self._slices(plan, _uploads(1)[0][0])
+        assert adm.offer(1, 0, 2, sl[0], 10, 0)[0] == WAIT
+        assert adm.offer(1, 0, 2, sl[0], 10, 0)[0] == WAIT  # dup
+        assert adm.offer(1, 1, 2, sl[1], 10, 0)[0] == ACCEPT
+
+    def test_combined_norm_outlier_screen(self):
+        plan, adm = self._adm(norm_min_history=4, norm_k=6.0)
+        ups, _ = _uploads(6)
+        for silo, up in enumerate(ups[:4], start=1):
+            sl = self._slices(plan, up)
+            assert adm.offer(silo, 0, 2, sl[0], 10, 0)[0] == WAIT
+            assert adm.offer(silo, 1, 2, sl[1], 10, 0)[0] == ACCEPT
+        big = jax.tree.map(
+            lambda v: (np.asarray(v) * 1000).astype(np.asarray(v).dtype),
+            ups[4])
+        sl = self._slices(plan, big)
+        assert adm.offer(5, 0, 2, sl[0], 10, 0)[0] == WAIT
+        status, info = adm.offer(5, 1, 2, sl[1], 10, 0)
+        assert status == REJECT and info["reason"] == "norm_outlier"
+        assert info["norm"] is not None
+
+    def test_stale_round_frame_never_wipes_current_assembly(self):
+        """A chaos-delayed/duplicated OLDER-round sync slice must not
+        destroy the silo's current round's partial assembly — only a
+        NEWER round supersedes it."""
+        from fedml_tpu.shard_spine import SiloShardAssembler
+        tmpl = _params()
+        plan = build_shard_plan(tmpl, 2, min_split_elems=64)
+        spec = plan.spec()
+        slices = plan.split_leaves(
+            [np.asarray(x) for x in jax.tree.leaves(tmpl)])
+        rx = SiloShardAssembler()
+        assert rx.offer(5, 0, 2, slices[0], spec,
+                        meta={"client_idx": 1}) is False
+        # stale round-4 frame arrives late: dropped, bank intact
+        assert rx.offer(4, 1, 2, slices[1], None) is False
+        # an out-of-range shard index is dropped, never banked (a
+        # banked slot 7 would lie to the completion count and KeyError
+        # inside take())
+        assert rx.offer(5, 7, 2, slices[1], None) is False
+        assert rx.offer(5, 1, 2, slices[1], None) is True
+        params, meta = rx.take()
+        assert _bits_equal(tmpl, params)
+        assert meta["client_idx"] == 1
+
+    def test_strikes_quarantine_through_shared_tracker(self):
+        from fedml_tpu.robust import TrustTracker
+        trust = TrustTracker(strikes_to_quarantine=2)
+        plan, adm = self._adm(trust=trust)
+        sl = self._slices(plan, _uploads(1)[0][0])
+        adm.offer(1, 0, 2, sl[1], 10, 0)   # wrong shard: strike
+        adm.offer(1, 0, 2, sl[1], 10, 1)   # strike 2 => quarantined
+        assert trust.state(1, 2) == TrustTracker.QUARANTINED
+        assert adm.offer(1, 0, 2, sl[0], 10, 2)[0] == REJECT
+        assert adm.rejected["quarantined"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the live sharded federation over the real transport
+# ---------------------------------------------------------------------------
+
+def _train_fn(silo):
+    def fn(params, client_idx, round_idx):
+        rng = np.random.RandomState(1000 * silo + int(round_idx or 0))
+        return jax.tree.map(
+            lambda v: (np.asarray(v)
+                       + rng.randn(*np.shape(v)).astype(np.float32) * 0.1
+                       ).astype(np.asarray(v).dtype)
+            if np.asarray(v).dtype.kind == "f" else np.asarray(v),
+            params), 10 + silo
+    return fn
+
+
+def _run_shard(init, rounds, S, n=3, norm_clip=0.0, fused="off",
+               perf=None, ck=None, jr=None, fl=None, rogue=None,
+               spine=None):
+    hub = LocalHub(codec_roundtrip=True)
+    if spine is None:
+        spine = build_shard_spine(
+            init, num_shards=S, norm_clip=norm_clip, fused=fused,
+            min_split_elems=64, mesh=None,
+            sentry=perf.sentry if perf else None,
+            device=perf.device if perf else None)
+    server = FedAvgServerActor(
+        hub.transport(0), init, n, n, rounds, stream_agg=spine.agg,
+        shard_wire=spine, perf=perf, checkpointer=ck, journal=jr,
+        faultline=fl,
+        extra_state=(lambda: {"shard": spine.checkpoint_state()},
+                     lambda t: spine.restore_checkpoint_state(
+                         t["shard"])))
+    silos = []
+    for i in range(1, n + 1):
+        cls = rogue if (rogue is not None and i == 2) else \
+            FedAvgClientActor
+        silos.append(cls(i, hub.transport(i), _train_fn(i)))
+    server.register_handlers()
+    for s in silos:
+        s.register_handlers()
+    server.start()
+    hub.pump()
+    return server, spine
+
+
+def _run_plain_stream(init, rounds, n=3, norm_clip=0.0, ck=None,
+                      jr=None):
+    hub = LocalHub(codec_roundtrip=True)
+    server = FedAvgServerActor(
+        hub.transport(0), init, n, n, rounds, checkpointer=ck,
+        journal=jr,
+        stream_agg=StreamingAggregator(init, method="mean",
+                                       norm_clip=norm_clip))
+    silos = [FedAvgClientActor(i, hub.transport(i), _train_fn(i))
+             for i in range(1, n + 1)]
+    server.register_handlers()
+    for s in silos:
+        s.register_handlers()
+    server.start()
+    hub.pump()
+    return server
+
+
+class TestLiveShardedFederation:
+    def test_s1_live_bit_identical_to_replicated(self):
+        init = _params()
+        plain = _run_plain_stream(init, 3, norm_clip=2.0)
+        sharded, _ = _run_shard(init, 3, S=1, norm_clip=2.0)
+        assert plain.round_idx == sharded.round_idx == 3
+        assert _bits_equal(plain.params, sharded.params)
+
+    def test_s2_live_unclipped_bit_identical(self):
+        init = _params()
+        plain = _run_plain_stream(init, 3)
+        sharded, _ = _run_shard(init, 3, S=2)
+        assert _bits_equal(plain.params, sharded.params)
+
+    def test_s4_live_clipped_allclose_fused(self):
+        init = _params()
+        plain = _run_plain_stream(init, 3, norm_clip=2.0)
+        sharded, _ = _run_shard(init, 3, S=4, norm_clip=2.0, fused="on")
+        assert _allclose(plain.params, sharded.params)
+
+    def test_broadcast_encodes_once_per_shard(self):
+        """One SharedPayload per SHARD per broadcast (S encodes), one
+        per upload slice — never one per receiver."""
+        init = _params()
+        S, n, rounds = 2, 3, 2
+        before = dict(CODEC_COUNTS)
+        _run_shard(init, rounds, S=S, n=n)
+        encodes = CODEC_COUNTS["payload_encodes"] - before[
+            "payload_encodes"]
+        # per round: S broadcast payloads + n*S upload slices
+        assert encodes == rounds * (S + n * S)
+
+    def test_rogue_whole_model_upload_rejected_at_weight0(self):
+        class Rogue(FedAvgClientActor):
+            def _on_shard_sync(self, msg):
+                # a mis-launched plain silo: trains on shard 0's slice
+                # payload? no — it never assembles; ship a whole-model
+                # upload instead, which the sharded wire must reject
+                if msg.get(Message.ARG_SHARD) != 0:
+                    return
+                self.send(MsgType.C2S_MODEL, self.server_id,
+                          **{Message.ARG_MODEL_PARAMS: _params(),
+                             Message.ARG_NUM_SAMPLES: 10,
+                             Message.ARG_ROUND:
+                                 msg.get(Message.ARG_ROUND)})
+
+        init = _params()
+        server, spine = _run_shard(init, 2, S=2, rogue=Rogue)
+        assert server.round_idx == 2  # the barrier closed over silo 2
+        assert spine.admission.rejected["fingerprint"] >= 2
+        # the honest silos' folds landed: round advanced the global
+        assert not _bits_equal(server.params, init)
+
+    def test_poisoned_slice_rejects_silo_and_round_completes(self):
+        class NanSilo(FedAvgClientActor):
+            def _on_shard_sync(self, msg):
+                FedAvgClientActor._on_shard_sync(self, msg)
+
+        def nan_train(silo):
+            def fn(params, client_idx, round_idx):
+                return jax.tree.map(
+                    lambda v: np.full_like(np.asarray(v), np.nan)
+                    if np.asarray(v).dtype.kind == "f"
+                    else np.asarray(v), params), 10
+            return fn
+
+        hub = LocalHub(codec_roundtrip=True)
+        init = _params()
+        spine = build_shard_spine(init, num_shards=2, min_split_elems=64,
+                                  mesh=None)
+        server = FedAvgServerActor(
+            hub.transport(0), init, 3, 3, 2, stream_agg=spine.agg,
+            shard_wire=spine)
+        silos = [FedAvgClientActor(
+            i, hub.transport(i),
+            nan_train(i) if i == 2 else _train_fn(i))
+            for i in (1, 2, 3)]
+        server.register_handlers()
+        for s in silos:
+            s.register_handlers()
+        server.start()
+        hub.pump()
+        assert server.round_idx == 2
+        assert spine.admission.rejected["nonfinite"] >= 2
+
+    def test_jit_once_per_shard_under_perf_strict(self, tmp_path):
+        from fedml_tpu.obs import DeviceRecorder, PerfRecorder
+        from fedml_tpu.obs.trend import validate_ledger
+        init = _params()
+        perf = PerfRecorder(str(tmp_path / "perf.jsonl"),
+                            strict_recompiles=True,
+                            device=DeviceRecorder())
+        try:
+            server, spine = _run_shard(init, 4, S=2, norm_clip=2.0,
+                                       fused="on", perf=perf)
+        finally:
+            perf.close()
+        assert server.round_idx == 4
+        rows = [json.loads(l) for l in
+                (tmp_path / "perf.jsonl").read_text().splitlines()]
+        assert len(rows) == 4
+        sizes = {r["jit_cache_sizes"]["shard_spine[mean]"] for r in rows}
+        assert len(sizes) == 1  # jit-once per shard family, every round
+        for r in rows:
+            assert r["recompiles"] == 0
+            assert r["shards"] == 2
+            assert r["phases"].get("shard_finalize", 0) > 0
+            assert r["phases"].get("fold", 0) > 0
+            assert "staging" not in r["phases"]
+        # the compile ledger NAMES the fused finalize kernels (round 0)
+        fns = [c["fn"] for c in rows[0]["device"]["compiles"]]
+        assert any(f.startswith("fused_finalize[") for f in fns)
+        assert any(f.startswith("shard_fold[") for f in fns)
+        # old and new ledger shapes both validate
+        assert validate_ledger(rows) == []
+        old_row = {k: v for k, v in rows[0].items()
+                   if k not in ("shards", "device")}
+        assert validate_ledger([old_row]) == []
+        bad = dict(rows[0], shards=0)
+        assert validate_ledger([bad])
+
+    def test_shards_field_schema_gate(self):
+        from fedml_tpu.obs.trend import validate_ledger
+        row = {"round": 0, "phases": {}, "recompiles": 0,
+               "wire": {"bytes_out": 0, "bytes_in": 0}}
+        assert validate_ledger([dict(row, shards=4)]) == []
+        assert validate_ledger([dict(row, shards="4")])
+
+
+# ---------------------------------------------------------------------------
+# crash consistency: the sharded journal round-trip
+# ---------------------------------------------------------------------------
+
+class TestShardedCrashRecovery:
+    def test_crash_mid_round_resumes_bit_identical(self, tmp_path):
+        """The PR 12 contract under --model_shards: a kill after k folds
+        restores the durable SHARDED prefix and re-tasks only the rest —
+        final global bit-identical to the uncrashed run."""
+        init = _params()
+        want, _ = _run_shard(init, 3, S=2)
+        fl = Faultline(crashes=[CrashSpec(point="post_fold_pre_ack",
+                                          hit=2, round_idx=1)])
+        with pytest.raises(ActorKilled):
+            _run_shard(init, 3, S=2,
+                       ck=RoundCheckpointer(str(tmp_path / "ck"),
+                                            save_every=1),
+                       jr=RoundJournal(str(tmp_path / "j"),
+                                       snapshot_every=1),
+                       fl=fl)
+        jr2 = RoundJournal(str(tmp_path / "j"), snapshot_every=1)
+        resumes = []
+        orig = jr2.note_resume
+        jr2.note_resume = lambda *a, **kw: (resumes.append(a),
+                                            orig(*a, **kw))
+        resumed, _ = _run_shard(
+            init, 3, S=2,
+            ck=RoundCheckpointer(str(tmp_path / "ck"), save_every=1),
+            jr=jr2)
+        assert resumed.round_idx == 3
+        assert _bits_equal(resumed.params, want.params)
+        # the mid-round recovery actually engaged (it restored the
+        # 2-fold durable prefix instead of re-running the round whole)
+        assert resumes and resumes[0][0] == 1 and len(resumes[0][1]) == 2
+
+    def test_mode_change_abandons_to_boundary(self, tmp_path):
+        """A journal written under S=2 resumed by a REPLICATED server:
+        the mode tag mismatch ABANDONS the round loudly — re-tasking
+        everything from the boundary still lands the deterministic
+        global, but the sharded fold state is never unflattened into
+        the replicated layout."""
+        init = _params()
+        want = _run_plain_stream(init, 3)
+        fl = Faultline(crashes=[CrashSpec(point="post_fold_pre_ack",
+                                          hit=2, round_idx=1)])
+        with pytest.raises(ActorKilled):
+            _run_shard(init, 3, S=2,
+                       ck=RoundCheckpointer(str(tmp_path / "ck"),
+                                            save_every=1),
+                       jr=RoundJournal(str(tmp_path / "j"),
+                                       snapshot_every=1),
+                       fl=fl)
+        jr2 = RoundJournal(str(tmp_path / "j"), snapshot_every=1)
+        abandons = []
+        orig = jr2.abandon
+        jr2.abandon = lambda r, reason: (abandons.append(reason),
+                                         orig(r, reason))
+        resumed = _run_plain_stream(
+            init, 3,
+            ck=RoundCheckpointer(str(tmp_path / "ck"), save_every=1),
+            jr=jr2)
+        assert resumed.round_idx == 3
+        assert _bits_equal(resumed.params, want.params)
+        assert abandons and "mode mismatch" in abandons[0]
+
+    def test_shard_count_change_refused_at_checkpoint(self, tmp_path):
+        """Resuming with a DIFFERENT --model_shards is refused at the
+        checkpoint layout record — loudly, before any fold state could
+        restore into the wrong slots."""
+        init = _params()
+        fl = Faultline(crashes=[CrashSpec(point="post_fold_pre_ack",
+                                          hit=2, round_idx=1)])
+        with pytest.raises(ActorKilled):
+            _run_shard(init, 3, S=2,
+                       ck=RoundCheckpointer(str(tmp_path / "ck"),
+                                            save_every=1),
+                       jr=RoundJournal(str(tmp_path / "j"),
+                                       snapshot_every=1),
+                       fl=fl)
+        with pytest.raises(ValueError, match="model_shards 2"):
+            _run_shard(init, 3, S=1,
+                       ck=RoundCheckpointer(str(tmp_path / "ck"),
+                                            save_every=1),
+                       jr=RoundJournal(str(tmp_path / "j"),
+                                       snapshot_every=1))
+
+    def test_state_dict_roundtrips_sharded_accumulator(self):
+        tmpl = _params()
+        plan = build_shard_plan(tmpl, 2, min_split_elems=64)
+        ups, ws = _uploads(4, tmpl=tmpl)
+        a = ShardedStreamingAggregator(plan, tmpl, norm_clip=2.0)
+        a.reset(tmpl)
+        for u, w in zip(ups[:2], ws[:2]):
+            a.fold(u, w)
+        snap = a.state_dict()
+        assert snap["shard_fp"] == plan.fingerprint()
+        b = ShardedStreamingAggregator(plan, tmpl, norm_clip=2.0)
+        b.reset(tmpl)
+        b.load_state_dict(snap)
+        for u, w in zip(ups[2:], ws[2:]):
+            a.fold(u, w)
+            b.fold(u, w)
+        assert _bits_equal(a.finalize(0), b.finalize(0))
+
+    def test_foreign_snapshot_refused(self):
+        tmpl = _params()
+        p2 = build_shard_plan(tmpl, 2, min_split_elems=64)
+        p4 = build_shard_plan(tmpl, 4, min_split_elems=64)
+        a = ShardedStreamingAggregator(p2, tmpl)
+        a.reset(tmpl)
+        a.fold(_uploads(1, tmpl=tmpl)[0][0], 10.0)
+        snap = a.state_dict()
+        b = ShardedStreamingAggregator(p4, tmpl)
+        b.reset(tmpl)
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            b.load_state_dict(snap)
+        # a replicated snapshot (no shard_fp) is just as foreign
+        plain = StreamingAggregator(tmpl, method="mean")
+        plain.reset(tmpl)
+        plain.fold(_uploads(1, tmpl=tmpl)[0][0], 10.0)
+        with pytest.raises(ValueError, match="no shard-plan"):
+            b.load_state_dict(plain.state_dict())
+
+    def test_shard_fp_survives_the_journal_snapshot_codec(self,
+                                                          tmp_path):
+        tmpl = _params()
+        plan = build_shard_plan(tmpl, 2, min_split_elems=64)
+        agg = ShardedStreamingAggregator(plan, tmpl)
+        agg.reset(tmpl)
+        jr = RoundJournal(str(tmp_path / "j"), snapshot_every=1)
+        jr.round_start(0, mode="shard_mean[S=2]", global_crc=1)
+        agg.fold(_uploads(1, tmpl=tmpl)[0][0], 10.0)
+        jr.note_accept(0, 1, 10.0, state_fn=agg.state_dict)
+        rec = RoundJournal(str(tmp_path / "j")).recover()
+        assert rec is not None and rec.state is not None
+        assert rec.state["shard_fp"] == plan.fingerprint()
+
+    def test_checkpoint_layout_record_verifies(self):
+        init = _params()
+        spine2 = build_shard_spine(init, num_shards=2,
+                                   min_split_elems=64, mesh=None)
+        spine4 = build_shard_spine(init, num_shards=4,
+                                   min_split_elems=64, mesh=None)
+        state = spine2.checkpoint_state()
+        spine2.restore_checkpoint_state(state)  # self-consistent
+        with pytest.raises(ValueError, match="model_shards 2"):
+            spine4.restore_checkpoint_state(state)
+
+
+# ---------------------------------------------------------------------------
+# config gates
+# ---------------------------------------------------------------------------
+
+class TestConfigGates:
+    def _cfg(self, **kw):
+        base = dict(algo="cross_silo", agg_mode="stream", model_shards=2,
+                    comm_round=1, client_num_in_total=2,
+                    client_num_per_round=2, log_stdout=False)
+        base.update(kw)
+        return ExperimentConfig(**base)
+
+    @pytest.mark.parametrize("kw,match", [
+        (dict(algo="fedavg"), "cross_silo only"),
+        (dict(agg_mode="stack"), "agg_mode stream"),
+        (dict(robust_agg="krum"), "order-statistic"),
+        (dict(secagg="pairwise"), "mutually exclusive"),
+        (dict(edge_aggregators=2), "edge_aggregators"),
+        (dict(wire_compression="topk"), "wire_compression"),
+        (dict(admission="off"), "admission"),
+        (dict(silo_backend="grpc"), "local hub"),
+        (dict(model_shards=-1), "must be >= 0"),
+        (dict(model_shards=0, fused_finalize="on"), "model_shards"),
+        (dict(fused_finalize="maybe"), "auto|on|off"),
+    ])
+    def test_invalid_combos_fail_loudly(self, kw, match):
+        from fedml_tpu.experiments.main import main
+        with pytest.raises((ValueError, Exception), match=match):
+            main(self._cfg(**kw))
+
+    def test_actor_level_gates(self):
+        init = _params()
+        spine = build_shard_spine(init, num_shards=2, min_split_elems=64,
+                                  mesh=None)
+        hub = LocalHub()
+        with pytest.raises(ValueError, match="sharded stream_agg"):
+            FedAvgServerActor(hub.transport(0), init, 2, 2, 1,
+                              shard_wire=spine)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            from fedml_tpu.robust import make_defended_aggregate
+            FedAvgServerActor(hub.transport(0), init, 2, 2, 1,
+                              shard_wire=spine, stream_agg=spine.agg,
+                              aggregate_fn=make_defended_aggregate(
+                                  "mean"))
+
+    def test_build_spine_validates_fused_mode(self):
+        with pytest.raises(ValueError, match="auto|on|off"):
+            build_shard_spine(_params(), num_shards=2, fused="sometimes")
