@@ -460,6 +460,16 @@ class ExperimentConfig:
     serve_batch_delay_ms: float = 2.0  # micro-batch flush deadline: how
     #                                 long the oldest queued request may
     #                                 wait for batchmates
+    serve_workers: int = 1          # >1: the multi-worker frontend
+    #                                 (serve/pool.py) — N SO_REUSEPORT
+    #                                 accept loops, each its own micro-
+    #                                 batcher, over ONE shared registry;
+    #                                 1 = the single ThreadingHTTPServer
+    serve_best_effort_headroom: float = 0.5  # fraction of the queue
+    #                                 depth best-effort requests may
+    #                                 fill; past it (or while any SLO is
+    #                                 breaching) best_effort sheds and
+    #                                 interactive keeps the reserve
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -1162,21 +1162,32 @@ def run_cross_silo(cfg, data, mesh, sink):
     if cfg.serve_port > 0 and (cfg.silo_backend == "local"
                                or cfg.node_id == 0):
         from fedml_tpu.serve import (MicroBatcher, ModelRegistry,
-                                     ServeFrontend)
+                                     ServeFrontend, ServeWorkerPool)
         predict = jax.jit(lambda p, x: wl.apply(p, x))
         registry = ModelRegistry(predict)
         buckets = tuple(int(b) for b in cfg.serve_buckets.split(","))
-        batcher = MicroBatcher(
-            registry, buckets=buckets,
+        batcher_kw = dict(
+            buckets=buckets,
             max_delay_s=cfg.serve_batch_delay_ms / 1e3,
             queue_depth=cfg.serve_queue_depth,
-            default_deadline_s=cfg.serve_deadline_ms / 1e3)
+            default_deadline_s=cfg.serve_deadline_ms / 1e3,
+            best_effort_headroom=cfg.serve_best_effort_headroom)
         # deep health check: /healthz?deep=1 evaluates the rolling SLOs
-        # (round p95, shed rate, torn frames, quarantines) and answers
-        # 503 on breach so an LB can rotate out a violating instance
-        frontend = ServeFrontend(registry, batcher,
-                                 port=cfg.serve_port,
-                                 slo=slo, health=health).start()
+        # (round p95, shed rate, worst-worker queue fill, torn frames,
+        # quarantines) and answers 503 on breach so an LB can rotate out
+        # a violating instance.  The same evaluator backs tiered
+        # admission (TierGate): best-effort sheds exactly while deep
+        # health would answer 503.
+        if cfg.serve_workers > 1:
+            frontend = ServeWorkerPool(
+                registry, port=cfg.serve_port,
+                workers=cfg.serve_workers, slo=slo, health=health,
+                **batcher_kw).start()
+        else:
+            batcher = MicroBatcher(registry, slo=slo, **batcher_kw)
+            frontend = ServeFrontend(registry, batcher,
+                                     port=cfg.serve_port,
+                                     slo=slo, health=health).start()
         _sample_x = np.asarray(data.train["x"][0, 0, 0])
         _warmed = []
 
@@ -1187,9 +1198,13 @@ def run_cross_silo(cfg, data, mesh, sink):
                 # compile every bucket off the round path: without this
                 # the FIRST request per bucket size pays the jit compile
                 # inside its own deadline and is shed 429 from an
-                # otherwise idle server
+                # otherwise idle server.  The pool warms every worker's
+                # batcher (all share one jit cache through predict).
                 import threading as _th
-                _th.Thread(target=lambda: batcher.warmup(_sample_x),
+                _warm_target = (frontend.warmup
+                                if cfg.serve_workers > 1
+                                else batcher.warmup)
+                _th.Thread(target=lambda: _warm_target(_sample_x),
                            daemon=True, name="serve-warmup").start()
 
     # round-checkpoint extra state, composed by name: silo-side EF
@@ -2031,6 +2046,18 @@ def main(argv=None) -> Dict[str, Any]:
             "silently train without serving.  To serve a finished "
             "checkpoint directory, use scripts/serve_bench.py "
             "--ckpt_dir instead.")
+    if cfg.serve_workers < 1:
+        raise ValueError(f"--serve_workers must be >= 1, got "
+                         f"{cfg.serve_workers}")
+    if cfg.serve_workers > 1 and cfg.serve_port <= 0:
+        raise ValueError(
+            "--serve_workers scales the HTTP frontend and needs "
+            "--serve_port; without one there is no frontend to scale "
+            "and the flag would silently do nothing.")
+    if not 0.0 < cfg.serve_best_effort_headroom <= 1.0:
+        raise ValueError(
+            f"--serve_best_effort_headroom must be in (0, 1], got "
+            f"{cfg.serve_best_effort_headroom}")
     # the flight recorder and the SLO evaluator hook the live actors'
     # round lifecycle; on the cohort-simulation algorithms the flags
     # would parse and then never record/evaluate anything — an empty

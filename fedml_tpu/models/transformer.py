@@ -12,13 +12,26 @@ Architecture: pre-LN blocks (LN → causal MHA → residual, LN → GELU MLP →
 residual), learned positional embeddings, final LN → vocab head.  ``dtype``
 enables bf16 mixed precision the same way as the rest of the zoo (params
 stay f32; softmax/logits accumulate f32).
+
+Incremental decode (the serving hot path, ISSUE 15): pass ``cache`` (built
+by `init_decode_cache`) and per-slot ``positions`` to run ONE token per
+slot against per-layer KV caches carried as explicit state — the model
+returns ``(logits [B, V], new_cache)`` instead of re-running the whole
+prefix every token.  The cache is plain pytree state (no flax mutable
+collections), so the serving scheduler jits one step over a fixed
+``[slots]`` batch and donates the cache in place; per-slot positions mean
+every slot may sit at a DIFFERENT sequence index, which is exactly what
+continuous batching needs (a finished slot restarts at position 0 and the
+``kv_idx <= position`` mask hides the previous occupant's stale rows).
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from fedml_tpu.parallel.ring_attention import (
@@ -71,7 +84,8 @@ class CausalSelfAttention(nn.Module):
     auto_block_len: int = 1024
 
     @nn.compact
-    def __call__(self, x, positions, ring_axis: Optional[str] = None):
+    def __call__(self, x, positions, ring_axis: Optional[str] = None,
+                 cache: Optional[dict] = None):
         d_head = self.d_model // self.n_heads
         q = nn.DenseGeneral((self.n_heads, d_head), dtype=self.dtype,
                             name="query")(x)
@@ -80,7 +94,33 @@ class CausalSelfAttention(nn.Module):
         v = nn.DenseGeneral((self.n_heads, d_head), dtype=self.dtype,
                             name="value")(x)
         t = x.shape[1]
-        if ring_axis is not None:
+        new_cache = None
+        if cache is not None:
+            # incremental decode: x is [B, 1, D], positions is [B] — the
+            # per-slot write index.  Scatter this token's k/v into the
+            # cache row, attend the single query against the whole cache
+            # with a per-slot causal mask (kv_idx <= position): rows past
+            # the slot's own position — including a previous occupant's
+            # stale entries after slot reuse — are masked out, so a slot
+            # restarting at position 0 is bit-equivalent to a fresh cache.
+            k_cache, v_cache = cache["k"], cache["v"]   # [B, Tc, H, d]
+            tc = k_cache.shape[1]
+            write = (jnp.arange(tc)[None, :]
+                     == positions[:, None])[:, :, None, None]
+            k_cache = jnp.where(write, k.astype(k_cache.dtype), k_cache)
+            v_cache = jnp.where(write, v.astype(v_cache.dtype), v_cache)
+            new_cache = {"k": k_cache, "v": v_cache}
+            scale = 1.0 / math.sqrt(d_head)
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, k_cache,
+                preferred_element_type=jnp.float32) * scale
+            mask = (jnp.arange(tc)[None, None, None, :]
+                    <= positions[:, None, None, None])
+            scores = jnp.where(mask, scores, -1e30)
+            p = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", p,
+                             v_cache.astype(jnp.float32))
+        elif ring_axis is not None:
             out = ring_attention(q, k, v, positions, positions, ring_axis)
         elif self.use_flash:
             out = _pallas_flash(q, k, v)
@@ -92,8 +132,28 @@ class CausalSelfAttention(nn.Module):
         else:
             out = full_attention(q, k, v, positions, positions)
         out = out.astype(x.dtype)
-        return nn.DenseGeneral(self.d_model, axis=(-2, -1),
-                               dtype=self.dtype, name="out")(out)
+        out = nn.DenseGeneral(self.d_model, axis=(-2, -1),
+                              dtype=self.dtype, name="out")(out)
+        return (out, new_cache) if cache is not None else out
+
+
+def init_decode_cache(model: "TransformerLM", slots: int, cache_len: int,
+                      dtype=jnp.float32) -> dict:
+    """Fresh per-layer KV cache for incremental decode: one
+    ``{"attn_i": {"k", "v"}}`` entry per layer, each ``[slots, cache_len,
+    n_heads, d_head]``.  Zeros are fine as the initial value — the
+    per-slot ``kv_idx <= position`` mask in `CausalSelfAttention` never
+    reads a row the slot's own steps have not written."""
+    if cache_len > model.max_len:
+        raise ValueError(
+            f"cache_len {cache_len} exceeds the model's max_len "
+            f"{model.max_len}: the positional embedding table has no row "
+            f"for those positions; shrink the cache or grow max_len")
+    d_head = model.d_model // model.n_heads
+    shape = (slots, cache_len, model.n_heads, d_head)
+    return {f"attn_{i}": {"k": jnp.zeros(shape, dtype),
+                          "v": jnp.zeros(shape, dtype)}
+            for i in range(model.n_layers)}
 
 
 class TransformerLM(nn.Module):
@@ -101,7 +161,14 @@ class TransformerLM(nn.Module):
 
     ``positions`` are global token indices (default ``arange(T)``); under
     sequence parallelism each shard passes its own offset block so the
-    positional embedding and causal mask stay globally correct."""
+    positional embedding and causal mask stay globally correct.
+
+    Incremental decode: with ``cache`` (from `init_decode_cache`),
+    ``input_seq`` is ONE token per slot (``[B]`` ints), ``positions`` the
+    per-slot sequence index (``[B]`` ints), and the call returns
+    ``(logits [B, vocab], new_cache)`` — the prediction for position
+    ``positions + 1`` given everything the cache holds up to and
+    including this token."""
     vocab_size: int
     d_model: int = 128
     n_heads: int = 4
@@ -123,24 +190,50 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_seq, train: bool = False, positions=None,
-                 ring_axis: Optional[str] = None):
-        _, t = input_seq.shape
-        if positions is None:
-            positions = jnp.arange(t)
-        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
-                     name="tok_embed")(input_seq)
-        x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
-                         name="pos_embed")(positions)[None, :, :]
+                 ring_axis: Optional[str] = None,
+                 cache: Optional[dict] = None):
+        decode = cache is not None
+        if decode:
+            if positions is None:
+                raise ValueError(
+                    "decode (cache=) needs per-slot positions: each slot "
+                    "sits at its own sequence index")
+            if ring_axis is not None:
+                raise ValueError(
+                    "decode (cache=) is single-chip attention over the kv "
+                    "cache; ring_axis does not compose with it")
+            tokens = input_seq.reshape(-1)          # [B] one token/slot
+            seq_for_mask = tokens[:, None]          # [B, 1] (MoE pad mask)
+            x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                         name="tok_embed")(tokens)[:, None, :]
+            x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                             name="pos_embed")(positions)[:, None, :]
+        else:
+            _, t = input_seq.shape
+            if positions is None:
+                positions = jnp.arange(t)
+            seq_for_mask = input_seq
+            x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
+                         name="tok_embed")(input_seq)
+            x = x + nn.Embed(self.max_len, self.d_model, dtype=self.dtype,
+                             name="pos_embed")(positions)[None, :, :]
+        new_cache = {} if decode else None
         for i in range(self.n_layers):
             h = nn.LayerNorm(dtype=self.dtype)(x)
-            h = CausalSelfAttention(self.n_heads, self.d_model,
-                                    dtype=self.dtype,
-                                    block_size=self.block_size,
-                                    use_flash=self.use_flash,
-                                    auto_block_len=self.auto_block_len,
-                                    name=f"attn_{i}")(h, positions, ring_axis)
+            attn = CausalSelfAttention(self.n_heads, self.d_model,
+                                       dtype=self.dtype,
+                                       block_size=self.block_size,
+                                       use_flash=self.use_flash,
+                                       auto_block_len=self.auto_block_len,
+                                       name=f"attn_{i}")
+            if decode:
+                h, new_cache[f"attn_{i}"] = attn(
+                    h, positions, cache=cache[f"attn_{i}"])
+            else:
+                h = attn(h, positions, ring_axis)
             if self.dropout_rate:
-                h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+                h = nn.Dropout(self.dropout_rate,
+                               deterministic=decode or not train)(h)
             x = x + h
             h = nn.LayerNorm(dtype=self.dtype)(x)
             if self.moe_experts:
@@ -148,14 +241,16 @@ class TransformerLM(nn.Module):
                 h = SwitchFFN(self.moe_experts, self.d_model, self.d_ff,
                               capacity_factor=self.moe_capacity_factor,
                               dtype=self.dtype, name=f"moe_{i}")(
-                    h, mask=(input_seq != self.pad_id))
+                    h, mask=(seq_for_mask != self.pad_id))
             else:
                 h = nn.Dense(self.d_ff, dtype=self.dtype)(h)
                 h = nn.gelu(h)
                 h = nn.Dense(self.d_model, dtype=self.dtype)(h)
             if self.dropout_rate:
-                h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+                h = nn.Dropout(self.dropout_rate,
+                               deterministic=decode or not train)(h)
             x = x + h
         x = nn.LayerNorm(dtype=self.dtype)(x)
-        return nn.Dense(self.vocab_size, dtype=self.dtype,
-                        name="lm_head")(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                          name="lm_head")(x)
+        return (logits[:, 0, :], new_cache) if decode else logits

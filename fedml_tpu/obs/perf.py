@@ -541,6 +541,13 @@ DEFAULT_SLOS = {
     # limits (CPU live-arrays fallback) never export the gauge, so the
     # objective evaluates vacuously there.
     "device_mem_utilization_ratio": 0.92,
+    # worst-WORKER serve queue fill (ISSUE 15's multi-worker pool):
+    # every MicroBatcher/DecodeScheduler exports qsize/depth as a
+    # worker-labeled gauge; the objective reads the MAX across them so
+    # one wedged worker breaches even while the pool average looks
+    # healthy.  This is also what tiered admission sheds on (via
+    # TierGate), so load shedding and deep-healthz always agree.
+    "serve_queue_utilization_ratio": 0.9,
     **HEALTH_SLOS,                        # drift alarms (obs/health.py)
 }
 
@@ -605,6 +612,8 @@ class SloEvaluator:
                 reg.gauge("fedml_slo_health_starvation_ratio"),
             "device_mem_utilization_ratio":
                 reg.gauge("fedml_slo_device_mem_utilization_ratio"),
+            "serve_queue_utilization_ratio":
+                reg.gauge("fedml_slo_serve_queue_utilization_ratio"),
         }
         self._breaches = {name: reg.counter(
             "fedml_slo_breaches_total", slo=name)
@@ -627,7 +636,17 @@ class SloEvaluator:
                     p95 = q if p95 is None else max(p95, q)
 
         submitted = self._sum_family(counters, "fedml_serve_requests_total")
-        shed = self._sum_family(counters, "fedml_serve_shed_total")
+        # slo_degraded sheds are EXCLUDED from the numerator: they are a
+        # CONSEQUENCE of an already-breaching objective (the tier gate
+        # shedding best-effort), not fresh evidence of overload.  A shed
+        # submit never increments requests_total, so counting them would
+        # close a feedback loop — tier-gate sheds inflate shed_rate,
+        # which keeps the gate degraded, which sheds more — latching a
+        # transient breach into a permanent one at any best-effort mix
+        # above threshold/(1+threshold).
+        shed = sum(v for k, v in counters.items()
+                   if k.startswith("fedml_serve_shed_total")
+                   and 'reason="slo_degraded"' not in k)
         shed_rate = (shed / submitted) if submitted else 0.0
 
         recv = self._sum_family(counters, "fedml_comm_recv_total")
@@ -661,6 +680,12 @@ class SloEvaluator:
                 # never a fabricated zero)
                 "device_mem_utilization_ratio":
                     gauges.get("fedml_dev_mem_utilization_ratio"),
+                # worst worker across the serve pool (absent gauge — no
+                # serving — reads None: vacuously healthy)
+                "serve_queue_utilization_ratio": max(
+                    (v for k, v in gauges.items() if k.startswith(
+                        "fedml_serve_queue_utilization_ratio")),
+                    default=None),
                 **health}
 
     def evaluate(self, count_breaches: bool = True) -> Dict[str, dict]:

@@ -228,6 +228,63 @@ def validate_health_ledger(rows: List[dict]) -> List[str]:
     return problems
 
 
+def validate_serve_bench(obj: dict,
+                         allow_smoke: bool = True) -> List[str]:
+    """Schema + honesty check for ``BENCH_serve.json`` v2 (ISSUE 15):
+    the serve path rides the same committed-artifact trend line as every
+    other hot path, so the gate refuses a bench that dropped its
+    acceptance verdicts, lost an arm, mislabeled its backend, or shipped
+    torn responses.  The bench SCRIPT enforces the numeric gates at
+    measurement time and records the verdicts; this validates that an
+    artifact still carries PASSING ones — failed verdicts fail
+    validation unconditionally (a smoke label must not excuse them: the
+    smoke run already records its gates against relaxed thresholds).
+    ``allow_smoke=False`` (the committed-trend-line mode — what
+    ``perf_trend.py --serve_bench`` uses) additionally rejects
+    smoke-labeled artifacts outright, so a /tmp smoke run can never be
+    re-committed as the trend anchor."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return ["serve bench is not a JSON object"]
+    if obj.get("bench") != "serve":
+        problems.append(f"bench != 'serve' (got {obj.get('bench')!r})")
+    if obj.get("version") != 2:
+        problems.append(f"version != 2 (got {obj.get('version')!r}); "
+                        "v1 artifacts predate the gated-arm format")
+    if obj.get("smoke") and not allow_smoke:
+        problems.append("smoke-labeled artifact on the committed trend "
+                        "line (smoke runs carry relaxed load gates and "
+                        "belong in /tmp, never committed)")
+    arms = obj.get("arms")
+    if not isinstance(arms, dict) or not arms:
+        return problems + ["no arms section"]
+    for name in ("replay", "http", "decode"):
+        if name not in arms:
+            problems.append(f"missing required arm {name!r}")
+    for name, arm in arms.items():
+        if not isinstance(arm, dict):
+            problems.append(f"arm {name!r} is not an object")
+            continue
+        if arm.get("backend") not in ("cpu", "gpu", "tpu"):
+            problems.append(f"arm {name!r}: no honest backend label "
+                            f"(got {arm.get('backend')!r})")
+        gates = arm.get("gates")
+        if not isinstance(gates, dict) or not gates:
+            problems.append(f"arm {name!r}: no recorded gate verdicts")
+            continue
+        for gname, verdict in gates.items():
+            if not isinstance(verdict, dict) or "ok" not in verdict:
+                problems.append(f"arm {name!r}: gate {gname!r} without "
+                                f"an ok verdict")
+            elif not verdict["ok"]:
+                problems.append(f"arm {name!r}: gate {gname!r} FAILED "
+                                f"({verdict})")
+        if "torn_responses" in arm and arm["torn_responses"] != 0:
+            problems.append(f"arm {name!r}: {arm['torn_responses']} torn "
+                            f"responses committed")
+    return problems
+
+
 def phase_medians(rows: List[dict],
                   skip_first: bool = True) -> Dict[str, float]:
     """Median per-phase seconds across the ledger (plus ``round_s``).
@@ -384,12 +441,16 @@ def main(argv=None) -> int:
                    help="health.jsonl to schema-validate (obs/health.py): "
                         "a malformed health ledger fails the gate, not "
                         "the reader that trusts it later")
+    p.add_argument("--serve_bench", default=None,
+                   help="BENCH_serve.json (v2) to validate: required "
+                        "arms present, honest backend labels, recorded "
+                        "gate verdicts all passing, zero torn responses")
     args = p.parse_args(argv)
     if args.ledger is None and not args.lint_mfu \
-            and args.health_ledger is None:
+            and args.health_ledger is None and args.serve_bench is None:
         p.print_usage()
-        print("perf_trend: nothing to do (pass --ledger, --health_ledger "
-              "and/or --lint_mfu)")
+        print("perf_trend: nothing to do (pass --ledger, --health_ledger, "
+              "--serve_bench and/or --lint_mfu)")
         return 2
 
     failures: List[str] = []
@@ -472,6 +533,23 @@ def main(argv=None) -> int:
                          if not v.get("ok"))
             print(f"health ledger: {len(health_rows)} rounds, schema OK, "
                   f"{alarms} alarm verdict(s) fired")
+
+    if args.serve_bench is not None:
+        try:
+            with open(args.serve_bench) as f:
+                serve_obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"perf_trend: cannot read serve bench: {e}")
+            return 2
+        # committed-trend-line mode: a smoke artifact must not anchor it
+        problems = validate_serve_bench(serve_obj, allow_smoke=False)
+        failures += [f"serve bench: {x}" for x in problems]
+        if not problems:
+            arms = serve_obj.get("arms", {})
+            rps = arms.get("replay", {}).get("throughput_rps")
+            occ = arms.get("decode", {}).get("occupancy_ratio")
+            print(f"serve bench: {len(arms)} arm(s) green "
+                  f"(replay {rps} req/s, decode occupancy ratio {occ})")
 
     if args.lint_mfu:
         paths = _expand(args.lint_mfu)

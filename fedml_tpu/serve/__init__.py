@@ -17,6 +17,14 @@ layers plus a bench harness:
     fedml_tpu.serve.server    ThreadingHTTPServer frontend (/predict,
                               /healthz, /version, /metrics) with admission
                               control and per-request deadline propagation
+    fedml_tpu.serve.pool      multi-worker frontend (ISSUE 15): N
+                              SO_REUSEPORT accept loops × N micro-batchers
+                              over ONE shared registry, worker-labeled
+                              telemetry, pool-wide health payloads
+    fedml_tpu.serve.decode    continuous-batching decode scheduler for
+                              autoregressive models: one compiled step
+                              over fixed [slots], per-step slot admission,
+                              swap-barrier version consistency
     scripts/serve_bench.py    open-loop load generator → BENCH_serve.json
 
 Everything is instrumented through the PR 2 telemetry registry under
@@ -26,9 +34,13 @@ triple swaps as one immutable snapshot), and a checkpoint directory GC'd
 between list and load is tolerated, not fatal.
 """
 
-from fedml_tpu.serve.batcher import MicroBatcher, ShedError
+from fedml_tpu.serve.batcher import (MicroBatcher, ShedError, TierGate,
+                                     TIERS)
+from fedml_tpu.serve.decode import DecodeResult, DecodeScheduler
+from fedml_tpu.serve.pool import ServeWorkerPool
 from fedml_tpu.serve.registry import ModelRegistry, ServedModel
 from fedml_tpu.serve.server import ServeFrontend
 
-__all__ = ["MicroBatcher", "ShedError", "ModelRegistry", "ServedModel",
-           "ServeFrontend"]
+__all__ = ["MicroBatcher", "ShedError", "TierGate", "TIERS",
+           "DecodeResult", "DecodeScheduler", "ServeWorkerPool",
+           "ModelRegistry", "ServedModel", "ServeFrontend"]

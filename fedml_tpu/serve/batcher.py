@@ -16,6 +16,16 @@ answer nobody is waiting for.  ``stop(drain=True)`` mirrors
 `ResilientTransport.stop`: already-queued requests still get answers,
 then the worker exits.
 
+Admission tiers (ISSUE 15): every request carries a tier —
+``interactive`` (the default) or ``best_effort`` — and shedding is
+tiered so best-effort traffic gives way first: best-effort submits shed
+at a SOFT queue watermark (``best_effort_headroom`` of the depth, so
+interactive always has reserved headroom) and, when a `TierGate` over
+the round-cadence `SloEvaluator` says an objective is breaching, shed
+outright (reason ``slo_degraded``).  The gate reads the SAME evaluator
+verdicts as ``/healthz?deep=1``, so load shedding and deep health can
+never disagree about whether the instance is degraded.
+
 Model consistency: the worker reads ONE `ServedModel` snapshot per batch
 from the registry, so every row of a batch is served by the same
 (params, version) — a hot swap landing mid-batch affects only the next
@@ -41,15 +51,116 @@ _STOP = object()
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
 
+TIERS = ("interactive", "best_effort")
+
+SHED_REASONS = ("queue_full", "deadline", "shutdown", "no_model",
+                "slo_degraded")
+
 
 class ShedError(RuntimeError):
     """A request was rejected by admission control or load shedding.
-    ``reason`` ∈ {queue_full, deadline, shutdown, no_model} — the HTTP
-    frontend maps it to 429 (503 for no_model)."""
+    ``reason`` ∈ {queue_full, deadline, shutdown, no_model,
+    slo_degraded} — the HTTP frontend maps it to 429 (503 for
+    no_model)."""
 
     def __init__(self, reason: str):
         super().__init__(reason)
         self.reason = reason
+
+
+def best_effort_cap(queue_depth: int,
+                    headroom: float) -> Optional[int]:
+    """The best-effort soft watermark: the queue fill beyond which only
+    interactive traffic is admitted.  An UNBOUNDED queue (depth <= 0)
+    has no fill fraction, so no watermark — None, never a degenerate
+    cap of 1 that would blackhole best-effort under any load."""
+    if not 0.0 < headroom <= 1.0:
+        raise ValueError(f"best_effort_headroom must be in (0, 1], "
+                         f"got {headroom}")
+    return max(1, int(headroom * queue_depth)) if queue_depth > 0 \
+        else None
+
+
+class TierAdmission:
+    """The tiered-admission state BOTH schedulers share (`MicroBatcher`
+    and `DecodeScheduler`): the (reason × tier) shed counters — built by
+    the OWNER so the metric-name literal stays in its module for the
+    source-scan lint — the best-effort watermark, and the `TierGate`.
+    One implementation, so a tier-policy fix can never silently apply
+    to one queue and not the other."""
+    __slots__ = ("gate", "be_cap", "counters")
+
+    def __init__(self, counters: dict, slo, be_cap: Optional[int]):
+        self.counters = counters
+        self.gate = (slo if slo is None or hasattr(slo, "degraded")
+                     else TierGate(slo))
+        self.be_cap = be_cap
+
+    def shed(self, reason: str, tier: str = "interactive") -> ShedError:
+        """Count a shed by (reason, tier) and build its error."""
+        self.counters[(reason, tier)].inc()
+        return ShedError(reason)
+
+    def screen(self, tier: str, qsize: int) -> None:
+        """Pre-queue admission: validate the tier, and shed best-effort
+        while an SLO breaches (slo_degraded) or past the watermark."""
+        if tier not in TIERS:
+            raise ValueError(f"unknown tier {tier!r}; expected one of "
+                             f"{TIERS}")
+        if tier == "best_effort":
+            if self.gate is not None and self.gate.degraded():
+                raise self.shed("slo_degraded", tier)
+            if self.be_cap is not None and qsize >= self.be_cap:
+                raise self.shed("queue_full", tier)
+
+
+class TierGate:
+    """The objective-state side of tiered admission: ``degraded()`` is
+    True while any SLO is breaching, read from the SAME `SloEvaluator`
+    that backs ``/healthz?deep=1`` — one source of truth, so a shed
+    best-effort request and a 503 deep probe always tell the same story.
+
+    The verdict is cached for ``ttl_s`` (an evaluate() walks a registry
+    snapshot; at 10k req/s that must not run per request) and evaluated
+    with ``count_breaches=False`` — admission probes, like LB probes,
+    must not inflate the per-round breach counters."""
+
+    def __init__(self, slo, ttl_s: float = 0.25):
+        self.slo = slo
+        self.ttl_s = ttl_s
+        self._lock = threading.Lock()
+        self._checked_at = -1e30
+        self._healthy = True
+
+    def degraded(self) -> bool:
+        if self.slo is None:
+            return False
+        now = time.monotonic()
+        refresh = False
+        with self._lock:
+            if now - self._checked_at >= self.ttl_s:
+                # claim the refresh INSIDE the lock, evaluate OUTSIDE it:
+                # the gate is shared across every pool worker, and an
+                # evaluate() (a registry snapshot walk) under the lock
+                # would serialize all concurrent best-effort submits for
+                # its whole duration — a stale read during the refresh
+                # window is harmless for an admission hint that already
+                # accepts ttl_s of staleness
+                self._checked_at = now
+                refresh = True
+        if refresh:
+            try:
+                healthy = all(
+                    v["ok"] for v in
+                    self.slo.evaluate(count_breaches=False).values())
+            except Exception:  # noqa: BLE001 — a broken evaluator
+                # must degrade to admit-everything, not crash submits
+                log.exception("tier gate: SLO evaluation failed")
+                healthy = True
+            with self._lock:
+                self._healthy = healthy
+        with self._lock:
+            return not self._healthy
 
 
 class BadInstanceError(ValueError):
@@ -82,14 +193,15 @@ def _settle(fut: Future, result=None, exc=None) -> None:
 
 
 class _Request:
-    __slots__ = ("x", "deadline", "enq_t", "future")
+    __slots__ = ("x", "deadline", "enq_t", "future", "tier")
 
     def __init__(self, x, deadline: Optional[float], enq_t: float,
-                 future: Future):
+                 future: Future, tier: str = "interactive"):
         self.x = x
         self.deadline = deadline
         self.enq_t = enq_t
         self.future = future
+        self.tier = tier
 
 
 class MicroBatcher:
@@ -102,11 +214,20 @@ class MicroBatcher:
     ``queue_depth``: bound on queued requests (admission control).
     ``default_deadline_s``: per-request deadline when submit passes none
     (None = no deadline, requests never shed once admitted).
+    ``worker``: label value stamped on every metric series this batcher
+    registers — the multi-worker pool names each worker's telemetry so
+    one hot worker is visible, not averaged away.
+    ``slo``: a `TierGate` (or an `SloEvaluator`, wrapped into one) —
+    best-effort submits shed while an objective is breaching.
+    ``best_effort_headroom``: fraction of the queue depth best-effort
+    traffic may fill; beyond it only interactive requests are admitted.
     """
 
     def __init__(self, registry, buckets: Sequence[int] = DEFAULT_BUCKETS,
                  max_delay_s: float = 0.005, queue_depth: int = 256,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 worker: Optional[str] = None, slo=None,
+                 best_effort_headroom: float = 0.5):
         buckets = tuple(int(b) for b in buckets)
         if not buckets or list(buckets) != sorted(set(buckets)) \
                 or buckets[0] < 1:
@@ -116,6 +237,7 @@ class MicroBatcher:
         self.buckets = buckets
         self.max_delay_s = max_delay_s
         self.default_deadline_s = default_deadline_s
+        self.worker = worker
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._stopped = False      # rejects new submits
         self._drain = True         # False: fail queued requests on stop
@@ -125,51 +247,73 @@ class MicroBatcher:
         # sentinel and leave its Future unresolved forever
         self._admit_lock = threading.Lock()
         reg = telemetry.get_registry()
-        self._c_requests = reg.counter("fedml_serve_requests_total")
-        self._c_batches = reg.counter("fedml_serve_batches_total")
-        self._c_shed = {r: reg.counter("fedml_serve_shed_total", reason=r)
-                        for r in ("queue_full", "deadline", "shutdown",
-                                  "no_model")}
-        self._g_depth = reg.gauge("fedml_serve_queue_depth_total")
+        lbl = {} if worker is None else {"worker": str(worker)}
+        self._c_requests = reg.counter("fedml_serve_requests_total", **lbl)
+        self._c_batches = reg.counter("fedml_serve_batches_total", **lbl)
+        self._adm = TierAdmission(
+            {(r, t): reg.counter("fedml_serve_shed_total",
+                                 reason=r, tier=t, **lbl)
+             for r in SHED_REASONS for t in TIERS},
+            slo, best_effort_cap(queue_depth, best_effort_headroom))
+        self.tier_gate = self._adm.gate
+        self._g_depth = reg.gauge("fedml_serve_queue_depth_total", **lbl)
+        # qsize / depth as a ratio: the worst-worker headroom signal the
+        # serve_queue_utilization_ratio SLO (and deep-healthz) reads
+        self._g_util = reg.gauge("fedml_serve_queue_utilization_ratio",
+                                 **lbl)
         self._h_occupancy = reg.histogram(
             "fedml_serve_batch_occupancy_total",
-            buckets=tuple(float(b) for b in buckets))
-        self._h_request = reg.histogram("fedml_serve_request_seconds")
-        self._h_predict = reg.histogram("fedml_serve_predict_seconds")
+            buckets=tuple(float(b) for b in buckets), **lbl)
+        self._h_request = reg.histogram("fedml_serve_request_seconds",
+                                        **lbl)
+        self._h_predict = reg.histogram("fedml_serve_predict_seconds",
+                                        **lbl)
         # the model's per-instance shape, learned from warmup or the
         # first successful batch: the screening anchor, so one malformed
         # FIRST arrival cannot fail its innocent batchmates
         self._expected_shape: Optional[tuple] = None
 
     # -- client side ---------------------------------------------------------
-    def submit(self, x, deadline_s: Optional[float] = None) -> Future:
+    def _shed(self, reason: str, tier: str = "interactive") -> ShedError:
+        return self._adm.shed(reason, tier)
+
+    def _note_depth(self) -> None:
+        depth = self._q.qsize()
+        self._g_depth.set(depth)
+        if self._q.maxsize > 0:   # maxsize 0 = unbounded: no fill ratio
+            self._g_util.set(depth / self._q.maxsize)
+
+    def submit(self, x, deadline_s: Optional[float] = None,
+               tier: str = "interactive") -> Future:
         """Enqueue one instance (shape = the model's sample shape).
         Returns a Future resolving to a `PredictResult`, or raising
         `ShedError` if the request is shed.  Raises `ShedError`
         IMMEDIATELY when the queue is full or the batcher is stopped —
-        admission control happens here, not after queueing."""
+        admission control happens here, not after queueing.  Best-effort
+        requests additionally shed at the soft queue watermark and while
+        the tier gate reports an SLO breach."""
+        self._adm.screen(tier, self._q.qsize())
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         now = time.monotonic()
         req = _Request(x, None if deadline_s is None else now + deadline_s,
-                       now, Future())
+                       now, Future(), tier)
         with self._admit_lock:
             if self._stopped:
-                self._c_shed["shutdown"].inc()
-                raise ShedError("shutdown")
+                raise self._shed("shutdown", tier)
             try:
                 self._q.put_nowait(req)
             except queue.Full:
-                self._c_shed["queue_full"].inc()
-                raise ShedError("queue_full") from None
+                raise self._shed("queue_full", tier) from None
         self._c_requests.inc()
-        self._g_depth.set(self._q.qsize())
+        self._note_depth()
         return req.future
 
     def predict(self, x, deadline_s: Optional[float] = None,
-                timeout: Optional[float] = 30.0) -> PredictResult:
+                timeout: Optional[float] = 30.0,
+                tier: str = "interactive") -> PredictResult:
         """Blocking submit-and-wait convenience (the bench hot path)."""
-        return self.submit(x, deadline_s).result(timeout)
+        return self.submit(x, deadline_s, tier=tier).result(timeout)
 
     def depth(self) -> int:
         """Currently queued requests (the /healthz headroom signal)."""
@@ -238,7 +382,7 @@ class MicroBatcher:
                 break
             batch = [first]
             stop_seen = self._accumulate(batch)
-            self._g_depth.set(self._q.qsize())
+            self._note_depth()
             self._process(batch)
             if stop_seen:
                 break
@@ -298,16 +442,14 @@ class MicroBatcher:
                     self._process(remaining[i:i + self.buckets[-1]])
             else:
                 for r in remaining:
-                    self._c_shed["shutdown"].inc()
-                    _settle(r.future, exc=ShedError("shutdown"))
+                    _settle(r.future, exc=self._shed("shutdown", r.tier))
 
     def _process(self, batch) -> None:
         now = time.monotonic()
         live = []
         for r in batch:
             if r.deadline is not None and now > r.deadline:
-                self._c_shed["deadline"].inc()
-                _settle(r.future, exc=ShedError("deadline"))
+                _settle(r.future, exc=self._shed("deadline", r.tier))
             else:
                 live.append(r)
         if not live:
@@ -315,8 +457,7 @@ class MicroBatcher:
         snapshot = self.registry.current()  # ONE snapshot for the batch
         if snapshot is None:
             for r in live:
-                self._c_shed["no_model"].inc()
-                _settle(r.future, exc=ShedError("no_model"))
+                _settle(r.future, exc=self._shed("no_model", r.tier))
             return
         # per-request shape screening: one malformed x must fail ITS
         # request, not every innocent batchmate np.stack would drag
@@ -366,8 +507,7 @@ class MicroBatcher:
                 # the answer exists but nobody useful is waiting: a late
                 # response is a failed response — shed it so delivered
                 # latency stays under the deadline by construction
-                self._c_shed["deadline"].inc()
-                _settle(r.future, exc=ShedError("deadline"))
+                _settle(r.future, exc=self._shed("deadline", r.tier))
                 continue
             self._h_request.observe(done - r.enq_t)
             _settle(r.future, PredictResult(out[i], snapshot.version))

@@ -4,14 +4,17 @@ Stdlib-only (the `telemetry.start_http_server` posture — one daemon
 thread per connection, fine for the CPU/silo edge; a TPU pod fronts this
 with a real LB).  Endpoints:
 
-* ``POST /predict`` — body ``{"x": [...], "deadline_ms": 50}``; the
-  instance rides the micro-batcher and the answer carries the model
-  version that produced it: ``{"y": [...], "version": 12}``.  Shed
-  requests answer **429** (deadline/queue-full — retry later), a
-  registry with no model yet answers **503**.  The per-request deadline
-  (body field or ``X-Deadline-Ms`` header) propagates into the batcher,
-  so a request that waited out its budget in the queue is shed there
-  instead of dispatched late.
+* ``POST /predict`` — body ``{"x": [...], "deadline_ms": 50,
+  "tier": "interactive"}``; the instance rides the micro-batcher and
+  the answer carries the model version that produced it: ``{"y": [...],
+  "version": 12}``.  Shed requests answer **429** (deadline/queue-full/
+  slo_degraded — retry later), a registry with no model yet answers
+  **503**.  The per-request deadline (body field or ``X-Deadline-Ms``
+  header) propagates into the batcher, so a request that waited out its
+  budget in the queue is shed there instead of dispatched late; the
+  admission tier (body field or ``X-Tier``) selects who sheds first
+  under load — best_effort gives way before interactive (see
+  `batcher.TierGate`).
 * ``GET /healthz`` — 200 with ``{"status": "ok", "version": ...,
   "queue_depth": ...}`` once a model is live, 503 before (a load
   balancer keeps the instance out of rotation until the first publish).
@@ -42,7 +45,7 @@ from typing import Optional
 import numpy as np
 
 from fedml_tpu.obs import telemetry, trace
-from fedml_tpu.serve.batcher import (BadInstanceError, MicroBatcher,
+from fedml_tpu.serve.batcher import (TIERS, BadInstanceError, MicroBatcher,
                                      ShedError)
 from fedml_tpu.serve.registry import ModelRegistry
 
@@ -109,7 +112,11 @@ class ServeFrontend:
 
 
 def _make_handler(registry: ModelRegistry, batcher: MicroBatcher,
-                  slo=None, health=None):
+                  slo=None, health=None, pool=None,
+                  worker_id: Optional[int] = None):
+    """``pool``/``worker_id``: set by `ServeWorkerPool` — health
+    payloads then carry the answering worker's id and every worker's
+    queue depth, so one probe through any worker sees the whole pool."""
     class _Handler(http.server.BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"  # keep-alive: the load generator
         # reuses connections, without this every request pays a TCP dial
@@ -139,6 +146,10 @@ def _make_handler(registry: ModelRegistry, batcher: MicroBatcher,
                     return
                 body = {"status": "ok", "version": m.version,
                         "queue_depth": batcher.depth()}
+                if pool is not None:
+                    body["worker"] = worker_id
+                    body["workers"] = pool.workers
+                    body["queue_depths"] = pool.queue_depths()
                 deep = "deep=1" in query.split("&")
                 if deep and slo is None:
                     body["deep"] = "unconfigured"
@@ -194,6 +205,11 @@ def _make_handler(registry: ModelRegistry, batcher: MicroBatcher,
                                       self.headers.get("X-Deadline-Ms"))
                 deadline_s = (float(deadline_ms) / 1e3
                               if deadline_ms is not None else None)
+                tier = req.get("tier", self.headers.get("X-Tier",
+                                                        "interactive"))
+                if tier not in TIERS:
+                    raise ValueError(f"unknown tier {tier!r}; expected "
+                                     f"one of {TIERS}")
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": "bad_request", "detail": str(e)})
                 return
@@ -202,12 +218,14 @@ def _make_handler(registry: ModelRegistry, batcher: MicroBatcher,
                                       version=registry.version)
                     if tracer is not None else None)
             try:
-                result = batcher.predict(x, deadline_s=deadline_s)
+                result = batcher.predict(x, deadline_s=deadline_s,
+                                         tier=tier)
                 self._reply(200, {"y": np.asarray(result.y).tolist(),
                                   "version": result.version})
             except ShedError as e:
                 self._reply(503 if e.reason == "no_model" else 429,
-                            {"error": "shed", "reason": e.reason})
+                            {"error": "shed", "reason": e.reason,
+                             "tier": tier})
             except FuturesTimeout:
                 # the batcher never answered: a server-side stall, not a
                 # client error — 503 so LBs retry/fail over instead of

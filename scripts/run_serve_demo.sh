@@ -1,15 +1,21 @@
 #!/usr/bin/env bash
-# End-to-end serving demo (ISSUE 3 acceptance): serve-while-train, then
-# the open-loop load benchmark — asserting the full loop actually closes:
+# End-to-end serving demo (ISSUE 3 acceptance; multi-worker + v2 bench
+# per ISSUE 15): serve-while-train, then the gated load benchmark —
+# asserting the full loop actually closes:
 #
-#   * a cross-silo federation trains with --serve_port: the HTTP frontend
-#     comes up, /healthz goes healthy, live /predict answers mid-training,
-#     and /version ADVANCES as rounds publish new globals,
+#   * a cross-silo federation trains with --serve_port AND
+#     --serve_workers 2: the multi-worker pool comes up (SO_REUSEPORT,
+#     one registry), /healthz goes healthy and names the answering
+#     worker, live /predict answers mid-training, and /version ADVANCES
+#     as rounds publish new globals,
 #   * checkpoint retention (--checkpoint_keep_last_n) keeps the watched
 #     directory bounded,
-#   * scripts/serve_bench.py renders BENCH_serve.json (>=1k req/s on CPU,
-#     p99 under the deadline, zero torn-version responses across 10
-#     mid-load hot swaps).
+#   * scripts/serve_bench.py --smoke runs the v2 arm set (replay/http/
+#     decode) green — the CI-sized twin of the committed BENCH_serve.json,
+#   * scripts/perf_trend.py --serve_bench validates the COMMITTED
+#     artifact: arms present, honest backend labels, every recorded gate
+#     verdict passing (the serve path rides the same trend line as every
+#     other hot path).
 #
 # Usage: scripts/run_serve_demo.sh [workdir]  (default: a fresh mktemp dir)
 set -euo pipefail
@@ -27,7 +33,7 @@ env JAX_PLATFORMS=cpu python -m fedml_tpu \
     --log_stdout false --run_dir "$DIR/run" --telemetry true \
     --checkpoint_dir "$CK" --checkpoint_every 1 \
     --checkpoint_keep_last_n 3 \
-    --serve_port "$PORT" --serve_deadline_ms 100 &
+    --serve_port "$PORT" --serve_workers 2 --serve_deadline_ms 100 &
 TRAIN_PID=$!
 trap 'kill $TRAIN_PID 2>/dev/null || true' EXIT
 
@@ -64,6 +70,8 @@ while True:
         pass
     time.sleep(0.05)
 print(f"healthz up: {body}")
+assert body.get("workers") == 2, f"pool did not report 2 workers: {body}"
+assert "worker" in body, f"healthz lost the answering-worker id: {body}"
 
 versions, predicted = set(), 0
 x = [0.0] * 784
@@ -101,18 +109,26 @@ echo "== asserting checkpoint retention GC"
 KEPT=$(ls "$CK" | grep -c '^[0-9][0-9]*$')
 [ "$KEPT" -le 3 ] || { echo "retention kept $KEPT > 3 rounds"; exit 1; }
 
-echo "== open-loop load benchmark (10 mid-load hot swaps)"
-env JAX_PLATFORMS=cpu python scripts/serve_bench.py \
-    --rate 1500 --duration_s 5 --swaps 10 --out "$DIR/BENCH_serve.json"
+echo "== serve bench v2 smoke arms (replay / http / decode, gated)"
+env JAX_PLATFORMS=cpu python scripts/serve_bench.py --smoke \
+    --out "$DIR/BENCH_serve_smoke.json"
 
-python - "$DIR/BENCH_serve.json" <<'EOF'
+python - "$DIR/BENCH_serve_smoke.json" <<'EOF'
 import json, sys
 b = json.load(open(sys.argv[1]))
-assert b["torn_responses"] == 0, b
-assert b["throughput_rps"] >= 1000, b
-assert b["latency_ms"]["p99"] <= b["deadline_ms"], b
-print(f"bench OK: {b['throughput_rps']} req/s, "
-      f"p99={b['latency_ms']['p99']}ms, shed_rate={b['shed_rate']}, "
-      f"versions={b['versions_served']}")
+assert b["version"] == 2 and b["smoke"] is True, b
+r = b["arms"]["replay"]; d = b["arms"]["decode"]
+assert r["torn_responses"] == 0, r
+assert r["latency_ms"]["p99"] <= r["deadline_ms"], r
+assert d["occupancy_ratio"] >= 2.0, d
+assert d["recompiles_after_warmup"] == 0, d
+print(f"smoke OK: replay {r['throughput_rps']} req/s "
+      f"p99={r['latency_ms']['p99']}ms, decode occupancy "
+      f"{d['continuous']['occupancy_mean']} vs {d['drain']['occupancy_mean']} "
+      f"({d['occupancy_ratio']}x), ledger={d['compile_ledger']}")
 EOF
+
+echo "== trend gate over the COMMITTED BENCH_serve.json"
+env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
+    --serve_bench BENCH_serve.json
 echo "== serve demo OK ($DIR)"

@@ -33,5 +33,9 @@ cd "$(dirname "$0")/.."
 [ -f tests/test_pallas_agg.py ]
 [ -f tests/test_pallas_mask.py ]
 grep -q "fused=True" tests/test_shard_spine.py  # fused-finalize parity too
+# ISSUE 15 production serving: the multi-worker pool suite and the
+# continuous-batching decode suite must ride the fast tier
+[ -f tests/test_serve_pool.py ]
+[ -f tests/test_decode.py ]
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

@@ -1,0 +1,403 @@
+"""Continuous-batching decode contracts (ISSUE 15): incremental-decode
+parity vs the full forward, slot isolation and clean slot reuse, the
+scheduler's greedy correctness under mid-flight joins, drain-vs-
+continuous occupancy, the swap-barrier version contract (a KV cache
+computed under version v must never meet params v+1), jit-once per
+(slots, cache-bucket), and tiered shedding on the decode queue.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models.transformer import TransformerLM, init_decode_cache
+from fedml_tpu.serve.batcher import ShedError
+from fedml_tpu.serve.decode import DecodeScheduler
+from fedml_tpu.serve.registry import ModelRegistry
+
+VOCAB = 61
+
+
+def _model(**kw):
+    cfg = dict(vocab_size=VOCAB, d_model=32, n_heads=2, n_layers=2,
+               d_ff=64, max_len=64)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=0):
+    return model.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 8), jnp.int32))
+
+
+def _registry(params, version=0):
+    reg = ModelRegistry(lambda p, x: x, history=8)
+    reg.publish(params, version)
+    return reg
+
+
+def _ref_greedy(model, params, prompt, max_new):
+    """Reference greedy decode via the FULL forward pass each step —
+    the oracle the incremental path must match."""
+    toks = list(prompt)
+    out = []
+    for _ in range(max_new):
+        logits = model.apply(params, jnp.asarray([toks]))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+    return out
+
+
+# -- incremental decode vs full forward --------------------------------------
+
+def test_decode_logits_match_full_forward():
+    """Token-by-token cached decode reproduces the full forward's
+    per-position logits (same params, same math, explicit KV state)."""
+    model = _model()
+    params = _params(model)
+    B, T = 3, 12
+    seq = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, VOCAB)
+    full = model.apply(params, seq)
+    cache = init_decode_cache(model, slots=B, cache_len=16)
+    steps = []
+    for t in range(T):
+        logits, cache = model.apply(params, seq[:, t],
+                                    positions=jnp.full((B,), t),
+                                    cache=cache)
+        steps.append(logits)
+    dec = jnp.stack(steps, axis=1)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_decode_slots_are_isolated_and_positions_independent():
+    """Two sequences decoding in one batch at DIFFERENT positions match
+    each decoded alone — a slot never reads a neighbor's cache rows."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.RandomState(0)
+    seq_a = rng.randint(0, VOCAB, size=8)
+    seq_b = rng.randint(0, VOCAB, size=8)
+
+    def alone(seq, upto):
+        cache = init_decode_cache(model, slots=1, cache_len=16)
+        for t in range(upto + 1):
+            logits, cache = model.apply(
+                params, jnp.asarray([seq[t]]),
+                positions=jnp.asarray([t]), cache=cache)
+        return np.asarray(logits[0])
+
+    # batch: slot 0 walks seq_a from t=0; slot 1 starts seq_b LATER so
+    # the two slots sit at different positions every joint step
+    cache = init_decode_cache(model, slots=2, cache_len=16)
+    for t in range(3):   # slot 1 idle: feed its own prefix only in slot 0
+        logits, cache = model.apply(
+            params, jnp.asarray([seq_a[t], 0]),
+            positions=jnp.asarray([t, 0]), cache=cache)
+    # now slot 1 begins at position 0 while slot 0 continues at t
+    for i in range(4):
+        logits, cache = model.apply(
+            params, jnp.asarray([seq_a[3 + i], seq_b[i]]),
+            positions=jnp.asarray([3 + i, i]), cache=cache)
+    np.testing.assert_allclose(logits[0], alone(seq_a, 6),
+                               atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(logits[1], alone(seq_b, 3),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_slot_reuse_masks_previous_occupant():
+    """A slot restarting at position 0 over a DIRTY cache (previous
+    occupant's rows still there) decodes exactly like a fresh cache —
+    the kv_idx <= position mask hides stale state by construction."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.RandomState(1)
+    first = rng.randint(0, VOCAB, size=10)
+    second = rng.randint(0, VOCAB, size=5)
+    cache = init_decode_cache(model, slots=1, cache_len=16)
+    for t, tok in enumerate(first):     # dirty the cache deep
+        _, cache = model.apply(params, jnp.asarray([tok]),
+                               positions=jnp.asarray([t]), cache=cache)
+    dirty = cache
+    fresh = init_decode_cache(model, slots=1, cache_len=16)
+    for t, tok in enumerate(second):    # same tokens over both caches
+        out_d, dirty = model.apply(params, jnp.asarray([tok]),
+                                   positions=jnp.asarray([t]),
+                                   cache=dirty)
+        out_f, fresh = model.apply(params, jnp.asarray([tok]),
+                                   positions=jnp.asarray([t]),
+                                   cache=fresh)
+        np.testing.assert_array_equal(np.asarray(out_d),
+                                      np.asarray(out_f))
+
+
+def test_decode_requires_positions_and_rejects_ring_axis():
+    model = _model()
+    params = _params(model)
+    cache = init_decode_cache(model, slots=1, cache_len=8)
+    with pytest.raises(ValueError, match="positions"):
+        model.apply(params, jnp.asarray([1]), cache=cache)
+    with pytest.raises(ValueError, match="ring_axis"):
+        model.apply(params, jnp.asarray([1]),
+                    positions=jnp.asarray([0]), cache=cache,
+                    ring_axis="seq")
+
+
+def test_cache_len_must_fit_positional_table():
+    model = _model(max_len=32)
+    with pytest.raises(ValueError, match="max_len"):
+        init_decode_cache(model, slots=2, cache_len=64)
+
+
+def test_moe_decode_runs():
+    """The MoE variant decodes through the same cache path (SwitchFFN is
+    shape-generic over T=1)."""
+    model = _model(moe_experts=2)
+    params = _params(model)
+    cache = init_decode_cache(model, slots=2, cache_len=8)
+    logits, cache = model.apply(params, jnp.asarray([3, 4]),
+                                positions=jnp.asarray([0, 0]),
+                                cache=cache)
+    assert logits.shape == (2, VOCAB)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+# -- scheduler ---------------------------------------------------------------
+
+def test_scheduler_matches_reference_greedy_with_mid_flight_joins():
+    """More requests than slots: later requests join mid-flight as
+    earlier ones finish, and every result still matches the full-forward
+    greedy oracle — scheduling is numerically invisible."""
+    model = _model()
+    params = _params(model)
+    reg = _registry(params)
+    sched = DecodeScheduler(reg, model, slots=2, cache_len=32,
+                            max_new=5).start()
+    assert sched.warmup()
+    rng = np.random.RandomState(3)
+    prompts = [list(rng.randint(1, VOCAB, size=rng.randint(1, 6)))
+               for _ in range(7)]
+    futs = [sched.submit(p, max_new=5) for p in prompts]
+    for p, f in zip(prompts, futs):
+        r = f.result(60)
+        assert r.tokens == _ref_greedy(model, params, p, 5)
+        assert r.version == 0 and not r.truncated
+    assert sched._cache_size() == 1, "mid-flight joins retraced the step"
+    sched.stop()
+
+
+def test_drain_mode_admits_only_when_all_slots_free():
+    """The drain baseline holds occupancy strictly to batch boundaries:
+    mean occupancy under mixed lengths sits well below continuous."""
+    model = _model()
+    params = _params(model)
+    reg = _registry(params)
+    results = {}
+    for continuous in (False, True):
+        sched = DecodeScheduler(reg, model, slots=4, cache_len=32,
+                                continuous=continuous).start()
+        assert sched.warmup()
+        prompts = [[1 + i] for i in range(16)]
+        max_news = [20 if i % 4 == 0 else 3 for i in range(16)]
+        futs = [sched.submit(p, max_new=m)
+                for p, m in zip(prompts, max_news)]
+        toks = [f.result(60).tokens for f in futs]
+        results[continuous] = (sched.occupancy(), toks)
+        sched.stop()
+    occ_drain, toks_drain = results[False]
+    occ_cont, toks_cont = results[True]
+    assert toks_drain == toks_cont, "schedule changed the greedy tokens"
+    assert occ_cont > occ_drain * 1.5, (
+        f"continuous occupancy {occ_cont:.2f} not clearly above "
+        f"drain {occ_drain:.2f}")
+
+
+def test_swap_barrier_pins_version_for_in_flight_sequences():
+    """A publish mid-generation must NOT touch live sequences (their KV
+    cache is state of the OLD params): they finish on the pinned
+    version, admission pauses, and post-drain requests get the new one
+    — with tokens matching each version's own oracle."""
+    model = _model()
+    params0 = _params(model, seed=0)
+    params1 = jax.tree.map(lambda v: v - 0.02, params0)
+    reg = _registry(params0, version=0)
+    sched = DecodeScheduler(reg, model, slots=2, cache_len=32,
+                            max_new=24).start()
+    assert sched.warmup()
+    futs = [sched.submit([5, 6], max_new=24) for _ in range(2)]
+    # wait until both sequences are demonstrably in flight
+    deadline = time.monotonic() + 10
+    while sched.steps < 3 and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert sched.steps >= 3, "sequences never started"
+    reg.publish(params1, 1)
+    late = sched.submit([7, 8], max_new=4)
+    for f in futs:
+        r = f.result(60)
+        assert r.version == 0, "swap landed mid-sequence"
+        assert r.tokens == _ref_greedy(model, params0, [5, 6], 24)
+    r = late.result(60)
+    assert r.version == 1, "post-drain admission kept the stale snapshot"
+    assert r.tokens == _ref_greedy(model, params1, [7, 8], 4)
+    assert sched._cache_size() == 1
+    sched.stop()
+
+
+def test_scheduler_jit_once_registered_with_sentry_and_ledger():
+    from fedml_tpu.obs.device import DeviceRecorder
+    from fedml_tpu.obs.perf import RecompileSentry
+    model = _model()
+    reg = _registry(_params(model))
+    sched = DecodeScheduler(reg, model, slots=2, cache_len=16)
+    recorder = DeviceRecorder(cost_analysis=False)
+    sentry = RecompileSentry(strict=True)
+    name = sched.register_obs(recorder, sentry)
+    assert name == "decode_step[s2,c16]"
+    recorder.round_start()
+    assert sched.warmup()
+    compiles = recorder.round_snapshot(None)["compiles"]
+    assert any(c["fn"] == name for c in compiles), compiles
+    sentry.check(0)
+    sched.start()
+    recorder.round_start()
+    futs = [sched.submit([1, 2], max_new=4) for _ in range(5)]
+    for f in futs:
+        f.result(60)
+    assert sentry.check(1) == {}, "decode step retraced under load"
+    assert recorder.round_snapshot(None)["compiles"] == []
+    assert sched._cache_size() == 1
+    sched.stop()
+
+
+def test_truncation_at_cache_bucket_is_flagged():
+    model = _model()
+    reg = _registry(_params(model))
+    sched = DecodeScheduler(reg, model, slots=1, cache_len=8,
+                            max_new=32).start()
+    assert sched.warmup()
+    # prompt 3 + requested 32 > bucket 8: admission caps max_new at 5
+    # and the result says so — the generation WAS cut by the bucket
+    r = sched.generate([1, 2, 3], max_new=32)
+    assert len(r.tokens) == 5 and r.truncated
+    # a request that FITS is never flagged
+    r2 = sched.generate([1, 2, 3], max_new=5)
+    assert len(r2.tokens) == 5 and not r2.truncated
+    with pytest.raises(ValueError, match="does not fit"):
+        sched.submit(list(range(1, 9)))   # prompt alone fills the bucket
+    sched.stop()
+
+
+def test_decode_shedding_queue_full_deadline_shutdown_no_model():
+    model = _model()
+    reg = ModelRegistry(lambda p, x: x, history=4)   # EMPTY registry
+    sched = DecodeScheduler(reg, model, slots=1, cache_len=16,
+                            queue_depth=2).start()
+    f = sched.submit([1], max_new=2)
+    with pytest.raises(ShedError, match="no_model"):
+        f.result(30)
+    sched.stop()
+
+    reg2 = _registry(_params(model))
+    sched2 = DecodeScheduler(reg2, model, slots=1, cache_len=16,
+                             queue_depth=2)   # worker NOT started
+    sched2.submit([1])
+    sched2.submit([1])
+    with pytest.raises(ShedError, match="queue_full"):
+        sched2.submit([1])
+    sched2.stop(drain=False)
+    with pytest.raises(ShedError, match="shutdown"):
+        sched2.submit([1])
+
+    sched3 = DecodeScheduler(reg2, model, slots=1, cache_len=16)
+    doomed = sched3.submit([1], deadline_s=0.0)
+    time.sleep(0.01)
+    sched3.start()
+    with pytest.raises(ShedError, match="deadline"):
+        doomed.result(30)
+    sched3.stop()
+
+
+def test_decode_tier_gate_sheds_best_effort_on_breach():
+    """Best-effort decode submits read the SAME objective verdicts as
+    deep-healthz: a breaching gate sheds them (slo_degraded) while
+    interactive requests keep flowing."""
+    class _Gate:
+        def __init__(self):
+            self.bad = False
+
+        def degraded(self):
+            return self.bad
+
+    model = _model()
+    reg = _registry(_params(model))
+    gate = _Gate()
+    sched = DecodeScheduler(reg, model, slots=1, cache_len=16,
+                            slo=gate).start()
+    assert sched.warmup()
+    assert sched.generate([1], max_new=2, tier="best_effort").tokens
+    gate.bad = True
+    with pytest.raises(ShedError, match="slo_degraded"):
+        sched.submit([1], tier="best_effort")
+    assert sched.generate([1], max_new=2).tokens   # interactive unharmed
+    with pytest.raises(ValueError, match="unknown tier"):
+        sched.submit([1], tier="bulk")
+    sched.stop()
+
+
+def test_drain_on_stop_answers_queued_sequences():
+    model = _model()
+    reg = _registry(_params(model))
+    sched = DecodeScheduler(reg, model, slots=2, cache_len=16,
+                            max_new=3)
+    futs = [sched.submit([1 + i], max_new=3) for i in range(5)]
+    sched.start()
+    sched.stop(drain=True)   # may race the worker's FIRST iteration:
+    # the drain contract must hold even when no snapshot was pinned yet
+    for f in futs:
+        assert len(f.result(0).tokens) == 3
+    # never-started scheduler: same contract, settled inline
+    sched2 = DecodeScheduler(reg, model, slots=2, cache_len=16,
+                             max_new=3)
+    futs2 = [sched2.submit([2 + i], max_new=3) for i in range(3)]
+    sched2.stop(drain=True)
+    for f in futs2:
+        assert len(f.result(0).tokens) == 3
+
+
+def test_queue_utilization_gauge_recovers_after_burst():
+    """The queue-fill gauge must fall back as the worker drains — a
+    submit-only gauge would latch a burst's high-water mark and
+    self-sustain an SLO breach (and best-effort shedding) on an idle
+    instance."""
+    from fedml_tpu.obs import telemetry
+    telemetry.enable()
+    try:
+        model = _model()
+        reg = _registry(_params(model))
+        sched = DecodeScheduler(reg, model, slots=2, cache_len=16,
+                                max_new=2, queue_depth=8)
+        futs = [sched.submit([1 + i], max_new=2) for i in range(8)]
+        snap = telemetry.get_registry().snapshot()
+        g = [v for k, v in snap["gauges"].items()
+             if k.startswith("fedml_serve_queue_utilization_ratio")]
+        assert g and max(g) == 1.0, "burst never registered"
+        sched.start()
+        for f in futs:
+            f.result(60)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            snap = telemetry.get_registry().snapshot()
+            g = [v for k, v in snap["gauges"].items()
+                 if k.startswith("fedml_serve_queue_utilization_ratio")]
+            if max(g) == 0.0:
+                break
+            time.sleep(0.01)
+        assert max(g) == 0.0, f"gauge latched at {max(g)} after drain"
+        sched.stop()
+    finally:
+        telemetry.disable()

@@ -144,6 +144,21 @@ EXPECTED = {
     "fedml_shard_finalize_seconds",
     "fedml_shard_fused_launches_total",
     "fedml_shard_acc_bytes",
+    # PR 15: production serving (serve/pool.py multi-worker frontend,
+    # serve/decode.py continuous-batching decode, tiered admission):
+    # per-worker queue fill (the worst-worker SLO signal), the worker
+    # count, decode step/token/request/shed/swap accounting, per-step
+    # slot occupancy, and the SLO gauge the tier gate + deep-healthz
+    # both read
+    "fedml_serve_queue_utilization_ratio",
+    "fedml_serve_workers_value",
+    "fedml_serve_decode_requests_total",
+    "fedml_serve_decode_steps_total",
+    "fedml_serve_decode_tokens_total",
+    "fedml_serve_decode_swaps_total",
+    "fedml_serve_decode_shed_total",
+    "fedml_serve_decode_occupancy_total",
+    "fedml_slo_serve_queue_utilization_ratio",
 }
 
 

@@ -411,6 +411,97 @@ def test_http_frontend_lifecycle(tmp_path):
         batcher.submit(_probe_x())
 
 
+def test_http_keepalive_two_requests_one_connection():
+    """Satellite pin (ISSUE 15): the handler speaks HTTP/1.1 keep-alive
+    with correct Content-Length framing — two requests ride ONE TCP
+    connection, byte-accurate bodies, no per-request dial."""
+    registry = ModelRegistry(_linear_apply(), history=8)
+    registry.publish(_params(2), 2)
+    batcher = MicroBatcher(registry, buckets=(1, 2), max_delay_s=0.001)
+    frontend = ServeFrontend(registry, batcher, port=0).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", frontend.port,
+                                          timeout=10)
+        conn.connect()
+        sock_before = conn.sock
+        for i in range(2):   # two POSTs, one connection
+            conn.request("POST", "/predict",
+                         json.dumps({"x": _probe_x().tolist()}),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.version == 11, "handler fell back to HTTP/1.0"
+            clen = resp.getheader("Content-Length")
+            body = resp.read()
+            assert clen is not None and int(clen) == len(body), (
+                "Content-Length does not frame the body — keep-alive "
+                "would desync on the next request")
+            assert json.loads(body)["version"] == 2
+        assert conn.sock is sock_before, "connection was re-dialed"
+        # a GET on the SAME connection still frames correctly
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert int(resp.getheader("Content-Length")) == len(resp.read())
+        conn.close()
+    finally:
+        frontend.stop()
+
+
+def test_registry_pin_survives_concurrent_publish_storm():
+    """Satellite audit (ISSUE 15): a pinned version must never be
+    evicted out from under a serving worker while publishes hammer the
+    registry from another thread — current() stays the pinned snapshot
+    and the pinned version stays in history throughout."""
+    registry = ModelRegistry(_linear_apply(), history=3)
+    for v in range(3):
+        registry.publish(_params(v), v)
+    registry.pin(1)
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            m = registry.current()
+            if m is None or m.version != 1:
+                errors.append(("lost pin", None if m is None
+                               else m.version))
+            if 1 not in registry.versions():
+                errors.append(("pinned version evicted from history",))
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for v in range(3, 40):
+        registry.publish(_params(v), v)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors[:3]
+    assert 1 in registry.versions()
+    # history stayed bounded despite the protected entries
+    assert len(registry.versions()) <= 4
+    registry.unpin()
+    assert registry.version == 39
+
+
+def test_rollback_on_fully_evicted_history_fails_loudly():
+    """Satellite audit: rollback() when eviction left nothing older than
+    the live version raises — it must never serve None or a KeyError
+    from a missing history slot."""
+    registry = ModelRegistry(_linear_apply(), history=2)
+    for v in range(6):   # eviction keeps only the newest + live
+        registry.publish(_params(v), v)
+    registry.rollback()          # one older version still exists
+    assert registry.version == 4
+    registry.unpin()
+    for v in range(6, 12):
+        registry.publish(_params(v), v)
+    registry.rollback()
+    with pytest.raises(RuntimeError, match="cannot rollback"):
+        registry.rollback()      # nothing older survived eviction
+    assert registry.current() is not None, "rollback left a None model"
+
+
 def test_http_deadline_propagates_to_429():
     """A request whose deadline_ms cannot be met while the worker is
     busy answers 429 (shed), not a late 200."""
@@ -436,21 +527,29 @@ def test_http_deadline_propagates_to_429():
 
 @pytest.mark.slow
 def test_sustained_load_acceptance(tmp_path):
-    """The serve_bench acceptance in miniature: open-loop 1.2k req/s for
-    3s with 10 mid-load hot swaps — zero torn responses, p99 under the
-    deadline, ≥1k req/s sustained, BENCH json renders."""
+    """The serve_bench v2 acceptance in miniature: the --smoke arm set
+    (replay + http + decode, fresh subprocesses each) runs green, the
+    artifact validates against the trend gate's schema, and the smoke
+    replay arm still sheds nothing and tears nothing."""
     import subprocess
     import sys
-    out = str(tmp_path / "BENCH_serve.json")
+
+    from fedml_tpu.obs.trend import validate_serve_bench
+    out = str(tmp_path / "BENCH_serve_smoke.json")
     proc = subprocess.run(
-        [sys.executable, "scripts/serve_bench.py", "--rate", "1200",
-         "--duration_s", "3", "--swaps", "10", "--out", out],
-        capture_output=True, text=True, timeout=300,
+        [sys.executable, "scripts/serve_bench.py", "--smoke",
+         "--out", out],
+        capture_output=True, text=True, timeout=900,
         env={"JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin",
              "HOME": "/tmp"},
         cwd=str(__import__("pathlib").Path(__file__).parent.parent))
-    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
     bench = json.load(open(out))
-    assert bench["torn_responses"] == 0
-    assert bench["throughput_rps"] >= 1000
-    assert bench["latency_ms"]["p99"] <= bench["deadline_ms"]
+    assert bench["version"] == 2 and bench["smoke"] is True
+    assert validate_serve_bench(bench) == []
+    replay = bench["arms"]["replay"]
+    assert replay["torn_responses"] == 0
+    assert replay["latency_ms"]["p99"] <= replay["deadline_ms"]
+    decode = bench["arms"]["decode"]
+    assert decode["occupancy_ratio"] >= 2.0
+    assert decode["recompiles_after_warmup"] == 0
