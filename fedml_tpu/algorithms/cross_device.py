@@ -86,6 +86,10 @@ class CrossDeviceConfig(FedAvgConfig):
     norm_screen_k: float = 6.0
     norm_screen_window: int = 64
     norm_screen_min_history: int = 8
+    wave_adversary: str = ""      # seeded poisoned WAVE SUMMARIES,
+    #                               injected pre-admission (ISSUE 16):
+    #                               "round:wave:kind[:param],..." —
+    #                               robust/adversary.WAVE_ATTACK_KINDS
 
 
 class CrossDevice(FedAvg):
@@ -97,7 +101,8 @@ class CrossDevice(FedAvg):
     only ``wave_size`` does."""
 
     def __init__(self, workload, data, config: CrossDeviceConfig,
-                 mesh=None, sink=None, perf=None, health=None, slo=None):
+                 mesh=None, sink=None, perf=None, health=None, slo=None,
+                 publish=None):
         cfg = config
         if cfg.local_alg not in LOCAL_ALGS:
             raise ValueError(f"--local_alg must be one of {LOCAL_ALGS}, "
@@ -149,6 +154,19 @@ class CrossDevice(FedAvg):
         self.perf = perf
         self.health = health
         self.slo = slo
+        # the train-to-serve seam (ISSUE 16): called with each round's
+        # finalized global as ``publish(params, version)`` — version =
+        # round_idx + 1 so a pre-published baseline can hold version 0
+        self.publish = publish
+        # seeded wave-summary poisoning, injected PRE-admission — the
+        # mega-cohort path's first-class attacker (no per-silo message
+        # seam exists inside a compiled wave)
+        if cfg.wave_adversary:
+            from fedml_tpu.robust.adversary import parse_wave_adversary_spec
+            self._wave_attacks = parse_wave_adversary_spec(
+                cfg.wave_adversary)
+        else:
+            self._wave_attacks = {}
         # lazily bound on first round (they need the params template)
         self.stream: Optional[StreamingAggregator] = None
         self.admission: Optional[WaveAdmission] = None
@@ -321,6 +339,17 @@ class CrossDevice(FedAvg):
                 continue
             t0 = time.perf_counter()
             mean_host = jax.tree.map(np.asarray, mean)
+            attack = self._wave_attacks.get((round_idx, wi))
+            if attack is not None:
+                # poison the WAVE SUMMARY pre-admission: the screen, the
+                # health sketch, and the fold all see the attacked mean —
+                # exactly what a compromised wave aggregation would ship
+                from fedml_tpu.robust.adversary import poison_wave_summary
+                mean_host = poison_wave_summary(attack, mean_host,
+                                                host_params,
+                                                seed=cfg.seed)
+                logger.warning("round %d wave %d POISONED (%s:%g)",
+                               round_idx, wi, attack.kind, attack.param)
             verdict = self.admission.screen(mean_host, host_params)
             self._perf_phase("admission", time.perf_counter() - t0)
             if not verdict.ok:
@@ -331,7 +360,21 @@ class CrossDevice(FedAvg):
                     self.health.observe_rejected(wi + 1, verdict.reason)
                 continue
             t0 = time.perf_counter()
-            self.stream.fold_wave(stacked, w)
+            if attack is not None:
+                # fold the POISONED mean through the SAME stacked wave
+                # program as every clean wave — each member ships the
+                # attacked mean (the weighted mean of identical rows IS
+                # the row), so the spine receives what admission and
+                # health were shown AND its hot fold never traces a new
+                # path in an attack round (the strict recompile sentry
+                # holds even under attack)
+                poisoned = jax.tree.map(
+                    lambda m, s: jnp.broadcast_to(
+                        jnp.asarray(m, dtype=s.dtype), s.shape),
+                    mean_host, stacked)
+                self.stream.fold_wave(poisoned, w)
+            else:
+                self.stream.fold_wave(stacked, w)
             dt = time.perf_counter() - t0
             self._h_fold.observe(dt)
             self._perf_phase("fold", dt)
@@ -413,6 +456,8 @@ class CrossDevice(FedAvg):
             params, info = self._run_round(params, ids, round_rng,
                                            round_idx)
             jax.block_until_ready(params)
+            if self.publish is not None:
+                self.publish(params, round_idx + 1)
             round_s = time.time() - t0
             if self.perf is not None:
                 self.perf.round_end(round_idx, cohort=len(ids),

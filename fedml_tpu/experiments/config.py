@@ -471,6 +471,30 @@ class ExperimentConfig:
     #                                 breaching) best_effort sheds and
     #                                 interactive keeps the reserve
 
+    # ---- release gate (fedml_tpu/serve/release: canary → promote) ------
+    release_gate: bool = False      # gate every published global behind
+    #                                 the canary release controller:
+    #                                 shadow divergence + health alarms +
+    #                                 held-out eval must all pass before
+    #                                 the serving swap (requires
+    #                                 --serve_port)
+    release_shadow_every: int = 16  # shadow sampler: capture every Nth
+    #                                 admitted /predict instance
+    release_shadow_slots: int = 64  # shadow ring size (newest N kept)
+    release_divergence_budget: float = 0.1  # max fraction of shadow rows
+    #                                 where canary disagrees with live
+    release_eval_tolerance: float = 0.02  # held-out eval may regress at
+    #                                 most this much vs the last promoted
+    release_cooldown_s: float = 5.0  # refuse new canaries this long
+    #                                 after a rollback...
+    release_backoff: float = 2.0    # ...growing exponentially per
+    #                                 consecutive failure...
+    release_max_cooldown_s: float = 60.0  # ...capped here
+    wave_adversary: str = ""        # cross_device only: seeded poisoned
+    #                                 wave summaries, injected pre-
+    #                                 admission — "round:wave:kind[:param]"
+    #                                 comma list (robust/adversary)
+
 
 def build_parser() -> argparse.ArgumentParser:
     """Argparse surface generated from the dataclass — one flag per field,

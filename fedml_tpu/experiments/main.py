@@ -256,6 +256,26 @@ def _eval_global(workload, params, data) -> Dict[str, float]:
     return out
 
 
+def _release_eval_fn(workload, data):
+    """Held-out scorer for the release gate: test accuracy, higher is
+    better.  None when the dataset has no test split — the eval signal
+    then passes vacuously (and says so in the verdict) instead of
+    scoring candidates on training data."""
+    if data.test is None:
+        return None
+    import jax
+    from fedml_tpu.parallel.cohort import cohort_eval
+    from fedml_tpu.trainer.local_sgd import make_evaluator
+    from fedml_tpu.utils.metrics import stats_from_metrics
+    ev = cohort_eval(make_evaluator(workload))
+    test = {k: jax.numpy.asarray(v) for k, v in data.test.items()}
+
+    def score(params):
+        return stats_from_metrics(ev(params, test))["acc"]
+
+    return score
+
+
 def _first_cohort(data, n: int):
     """Deterministic cohort of the first n clients (for cohort-input
     algorithms: FedNAS / FedGKT / FedGAN)."""
@@ -1158,7 +1178,7 @@ def run_cross_silo(cfg, data, mesh, sink):
     # round's global into a hot-swap registry behind an HTTP frontend, so
     # the federation serves its own model live.  A gRPC SILO process never
     # serves — only rank 0 holds the global.
-    frontend = publish = None
+    frontend = publish = release = None
     if cfg.serve_port > 0 and (cfg.silo_backend == "local"
                                or cfg.node_id == 0):
         from fedml_tpu.serve import (MicroBatcher, ModelRegistry,
@@ -1172,6 +1192,14 @@ def run_cross_silo(cfg, data, mesh, sink):
             queue_depth=cfg.serve_queue_depth,
             default_deadline_s=cfg.serve_deadline_ms / 1e3,
             best_effort_headroom=cfg.serve_best_effort_headroom)
+        shadow = None
+        if cfg.release_gate:
+            # the shadow tap rides every worker's batcher (one shared
+            # sampler), so the gate replays real admitted traffic
+            from fedml_tpu.serve import ReleaseController, ShadowSampler
+            shadow = ShadowSampler(every=cfg.release_shadow_every,
+                                   slots=cfg.release_shadow_slots)
+            batcher_kw["shadow"] = shadow
         # deep health check: /healthz?deep=1 evaluates the rolling SLOs
         # (round p95, shed rate, worst-worker queue fill, torn frames,
         # quarantines) and answers 503 on breach so an LB can rotate out
@@ -1188,11 +1216,32 @@ def run_cross_silo(cfg, data, mesh, sink):
             frontend = ServeFrontend(registry, batcher,
                                      port=cfg.serve_port,
                                      slo=slo, health=health).start()
+        if cfg.release_gate:
+            import os as _os
+            release = ReleaseController(
+                registry, shadow=shadow, health=health,
+                eval_fn=_release_eval_fn(wl, data),
+                divergence_budget=cfg.release_divergence_budget,
+                eval_tolerance=cfg.release_eval_tolerance,
+                cooldown_s=cfg.release_cooldown_s,
+                backoff=cfg.release_backoff,
+                max_cooldown_s=cfg.release_max_cooldown_s,
+                journal_path=_os.path.join(
+                    cfg.metrics_dir or cfg.run_dir or ".",
+                    "release.jsonl"))
         _sample_x = np.asarray(data.train["x"][0, 0, 0])
         _warmed = []
 
         def publish(params, version):
-            registry.publish(params, version)
+            if release is not None:
+                # the gated path: canary → shadow/health/eval verdict →
+                # promote or rollback.  The cross-silo hook's version IS
+                # the producing round, which keys the health signal.
+                release.offer(params, version, round_idx=version)
+            else:
+                registry.publish(params, version)
+            if registry.current() is None:
+                return  # first offer rolled back: nothing to warm yet
             if not _warmed:
                 _warmed.append(True)
                 # compile every bucket off the round path: without this
@@ -1486,6 +1535,7 @@ def run_cross_device(cfg, data, mesh, sink):
             norm_screen_k=cfg.norm_screen_k,
             norm_screen_window=cfg.norm_screen_window,
             norm_screen_min_history=cfg.norm_screen_min_history,
+            wave_adversary=cfg.wave_adversary,
             **_fedavg_cfg_kwargs(cfg)),
         mesh=mesh, sink=sink, perf=perf, health=health, slo=slo)
     try:
@@ -1873,7 +1923,8 @@ def main(argv=None) -> Dict[str, Any]:
                 "--adversary wraps per-silo train fns over the real "
                 "message path (robust/adversary.py); the compiled wave "
                 "has no per-silo message seam — run attack scenarios on "
-                "--algo cross_silo")
+                "--algo cross_silo, or poison wave SUMMARIES here with "
+                "--wave_adversary round:wave:kind[:param]")
         if cfg.rounds_per_dispatch > 1:
             raise ValueError(
                 "--rounds_per_dispatch is the fedavg HBM-resident "
@@ -2058,6 +2109,28 @@ def main(argv=None) -> Dict[str, Any]:
         raise ValueError(
             f"--serve_best_effort_headroom must be in (0, 1], got "
             f"{cfg.serve_best_effort_headroom}")
+    # release gate (serve/release.py): gates the serve-while-train
+    # publish hook, so without a frontend the flag would silently train
+    # ungated while the run is labeled canary-protected
+    if cfg.release_gate and cfg.serve_port <= 0:
+        raise ValueError(
+            "--release_gate gates the serve-while-train publish hook "
+            "(canary → shadow/health/eval verdict) and needs "
+            "--serve_port; without a frontend there is no serving swap "
+            "to gate and the flag would silently do nothing.")
+    if cfg.release_gate and (cfg.release_shadow_every < 1
+                             or cfg.release_shadow_slots < 1):
+        raise ValueError(
+            f"--release_shadow_every and --release_shadow_slots must be "
+            f">= 1, got {cfg.release_shadow_every} and "
+            f"{cfg.release_shadow_slots}")
+    if cfg.wave_adversary and cfg.algo != "cross_device":
+        raise ValueError(
+            f"--wave_adversary poisons compiled wave SUMMARIES and "
+            f"applies to --algo cross_device only; --algo {cfg.algo} "
+            f"would silently train clean while the run is labeled "
+            f"poisoned.  Per-silo attacks on the actor path use "
+            f"--adversary.")
     # the flight recorder and the SLO evaluator hook the live actors'
     # round lifecycle; on the cohort-simulation algorithms the flags
     # would parse and then never record/evaluate anything — an empty

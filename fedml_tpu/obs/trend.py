@@ -285,6 +285,66 @@ def validate_serve_bench(obj: dict,
     return problems
 
 
+def validate_release_bench(obj: dict,
+                           allow_smoke: bool = True) -> List[str]:
+    """Schema + honesty check for ``BENCH_release.json`` v1 (ISSUE 16):
+    the train-to-serve release gate rides the same committed-artifact
+    trend line as the serve bench.  The bench SCRIPT enforces the
+    numeric gates at measurement time; this validates an artifact still
+    carries PASSING verdicts for both arms — and re-derives the two
+    claims a regenerated artifact must never lose: zero responses
+    served from the poisoned version, and zero recompiles after
+    warmup.  ``allow_smoke=False`` (the committed-trend-line mode)
+    rejects smoke-labeled artifacts outright."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return ["release bench is not a JSON object"]
+    if obj.get("bench") != "release":
+        problems.append(f"bench != 'release' (got {obj.get('bench')!r})")
+    if obj.get("version") != 1:
+        problems.append(f"version != 1 (got {obj.get('version')!r})")
+    if obj.get("smoke") and not allow_smoke:
+        problems.append("smoke-labeled artifact on the committed trend "
+                        "line (smoke runs carry relaxed load gates and "
+                        "belong in /tmp, never committed)")
+    arms = obj.get("arms")
+    if not isinstance(arms, dict) or not arms:
+        return problems + ["no arms section"]
+    for name in ("pipeline", "crash_promote"):
+        if name not in arms:
+            problems.append(f"missing required arm {name!r}")
+    for name, arm in arms.items():
+        if not isinstance(arm, dict):
+            problems.append(f"arm {name!r} is not an object")
+            continue
+        if arm.get("backend") not in ("cpu", "gpu", "tpu"):
+            problems.append(f"arm {name!r}: no honest backend label "
+                            f"(got {arm.get('backend')!r})")
+        gates = arm.get("gates")
+        if not isinstance(gates, dict) or not gates:
+            problems.append(f"arm {name!r}: no recorded gate verdicts")
+            continue
+        for gname, verdict in gates.items():
+            if not isinstance(verdict, dict) or "ok" not in verdict:
+                problems.append(f"arm {name!r}: gate {gname!r} without "
+                                f"an ok verdict")
+            elif not verdict["ok"]:
+                problems.append(f"arm {name!r}: gate {gname!r} FAILED "
+                                f"({verdict})")
+    pipe = arms.get("pipeline")
+    if isinstance(pipe, dict) and "error" not in pipe:
+        served = pipe.get("responses_by_version", {})
+        pv = pipe.get("poisoned_version")
+        if pv is not None and served.get(str(pv), 0) != 0:
+            problems.append(f"pipeline: {served[str(pv)]} responses "
+                            f"served from poisoned version {pv}")
+        if pipe.get("recompiles_after_warmup", 0) != 0:
+            problems.append(f"pipeline: "
+                            f"{pipe['recompiles_after_warmup']} "
+                            f"recompiles after warmup committed")
+    return problems
+
+
 def phase_medians(rows: List[dict],
                   skip_first: bool = True) -> Dict[str, float]:
     """Median per-phase seconds across the ledger (plus ``round_s``).
@@ -445,12 +505,18 @@ def main(argv=None) -> int:
                    help="BENCH_serve.json (v2) to validate: required "
                         "arms present, honest backend labels, recorded "
                         "gate verdicts all passing, zero torn responses")
+    p.add_argument("--release_bench", default=None,
+                   help="BENCH_release.json (v1) to validate: both arms "
+                        "present, honest backend labels, recorded gate "
+                        "verdicts all passing, zero responses from the "
+                        "poisoned version, zero recompiles after warmup")
     args = p.parse_args(argv)
     if args.ledger is None and not args.lint_mfu \
-            and args.health_ledger is None and args.serve_bench is None:
+            and args.health_ledger is None and args.serve_bench is None \
+            and args.release_bench is None:
         p.print_usage()
         print("perf_trend: nothing to do (pass --ledger, --health_ledger, "
-              "--serve_bench and/or --lint_mfu)")
+              "--serve_bench, --release_bench and/or --lint_mfu)")
         return 2
 
     failures: List[str] = []
@@ -550,6 +616,24 @@ def main(argv=None) -> int:
             occ = arms.get("decode", {}).get("occupancy_ratio")
             print(f"serve bench: {len(arms)} arm(s) green "
                   f"(replay {rps} req/s, decode occupancy ratio {occ})")
+
+    if args.release_bench is not None:
+        try:
+            with open(args.release_bench) as f:
+                release_obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"perf_trend: cannot read release bench: {e}")
+            return 2
+        # committed-trend-line mode: a smoke artifact must not anchor it
+        problems = validate_release_bench(release_obj, allow_smoke=False)
+        failures += [f"release bench: {x}" for x in problems]
+        if not problems:
+            arms = release_obj.get("arms", {})
+            pipe = arms.get("pipeline", {})
+            print(f"release bench: {len(arms)} arm(s) green "
+                  f"({pipe.get('promotions')} promotions, poisoned "
+                  f"v{pipe.get('poisoned_version')} contained, p99 "
+                  f"{pipe.get('latency_ms', {}).get('p99')}ms)")
 
     if args.lint_mfu:
         paths = _expand(args.lint_mfu)

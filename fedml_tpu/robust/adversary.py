@@ -217,3 +217,97 @@ def attacked_silos(adversaries: Dict[int, Attack],
     """Silo ids running one of ``kinds`` (all kinds when None)."""
     return sorted(s for s, a in adversaries.items()
                   if kinds is None or a.kind in kinds)
+
+
+# ---------------------------------------------------------------------------
+# wave-level poisoning (--cross_device; ISSUE 16)
+# ---------------------------------------------------------------------------
+
+# the cross-device engine has no per-silo message seam (clients train
+# INSIDE one compiled wave program), so per-silo kinds like inflate/
+# backdoor don't apply; these perturb the WAVE SUMMARY — the weighted
+# partial mean the admission screen and the streaming fold both see
+WAVE_ATTACK_KINDS = ("sign_flip", "scale", "gauss", "nan_bomb")
+
+
+@dataclasses.dataclass(frozen=True)
+class WaveAttack:
+    """One poisoned wave: at ``(round_idx, wave)`` (both 0-based), the
+    wave's summary is replaced per ``kind`` before admission — the
+    mega-cohort path's first-class attacker."""
+    round_idx: int
+    wave: int
+    kind: str
+    param: float
+
+    def __post_init__(self):
+        if self.kind not in WAVE_ATTACK_KINDS:
+            raise ValueError(f"unknown wave attack kind {self.kind!r}; "
+                             f"available: {WAVE_ATTACK_KINDS}")
+        if self.round_idx < 0 or self.wave < 0:
+            raise ValueError(f"--wave_adversary round/wave indices are "
+                             f"0-based and non-negative; got round="
+                             f"{self.round_idx} wave={self.wave}")
+
+
+def parse_wave_adversary_spec(spec: str) -> Dict[tuple, WaveAttack]:
+    """``"round:wave:kind[:param],..."`` → {(round, wave): WaveAttack}.
+
+        --wave_adversary "3:0:scale:50"        # round 3, wave 0, x50
+        --wave_adversary "1:0:sign_flip,2:1:gauss:5"
+    """
+    out: Dict[tuple, WaveAttack] = {}
+    if not spec:
+        return out
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"bad --wave_adversary entry {entry!r}; expected "
+                f"round:wave:kind[:param] (e.g. '3:0:scale:50')")
+        try:
+            round_idx, wave = int(parts[0]), int(parts[1])
+        except ValueError:
+            raise ValueError(f"bad --wave_adversary round/wave in "
+                             f"{entry!r}") from None
+        kind = parts[2].strip()
+        param = float(parts[3]) if len(parts) == 4 \
+            else _DEFAULT_PARAM.get(kind, 0.0)
+        key = (round_idx, wave)
+        if key in out:
+            raise ValueError(f"--wave_adversary lists round {round_idx} "
+                             f"wave {wave} twice")
+        out[key] = WaveAttack(round_idx, wave, kind, param)
+    return out
+
+
+def poison_wave_summary(attack: WaveAttack, mean_host, global_host,
+                        seed: int = 0):
+    """Apply ``attack`` to a wave's summary (the weighted partial MEAN,
+    params-like) relative to the round's global — the same update
+    semantics as the per-silo kinds, at wave granularity.  Host numpy
+    math, seeded per ``(seed, round, wave)`` so attacked runs replay
+    bit-identically."""
+    if attack.kind == "sign_flip":
+        return _tree_map2(
+            lambda g, m: (g - attack.param * (m - g)).astype(m.dtype),
+            global_host, mean_host)
+    if attack.kind == "scale":
+        return _tree_map2(
+            lambda g, m: (g + attack.param * (m - g)).astype(m.dtype),
+            global_host, mean_host)
+    if attack.kind == "gauss":
+        rng = np.random.RandomState(
+            (seed * 1_000_003 + attack.round_idx * 7919
+             + attack.wave * 101) % (2 ** 32))
+        return _tree_map1(
+            lambda m: (m + rng.normal(0.0, attack.param, m.shape))
+            .astype(m.dtype) if np.issubdtype(m.dtype, np.floating)
+            else m, mean_host)
+    if attack.kind == "nan_bomb":
+        return _first_float_leaf_to_nan(mean_host)
+    raise ValueError(  # pragma: no cover — __post_init__ validated
+        f"unhandled wave attack kind {attack.kind!r}")

@@ -60,11 +60,19 @@ CRASH_POINTS = (
     #                              not yet recovered (abort-only proof)
     "barrier_close",             # the round barrier just satisfied
     "publish",                   # checkpoint durable, publish pending
+    "canary_promote",            # release gate: verdict passed — fired
+    #                              BEFORE and AFTER the atomic registry
+    #                              promote (hit 1 = pre, hit 2 = post),
+    #                              so a respawn sees exactly one of the
+    #                              two consistent states, never between
+    "canary_rollback",           # release gate: verdict failed — fired
+    #                              around the canary discard the same way
 )
 
 # writer channels the disk-fault seam can hit (utils/journal callers)
 DISK_CHANNELS = ("perf_ledger", "health_ledger", "journal",
-                 "journal_snapshot")
+                 "journal_snapshot", "checkpoint_manifest",
+                 "release_journal")
 
 
 class ActorKilled(BaseException):

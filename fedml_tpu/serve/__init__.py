@@ -25,7 +25,16 @@ layers plus a bench harness:
                               autoregressive models: one compiled step
                               over fixed [slots], per-step slot admission,
                               swap-barrier version consistency
+    fedml_tpu.serve.release   train-to-serve release gate (ISSUE 16):
+                              every finalized global enters as a CANARY;
+                              promotion gated on shadow-traffic
+                              divergence, health-observatory alarms, and
+                              held-out eval regression — fail rolls back
+                              (the live slot never moved) with cooldown/
+                              backoff, all crash-consistent
     scripts/serve_bench.py    open-loop load generator → BENCH_serve.json
+    scripts/release_bench.py  gated release pipeline under live load →
+                              BENCH_release.json
 
 Everything is instrumented through the PR 2 telemetry registry under
 ``fedml_serve_*`` (see the README metric table) and designed to survive
@@ -39,8 +48,10 @@ from fedml_tpu.serve.batcher import (MicroBatcher, ShedError, TierGate,
 from fedml_tpu.serve.decode import DecodeResult, DecodeScheduler
 from fedml_tpu.serve.pool import ServeWorkerPool
 from fedml_tpu.serve.registry import ModelRegistry, ServedModel
+from fedml_tpu.serve.release import ReleaseController, ShadowSampler
 from fedml_tpu.serve.server import ServeFrontend
 
 __all__ = ["MicroBatcher", "ShedError", "TierGate", "TIERS",
            "DecodeResult", "DecodeScheduler", "ServeWorkerPool",
-           "ModelRegistry", "ServedModel", "ServeFrontend"]
+           "ModelRegistry", "ServedModel", "ServeFrontend",
+           "ReleaseController", "ShadowSampler"]

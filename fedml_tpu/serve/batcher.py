@@ -221,13 +221,17 @@ class MicroBatcher:
     best-effort submits shed while an objective is breaching.
     ``best_effort_headroom``: fraction of the queue depth best-effort
     traffic may fill; beyond it only interactive requests are admitted.
+    ``shadow``: a `serve.release.ShadowSampler` (or anything with
+    ``offer(x)``) — every ADMITTED request's instance is offered so the
+    release gate replays a deterministic slice of real traffic against
+    each canary; pool workers share ONE sampler via ``batcher_kw``.
     """
 
     def __init__(self, registry, buckets: Sequence[int] = DEFAULT_BUCKETS,
                  max_delay_s: float = 0.005, queue_depth: int = 256,
                  default_deadline_s: Optional[float] = None,
                  worker: Optional[str] = None, slo=None,
-                 best_effort_headroom: float = 0.5):
+                 best_effort_headroom: float = 0.5, shadow=None):
         buckets = tuple(int(b) for b in buckets)
         if not buckets or list(buckets) != sorted(set(buckets)) \
                 or buckets[0] < 1:
@@ -238,6 +242,7 @@ class MicroBatcher:
         self.max_delay_s = max_delay_s
         self.default_deadline_s = default_deadline_s
         self.worker = worker
+        self.shadow = shadow
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._stopped = False      # rejects new submits
         self._drain = True         # False: fail queued requests on stop
@@ -306,6 +311,10 @@ class MicroBatcher:
             except queue.Full:
                 raise self._shed("queue_full", tier) from None
         self._c_requests.inc()
+        if self.shadow is not None:
+            # admitted traffic only: the shadow slice mirrors what the
+            # serving model actually answers, not what admission shed
+            self.shadow.offer(x)
         self._note_depth()
         return req.future
 

@@ -23,10 +23,22 @@ Operational controls: ``pin(version)`` freezes serving on a known-good
 version while publishes keep accumulating history; ``rollback()`` steps
 the live model back one version (and pins there, so the next publish
 doesn't immediately re-roll); ``unpin()`` resumes following the newest.
+
+Release states (ISSUE 16): every history entry is either **promoted**
+(vetted — has served, or was published on the direct ungated path) or a
+**canary** (entered via ``publish(..., canary=True)`` by the
+`serve.release.ReleaseController`; in history for shadow evaluation but
+NEVER the live slot until ``promote()``).  ``rollback()`` steps back to
+the previous *promoted* version — a failed canary can never roll
+serving onto another unvetted model — and fails loudly when no older
+promoted version exists (the promoted horizon).  Canaries are
+eviction-protected while pending (the gate always resolves them to
+``promote`` or ``discard``), so a verdict can never race retention.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import threading
@@ -70,6 +82,7 @@ class ModelRegistry:
         self._max_history = history
         self._lock = threading.Lock()
         self._history: "OrderedDict[int, ServedModel]" = OrderedDict()
+        self._state: dict = {}  # version -> "promoted" | "canary"
         self._pinned: Optional[int] = None
         self._live: Optional[ServedModel] = None
         reg = telemetry.get_registry()
@@ -95,73 +108,183 @@ class ModelRegistry:
         with self._lock:
             return list(self._history)
 
+    def state(self, version: int) -> str:
+        """Release state of a history entry: "promoted" | "canary"."""
+        with self._lock:
+            if version not in self._history:
+                raise KeyError(f"version {version} not in registry "
+                               f"history {list(self._history)}")
+            return self._state[version]
+
+    def canaries(self) -> list:
+        """Versions still awaiting a release verdict."""
+        with self._lock:
+            return [v for v in self._history
+                    if self._state[v] == "canary"]
+
+    def get(self, version: int) -> ServedModel:
+        """The snapshot for ``version`` (shadow replay reads the canary
+        without ever touching the live slot)."""
+        with self._lock:
+            if version not in self._history:
+                raise KeyError(f"version {version} not in registry "
+                               f"history {list(self._history)}")
+            return self._history[version]
+
     # -- write path ---------------------------------------------------------
-    def publish(self, params: Pytree, version: int) -> bool:
+    def publish(self, params: Pytree, version: int,
+                canary: bool = False) -> bool:
         """Register a new model version; hot-swap it live unless a pin is
         holding an older version.  Returns True when the version was NEW
         (stale/duplicate publishes — e.g. a watcher and a train hook both
-        feeding the registry — are ignored, preserving monotonicity)."""
+        feeding the registry — are ignored, preserving monotonicity).
+
+        ``canary=True`` (the release gate's entry path): the version
+        lands in history but NEVER swaps the live slot — it serves only
+        shadow traffic until ``promote()`` or ``discard()`` resolves it.
+        """
         version = int(version)
         snapshot = ServedModel(params, self._apply_fn, version)
         with self._lock:
             if self._history and version <= next(reversed(self._history)):
                 return False
             self._history[version] = snapshot
-            while len(self._history) > self._max_history:
-                # evict oldest-first but NEVER the pinned or live version:
-                # a long serve-while-train run publishing past a pin must
-                # not make the pinned model un-rollback-able
-                protected = {self._pinned}
-                if self._live is not None:
-                    protected.add(self._live.version)
-                evict = next((k for k in self._history
-                              if k not in protected), None)
-                if evict is None:
-                    break
-                del self._history[evict]
-            if self._pinned is None:
+            self._state[version] = "canary" if canary else "promoted"
+            self._evict_locked()
+            if not canary and self._pinned is None:
                 self._live = snapshot
                 self._c_swap.inc()
             if self._live is not None:  # gauge tracks the SERVING version
                 self._g_version.set(self._live.version)
         log.info("registry: published version %d%s", version,
-                 " (pinned, not live)" if self._pinned is not None else "")
+                 " (canary, not live)" if canary else
+                 (" (pinned, not live)" if self._pinned is not None
+                  else ""))
         return True
 
+    def _evict_locked(self) -> None:
+        # evict oldest-first but NEVER the pinned, live, or a pending
+        # canary version: a long serve-while-train run publishing past a
+        # pin must not make the pinned model un-rollback-able, and a
+        # canary awaiting its verdict must not vanish mid-evaluation
+        while len(self._history) > self._max_history:
+            protected = {self._pinned}
+            if self._live is not None:
+                protected.add(self._live.version)
+            protected.update(v for v in self._history
+                             if self._state[v] == "canary")
+            evict = next((k for k in self._history
+                          if k not in protected), None)
+            if evict is None:
+                break
+            del self._history[evict]
+            self._state.pop(evict, None)
+
+    def promote(self, version: int) -> int:
+        """Resolve a canary as vetted: mark it promoted, swap it live,
+        and pin there (the promoted horizon — on the gated path serving
+        only ever moves by an explicit verdict).  Idempotent when the
+        version is already promoted AND live (the crash-at-
+        ``canary_promote`` respawn re-drives the verdict safely).
+        The swap is ONE lock-guarded reference assignment, so a process
+        killed anywhere around it leaves the registry either fully
+        pre-promote or fully post-promote — never between."""
+        with self._lock:
+            if version not in self._history:
+                raise KeyError(f"version {version} not in registry "
+                               f"history {list(self._history)}; cannot "
+                               f"promote")
+            if self._state[version] == "promoted":
+                if self._live is not None \
+                        and self._live.version == version:
+                    return version  # respawn replay: already done
+                raise RuntimeError(
+                    f"version {version} is promoted but not live "
+                    f"(live={None if self._live is None else self._live.version}); "
+                    f"promote() resolves canaries — use pin() to move "
+                    f"serving between vetted versions")
+            self._state[version] = "promoted"
+            self._pinned = version
+            self._live = self._history[version]
+            self._c_swap.inc()
+            self._g_version.set(version)
+        log.info("registry: PROMOTED canary version %d (live, pinned)",
+                 version)
+        return version
+
+    def discard(self, version: int) -> None:
+        """Resolve a canary as rejected: drop it from history.  The live
+        slot never moved for a canary, so this IS the rollback — serving
+        stays on the last promoted version.  Promoted versions cannot be
+        discarded (serving history is the rollback chain)."""
+        with self._lock:
+            if version not in self._history:
+                raise KeyError(f"version {version} not in registry "
+                               f"history {list(self._history)}; cannot "
+                               f"discard")
+            if self._state[version] != "canary":
+                raise RuntimeError(
+                    f"version {version} is promoted; discard() resolves "
+                    f"canaries only — promoted history is the rollback "
+                    f"chain")
+            del self._history[version]
+            del self._state[version]
+        log.warning("registry: discarded canary version %d", version)
+
     def pin(self, version: int) -> None:
-        """Freeze serving on ``version`` (must still be in history).
+        """Freeze serving on ``version`` (must still be in history and
+        promoted — a pin can never put an unvetted canary live).
         Publishes keep landing in history but stop swapping live."""
         with self._lock:
             if version not in self._history:
                 raise KeyError(
                     f"version {version} not in registry history "
                     f"{list(self._history)}; cannot pin")
+            if self._state[version] != "promoted":
+                raise RuntimeError(
+                    f"version {version} is an unvetted canary; pin() "
+                    f"serves promoted versions only — resolve it via "
+                    f"promote()/discard() first")
             self._pinned = version
             self._live = self._history[version]
             self._g_version.set(version)
 
     def unpin(self) -> None:
-        """Resume following the newest published version."""
+        """Resume following the newest PROMOTED version (a pending
+        canary is never served by unpinning past it)."""
         with self._lock:
             self._pinned = None
-            if self._history:
-                self._live = self._history[next(reversed(self._history))]
-                self._g_version.set(self._live.version)
+            newest = next(
+                (v for v in reversed(self._history)
+                 if self._state[v] == "promoted"), None)
+            if newest is not None:
+                self._live = self._history[newest]
+                self._g_version.set(newest)
 
     def rollback(self) -> int:
-        """Step the live model back one version and pin there (so the
-        next publish doesn't instantly re-roll).  Returns the version now
-        live; raises if there is no earlier version to fall back to."""
+        """Step the live model back to the previous PROMOTED version and
+        pin there (so the next publish doesn't instantly re-roll).
+        Canary entries are skipped — rollback must never land serving on
+        an unvetted model — and rolling past the promoted horizon (no
+        older promoted version in history) fails loudly instead of
+        serving whatever happens to be oldest.  Returns the version now
+        live."""
         with self._lock:
             if self._live is None:
                 raise RuntimeError("rollback before any publish")
             versions = list(self._history)
             idx = versions.index(self._live.version)
-            if idx == 0:
+            target = next(
+                (v for v in reversed(versions[:idx])
+                 if self._state[v] == "promoted"), None)
+            if target is None:
+                promoted = [v for v in versions
+                            if self._state[v] == "promoted"]
                 raise RuntimeError(
-                    f"no version older than {self._live.version} in "
-                    f"history {versions}; cannot rollback")
-            target = versions[idx - 1]
+                    f"no promoted version older than {self._live.version} "
+                    f"in history {versions} (promoted horizon: "
+                    f"{promoted}); cannot rollback onto an unvetted "
+                    f"canary")
             self._pinned = target
             self._live = self._history[target]
             self._g_version.set(target)
@@ -189,6 +312,15 @@ class CheckpointWatcher:
     is never shared.  A step that vanishes between list and load — the
     checkpointer's ``keep_last_n`` GC racing us — is counted and skipped,
     never fatal; it is marked seen so the watcher doesn't spin on it.
+
+    Torn-file hardening (ISSUE 16): the writer stamps every step with a
+    checksum manifest (`utils.checkpoint.manifest_path`, atomic-rename
+    via `utils.journal.atomic_write`).  When a manifest exists, the
+    loaded params must match its crc32 — a truncated orbax file, a
+    half-written manifest, or any torn read skips-and-warns
+    (``outcome="corrupt"``) instead of crashing the watcher or serving
+    garbage.  A step with NO manifest takes the pre-manifest load path
+    unverified (old checkpoint trees keep serving).
     """
 
     def __init__(self, registry: ModelRegistry, ckpt_dir: str,
@@ -205,6 +337,8 @@ class CheckpointWatcher:
                                     outcome="ok")
         self._c_vanished = reg.counter("fedml_serve_checkpoint_load_total",
                                        outcome="vanished")
+        self._c_corrupt = reg.counter("fedml_serve_checkpoint_load_total",
+                                      outcome="corrupt")
 
     def poll_once(self) -> int:
         """One list-and-load sweep (the thread's loop body; also the
@@ -223,21 +357,56 @@ class CheckpointWatcher:
         return published
 
     def _load(self, step: int):
-        from fedml_tpu.utils.checkpoint import RoundCheckpointer
+        from fedml_tpu.utils.checkpoint import (RoundCheckpointer,
+                                                _pack_keys, manifest_path)
+        from fedml_tpu.utils.journal import tree_crc
+        # the atomic-rename + checksum contract, verified BEFORE serving:
+        # a manifest that exists but cannot be parsed is a torn write —
+        # the step is suspect, never loaded (fail safe, keep serving)
+        want_crc = None
+        mpath = manifest_path(self.ckpt_dir, step)
+        if os.path.exists(mpath):
+            try:
+                with open(mpath) as f:
+                    manifest = json.load(f)
+                want_crc = int(manifest["crc"][self.param_key])
+            except (OSError, ValueError, KeyError, TypeError) as e:
+                self._c_corrupt.inc()
+                log.warning("watcher: step %d manifest torn/unreadable "
+                            "(%s: %s); skipping the step",
+                            step, type(e).__name__, e)
+                return None
         try:
             ck = RoundCheckpointer(self.ckpt_dir)
             try:
                 state = ck.restore(step)
             finally:
                 ck.close()
-            return state[self.param_key]
-        except (FileNotFoundError, KeyError, ValueError, OSError) as e:
+            params = state[self.param_key]
+        except (FileNotFoundError, KeyError) as e:
             # the step was GC'd between list and load, or is from a
             # different state schema — skip it, keep serving
             self._c_vanished.inc()
             log.warning("watcher: step %d unreadable (%s: %s); skipping",
                         step, type(e).__name__, e)
             return None
+        except Exception as e:  # noqa: BLE001 — a truncated orbax file
+            # raises whatever its decoder hits (ValueError, OSError,
+            # struct/msgpack errors...); every flavor of half-written
+            # checkpoint must skip-and-warn, never crash or serve garbage
+            self._c_corrupt.inc()
+            log.warning("watcher: step %d failed to load (%s: %s); "
+                        "skipping the step", step, type(e).__name__, e)
+            return None
+        if want_crc is not None:
+            got = tree_crc(_pack_keys(params))
+            if got != want_crc:
+                self._c_corrupt.inc()
+                log.warning("watcher: step %d params crc %d != manifest "
+                            "%d (torn/partial checkpoint); skipping",
+                            step, got, want_crc)
+                return None
+        return params
 
     def start(self) -> "CheckpointWatcher":
         if self._thread is not None:

@@ -172,9 +172,15 @@ def _make_handler(registry: ModelRegistry, batcher: MicroBatcher,
                         return
                 self._reply(200, body)
             elif path == "/version":
-                self._reply(200, {"version": registry.version,
-                                  "pinned": registry.pinned,
-                                  "history": registry.versions()})
+                body = {"version": registry.version,
+                        "pinned": registry.pinned,
+                        "history": registry.versions()}
+                canaries = getattr(registry, "canaries", None)
+                if canaries is not None:
+                    # release-gated registries: name what is in shadow
+                    # evaluation so an operator sees the pending canary
+                    body["canaries"] = canaries()
+                self._reply(200, body)
             elif path == "/metrics":
                 body = telemetry.get_registry().render_prometheus().encode()
                 self.send_response(200)

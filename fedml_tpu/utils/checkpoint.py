@@ -15,13 +15,27 @@ ordinary arrays) and re-wrapped on restore.
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+log = logging.getLogger(__name__)
+
 Pytree = Any
+
+MANIFEST_DIRNAME = "manifests"
+
+
+def manifest_path(ckpt_dir: str, step: int) -> str:
+    """The per-step checksum manifest: ``<ckpt_dir>/manifests/<step>.json``
+    — a sibling tree, never inside the orbax step dir (orbax owns that
+    layout), and never digit-named at the top level (the serving
+    watcher's step listing must not mistake it for a round)."""
+    return os.path.join(ckpt_dir, MANIFEST_DIRNAME, f"{step}.json")
 
 
 def _pack_keys(tree: Pytree) -> Pytree:
@@ -110,6 +124,46 @@ class RoundCheckpointer:
                         args=self._ocp.args.StandardSave(state))
         if not self.async_save:
             self._mngr.wait_until_finished()
+        self._write_manifest(round_idx, state)
+
+    def _write_manifest(self, round_idx: int, packed_state) -> None:
+        """Checksum manifest for the serving watcher's torn-file guard:
+        per-top-level-key crc32 over the PACKED leaves, written via the
+        atomic tmp+rename contract (and the ``checkpoint_manifest`` disk-
+        fault channel, so tests can inject torn/failed manifests).  A
+        manifest write failure warns and keeps training — the checkpoint
+        itself is durable; only the read-side verification is lost."""
+        from fedml_tpu.utils.journal import atomic_write, tree_crc
+        items = (packed_state.items() if hasattr(packed_state, "items")
+                 else [("state", packed_state)])
+        crcs = {str(k): tree_crc(v) for k, v in items}
+        path = manifest_path(self.ckpt_dir, round_idx)
+        data = json.dumps({"step": int(round_idx), "algo": "crc32",
+                           "crc": crcs}, sort_keys=True).encode()
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            atomic_write(path, data, channel="checkpoint_manifest")
+            self._prune_manifests(round_idx)
+        except OSError as e:
+            log.warning("checkpoint manifest for step %d not written "
+                        "(%s); watcher falls back to unverified load",
+                        round_idx, e)
+
+    def _prune_manifests(self, current_step: int) -> None:
+        """Drop manifests whose step dir the retention GC already took
+        (the manifest tree must stay as bounded as the checkpoints).
+        Steps >= the one just saved are kept unconditionally — an async
+        save's dir is not renamed durable yet when this runs."""
+        mdir = os.path.join(self.ckpt_dir, MANIFEST_DIRNAME)
+        try:
+            live = {n for n in os.listdir(self.ckpt_dir) if n.isdigit()}
+            for name in os.listdir(mdir):
+                stem = name[:-5] if name.endswith(".json") else name
+                if (stem.isdigit() and stem not in live
+                        and int(stem) < current_step):
+                    os.unlink(os.path.join(mdir, name))
+        except OSError:
+            pass
 
     def flush(self) -> None:
         """Block until every pending async save is durable."""

@@ -1,0 +1,156 @@
+#!/usr/bin/env bash
+# End-to-end release-gate demo (ISSUE 16): canary promote/rollback on
+# the live train-to-serve path, then the gated containment benchmark —
+# asserting the full release loop actually closes:
+#
+#   * a cross-silo federation trains with --serve_port AND
+#     --release_gate: every finalized global enters the registry as a
+#     CANARY and only a passing verdict (shadow / health / eval)
+#     promotes it to the live slot; /version ADVANCES only by verdict
+#     and exposes the in-flight canary set; the release journal
+#     records one verdict per offered version,
+#   * scripts/release_bench.py --smoke runs both arms green (pipeline
+#     containment + crash-at-promote consistency) — the CI-sized twin
+#     of the committed BENCH_release.json,
+#   * scripts/perf_trend.py --release_bench validates the COMMITTED
+#     artifact: both arms present, every recorded gate verdict passing,
+#     zero responses from the poisoned version, zero recompiles after
+#     warmup (the release path rides the same trend line as every
+#     other hot path).
+#
+# The tiny demo workload needs gate settings matched to its scale:
+# rounds finish in milliseconds, so the default 5s rollback cooldown
+# would swallow the whole run, and early-training eval is noisy enough
+# that the default 0.02 monotone-regression tolerance rolls back
+# legitimate rounds — both are sized down/up accordingly (production
+# defaults assume minutes-long rounds and a converged eval signal).
+#
+# Usage: scripts/run_release_demo.sh [workdir]  (default: a fresh mktemp dir)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DIR="${1:-$(mktemp -d /tmp/fedml_release_demo.XXXXXX)}"
+PORT="${SERVE_PORT:-8357}"
+echo "== release demo: artifacts under $DIR"
+
+env JAX_PLATFORMS=cpu python -m fedml_tpu \
+    --algo cross_silo --model lr --dataset mnist \
+    --client_num_in_total 8 --client_num_per_round 4 --comm_round 16 \
+    --epochs 2 --batch_size 10 --frequency_of_the_test 100 \
+    --log_stdout false --run_dir "$DIR/run" --telemetry true \
+    --serve_port "$PORT" --serve_workers 2 --serve_deadline_ms 100 \
+    --release_gate true --release_cooldown_s 0.5 \
+    --release_eval_tolerance 0.15 &
+TRAIN_PID=$!
+trap 'kill $TRAIN_PID 2>/dev/null || true' EXIT
+
+echo "== polling the gated frontend while training runs"
+python - "$PORT" "$TRAIN_PID" <<'EOF'
+import http.client, json, os, sys, time
+port, pid = int(sys.argv[1]), int(sys.argv[2])
+
+def alive():
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+def get(path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = json.loads(r.read())
+    conn.close()
+    return r.status, body
+
+deadline = time.time() + 120
+while True:
+    assert alive(), "training process died before the frontend came up"
+    assert time.time() < deadline, "frontend never came up"
+    try:
+        status, body = get("/healthz")
+        if status == 200:
+            break
+    except OSError:
+        pass
+    time.sleep(0.05)
+print(f"healthz up: {body}")
+
+versions, saw_canary_key, predicted = set(), False, 0
+x = [0.0] * 784
+while alive():
+    try:
+        status, body = get("/version")
+    except OSError:
+        break  # frontend closed at training end
+    if status == 200:
+        # the release-aware frontend exposes the in-flight canary set
+        saw_canary_key = saw_canary_key or ("canaries" in body)
+        if body["version"] is not None:
+            versions.add(body["version"])
+    if predicted < 3:  # live predictions answer from PROMOTED only
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=2)
+            conn.request("POST", "/predict", json.dumps({"x": x}),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            resp = json.loads(r.read())
+            conn.close()
+            if r.status == 200:
+                predicted += 1
+                print(f"live /predict ok at promoted version "
+                      f"{resp['version']}")
+        except OSError:
+            pass
+    time.sleep(0.02)
+
+print(f"promoted versions observed while training: {sorted(versions)}")
+assert len(versions) >= 2, \
+    f"/version never advanced by verdict: {sorted(versions)}"
+assert saw_canary_key, "/version never exposed the canary set"
+assert predicted > 0, "no live /predict succeeded mid-training"
+EOF
+wait "$TRAIN_PID"
+trap - EXIT
+
+echo "== asserting the release journal recorded one verdict per offer"
+python - "$DIR/run/release.jsonl" <<'EOF'
+import json, sys
+lines = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+assert lines, "release journal is empty"
+decisions = [l["decision"] for l in lines]
+allowed = {"promote", "rollback", "cooldown", "stale", "recover"}
+assert set(decisions) <= allowed, decisions
+promotes = decisions.count("promote")
+assert promotes >= 2, f"fewer than 2 promotions journaled: {decisions}"
+print(f"journal OK: {len(lines)} verdicts, {promotes} promotions, "
+      f"{decisions.count('rollback')} rollbacks, "
+      f"{decisions.count('cooldown')} cooldown refusals")
+EOF
+
+echo "== release bench smoke arms (pipeline containment + crash promote)"
+env JAX_PLATFORMS=cpu python scripts/release_bench.py --smoke \
+    --out "$DIR/BENCH_release_smoke.json"
+
+python - "$DIR/BENCH_release_smoke.json" <<'EOF'
+import json, sys
+b = json.load(open(sys.argv[1]))
+assert b["version"] == 1 and b["smoke"] is True, b
+p = b["arms"]["pipeline"]; c = b["arms"]["crash_promote"]
+pv = str(p["poisoned_version"])
+assert p["responses_by_version"].get(pv, 0) == 0, p
+assert p["decisions"][pv] == "rollback", p
+assert p["recompiles_after_warmup"] == 0, p
+assert p["latency_ms"]["p99"] <= p["deadline_ms"], p
+assert all(g["ok"] for g in c["gates"].values()), c
+print(f"smoke OK: poisoned v{pv} contained "
+      f"(divergence {p['shadow_divergence_by_version'][pv]}), "
+      f"{p['promotions']} promotions, p99={p['latency_ms']['p99']}ms, "
+      f"crash-at-promote consistent both sides of the swap")
+EOF
+
+echo "== trend gate over the COMMITTED BENCH_release.json"
+env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
+    --release_bench BENCH_release.json
+echo "== release demo OK ($DIR)"

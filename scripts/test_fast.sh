@@ -37,5 +37,9 @@ grep -q "fused=True" tests/test_shard_spine.py  # fused-finalize parity too
 # continuous-batching decode suite must ride the fast tier
 [ -f tests/test_serve_pool.py ]
 [ -f tests/test_decode.py ]
+# ISSUE 16 release gate: the canary promote/rollback suite must ride
+# the fast tier (registry states, verdict matrix, crash consistency,
+# poisoned-round containment)
+[ -f tests/test_release.py ]
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

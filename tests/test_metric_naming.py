@@ -159,6 +159,17 @@ EXPECTED = {
     "fedml_serve_decode_shed_total",
     "fedml_serve_decode_occupancy_total",
     "fedml_slo_serve_queue_utilization_ratio",
+    # release gate (serve/release.py): canary offers, verdict outcomes
+    # (rollbacks labeled by the failing signal), shadow tap volume, and
+    # the gauges the canary dashboard reads
+    "fedml_release_canaries_total",
+    "fedml_release_promotions_total",
+    "fedml_release_rollbacks_total",
+    "fedml_release_shadow_requests_total",
+    "fedml_release_shadow_divergence_ratio",
+    "fedml_release_eval_score_value",
+    "fedml_release_cooldown_seconds",
+    "fedml_release_verdict_seconds",
 }
 
 
