@@ -540,6 +540,7 @@ class AsyncFedServerActor(ServerManager):
             log.warning("ignoring duplicate version-%d upload from silo %d",
                         base_version, msg.sender_id)
             return
+        self._note_arrival()  # one wire arrival per (deduped) upload
         delta = msg.get(Message.ARG_MODEL_PARAMS)
         raw_samples = msg.get(Message.ARG_NUM_SAMPLES)
         delta_norm = None
@@ -557,7 +558,8 @@ class AsyncFedServerActor(ServerManager):
                 return
             # screen BEFORE buffering: a poisoned delta must never sit in
             # the buffer waiting to be applied
-            with self._perf_phase("admission"):
+            with self._span("ingest:admission", deterministic=True), \
+                    self._perf_phase("admission"):
                 verdict = self.admission.admit(msg.sender_id, delta,
                                                raw_samples, None,
                                                self.version)
@@ -621,7 +623,8 @@ class AsyncFedServerActor(ServerManager):
             # fold at arrival: the buffer keeps only the metadata tuple
             # (weights/discounts/at-most-once bookkeeping) — the delta's
             # bytes never wait for the version to close
-            with self._perf_phase("fold"):
+            with self._span("ingest:fold", deterministic=True), \
+                    self._perf_phase("fold"):
                 self.stream_agg.fold(delta, num_samples)
             delta = None
             if self.journal is not None:
@@ -629,7 +632,8 @@ class AsyncFedServerActor(ServerManager):
                 # rebuilds the buffer tuple (staleness discount included)
                 state_fn = (self.stream_agg.state_dict
                             if self.stream_agg.method == "mean" else None)
-                with self._perf_phase("journal"):
+                with self._span("ingest:journal", deterministic=True), \
+                        self._perf_phase("journal"):
                     self.journal.note_accept(
                         self.version, msg.sender_id, float(num_samples),
                         extra={"base": int(base_version)},

@@ -332,6 +332,10 @@ class CrossDevice(FedAvg):
             self._c_waves.inc()
             self._h_wave.observe(dt)
             self._perf_phase("wave", dt)
+            if self.perf is not None:
+                # a completed wave is this regime's "upload arrival" on
+                # the round's critical-path timeline
+                self.perf.note_arrival()
             if wave_weight <= 0:
                 # a wave of only weightless clients (all-pad / all-empty
                 # shards): folds as weight 0 — skipped entirely, never a

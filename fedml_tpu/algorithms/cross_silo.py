@@ -992,7 +992,8 @@ class FedAvgServerActor(ServerManager):
 
     def _send_unmask_request(self) -> None:
         from fedml_tpu.secure.protocol import MSG_SECAGG_UNMASK
-        with self._perf_phase("unmask"):
+        with self._span("ingest:unmask", deterministic=True), \
+                self._perf_phase("unmask"):
             survivors, dead = self.secagg.unmask_request()
             if dead:
                 log.warning("round %d: reconstructing %d dead silo(s) %s "
@@ -1009,7 +1010,8 @@ class FedAvgServerActor(ServerManager):
         if msg.get(Message.ARG_ROUND) != self.round_idx \
                 or self._secagg_stage != "unmask":
             return
-        with self._perf_phase("unmask"):
+        with self._span("ingest:unmask", deterministic=True), \
+                self._perf_phase("unmask"):
             complete = self.secagg.note_reveal(msg.sender_id,
                                                msg.get(Message.ARG_SECAGG))
         if complete:
@@ -1133,6 +1135,11 @@ class FedAvgServerActor(ServerManager):
             log.info("ignoring duplicate round-%d upload from silo %d",
                      self.round_idx, msg.sender_id)
             return
+        # one wire arrival per upload frame (shard slices each count —
+        # they are distinct frames): the critical-path observatory's
+        # idle classifier (network → straggler → barrier_wait) keys on
+        # this timeline
+        self._note_arrival()
         if self.shard_wire is not None:
             self._on_shard_upload(msg)
             return
@@ -1177,7 +1184,12 @@ class FedAvgServerActor(ServerManager):
             return
         if self.decode_upload is not None:
             try:
-                upload = self.decode_upload(upload, self.params)
+                # the codec decode is its own micro-span AND perf phase
+                # (ISSUE 17): "is this round decode-bound?" needs the
+                # interval, not a share of an opaque aggregate
+                with self._span("ingest:decode", deterministic=True), \
+                        self._perf_phase("decode"):
+                    upload = self.decode_upload(upload, self.params)
             except Exception:  # noqa: BLE001 — damaged compressed frame
                 if self.admission is None:
                     raise  # legacy fail-loudly contract
@@ -1194,7 +1206,8 @@ class FedAvgServerActor(ServerManager):
         entry = (upload, msg.get(Message.ARG_NUM_SAMPLES))
         upload_norm = None
         if self.admission is not None:
-            with self._perf_phase("admission"):
+            with self._span("ingest:admission", deterministic=True), \
+                    self._perf_phase("admission"):
                 verdict = self.admission.admit(
                     msg.sender_id, upload, msg.get(Message.ARG_NUM_SAMPLES),
                     self.params, self.round_idx)
@@ -1241,7 +1254,8 @@ class FedAvgServerActor(ServerManager):
         if self._first_upload_t is None:
             self._first_upload_t = time.monotonic()
         shard = msg.get(Message.ARG_SHARD)
-        with self._perf_phase("admission"):
+        with self._span("ingest:admission", deterministic=True), \
+                self._perf_phase("admission"):
             if shard is None:
                 log.warning("round %d: silo %d sent a whole-model "
                             "upload on the sharded wire; rejecting as "
@@ -1303,7 +1317,8 @@ class FedAvgServerActor(ServerManager):
             # survives per silo
             from fedml_tpu.secure.protocol import SecAggError
             try:
-                with self._perf_phase("fold"):
+                with self._span("ingest:fold", deterministic=True), \
+                        self._perf_phase("fold"):
                     self.secagg.fold(silo, entry[0], entry[1])
             except SecAggError as e:
                 # an upload from outside the fixed roster (e.g. a silo
@@ -1316,12 +1331,14 @@ class FedAvgServerActor(ServerManager):
                 if self.journal is not None:
                     # metadata only — a masked fold never snapshots
                     # (the round is journalled abort-only)
-                    with self._perf_phase("journal"):
+                    with self._span("ingest:journal", deterministic=True), \
+                            self._perf_phase("journal"):
                         self.journal.note_accept(self.round_idx, silo,
                                                  float(entry[1]))
                 entry = (self._STAGED, entry[1])
         elif entry is not None and self.stream_agg is not None:
-            with self._perf_phase("fold"):
+            with self._span("ingest:fold", deterministic=True), \
+                    self._perf_phase("fold"):
                 if self.shard_wire is not None:
                     # the admitted silo's S slices fold per shard —
                     # each shard's device touches only its O(model/S)
@@ -1336,13 +1353,15 @@ class FedAvgServerActor(ServerManager):
                 # rounds)
                 state_fn = (self.stream_agg.state_dict
                             if self.stream_agg.method == "mean" else None)
-                with self._perf_phase("journal"):
+                with self._span("ingest:journal", deterministic=True), \
+                        self._perf_phase("journal"):
                     self.journal.note_accept(self.round_idx, silo,
                                              float(entry[1]),
                                              state_fn=state_fn)
             entry = (self._STAGED, entry[1])
         elif entry is not None and self._staging_active():
-            with self._perf_phase("staging"):
+            with self._span("ingest:fold", deterministic=True), \
+                    self._perf_phase("staging"):
                 self._stage(silo, entry[0])
             entry = (self._STAGED, entry[1])
         elif entry is None and self.journal is not None:

@@ -15,7 +15,6 @@ actors exist only for host-edge (cross-silo gRPC / device) deployments.
 from __future__ import annotations
 
 import abc
-import contextlib
 import logging
 import threading
 from typing import Callable, Dict
@@ -110,17 +109,19 @@ class NodeManager(abc.ABC):
             "fedml_wire_fanout_total")
 
     def _span(self, name: str, **kw):
-        """A span context-manager on this node's track, or a null context
-        when tracing is disabled — call sites stay single-path."""
+        """A span context-manager on this node's track, or the SHARED
+        null context when tracing is disabled — call sites stay
+        single-path and the disabled branch allocates nothing (the
+        zero-allocation pin in tests/test_critical_path.py)."""
         if self._tracer is None:
-            return contextlib.nullcontext()
+            return trace.NULL_CONTEXT
         return self._tracer.span(name, node=self.node_id, **kw)
 
     def _root_span(self, name: str, hint: str = "", **kw):
         """Like `_span` but starts a NEW trace (ignores any active span)
         — for the spans that root a round/version/re-task tree."""
         if self._tracer is None:
-            return contextlib.nullcontext()
+            return trace.NULL_CONTEXT
         return self._tracer.span(
             name, parent=None, node=self.node_id,
             trace_id=self._tracer.new_trace_id(hint or name), **kw)
@@ -200,7 +201,14 @@ class ServerManager(NodeManager):
     perf = None
 
     def _perf_phase(self, name: str):
-        """Flight-recorder phase span (null context when no recorder)."""
+        """Flight-recorder phase span (the shared null context when no
+        recorder — one branch, zero allocations)."""
         if self.perf is not None:
             return self.perf.phase(name)
-        return contextlib.nullcontext()
+        return trace.NULL_CONTEXT
+
+    def _note_arrival(self) -> None:
+        """Stamp one upload arrival on the round's critical-path
+        timeline (one branch when the recorder is off)."""
+        if self.perf is not None:
+            self.perf.note_arrival()

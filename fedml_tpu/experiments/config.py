@@ -342,6 +342,9 @@ class ExperimentConfig:
     #                                      run_dir/telemetry.{json,prom}
     prom_port: int = 0                   # >0: serve live Prometheus text at
     #                                      :port/metrics (implies telemetry)
+    metrics_port: int = 0                # alias for --prom_port (obs naming;
+    #                                      setting BOTH to different ports is
+    #                                      a config error, not a silent pick)
     perf: bool = False                   # performance flight recorder
     #                                      (obs/perf.py): one perf.jsonl
     #                                      ledger line per round/version —

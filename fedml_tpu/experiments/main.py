@@ -2109,6 +2109,12 @@ def main(argv=None) -> Dict[str, Any]:
         raise ValueError(
             f"--serve_best_effort_headroom must be in (0, 1], got "
             f"{cfg.serve_best_effort_headroom}")
+    if cfg.metrics_port > 0 and cfg.prom_port > 0 \
+            and cfg.metrics_port != cfg.prom_port:
+        raise ValueError(
+            f"--metrics_port is an alias for --prom_port; got both, "
+            f"disagreeing ({cfg.metrics_port} vs {cfg.prom_port}) — "
+            f"pass one, or the same port for both.")
     # release gate (serve/release.py): gates the serve-while-train
     # publish hook, so without a frontend the flag would silently train
     # ungated while the run is labeled canary-protected
@@ -2169,14 +2175,16 @@ def main(argv=None) -> Dict[str, Any]:
     # telemetry snapshot and whatever spans were recorded
     from fedml_tpu.obs import telemetry as _telemetry, trace as _trace
     registry = prom_server = tracer = None
-    if cfg.telemetry or cfg.prom_port > 0:
+    scrape_port = cfg.metrics_port or cfg.prom_port  # gate above pins
+    # any disagreement, so first-nonzero is an alias pick, not a choice
+    if cfg.telemetry or scrape_port > 0:
         registry = _telemetry.enable()
-        if cfg.prom_port > 0:
-            prom_server = _telemetry.start_http_server(cfg.prom_port,
+        if scrape_port > 0:
+            prom_server = _telemetry.start_http_server(scrape_port,
                                                        registry)
             if prom_server is not None:  # bind failure warned + returned None
                 logger.info("telemetry: serving /metrics on :%d",
-                            cfg.prom_port)
+                            scrape_port)
     if cfg.trace_dir:
         tracer = _trace.enable(node=f"node{cfg.node_id}")
 

@@ -48,6 +48,7 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from fedml_tpu.obs import critical_path as _cpath
 from fedml_tpu.obs import telemetry
 from fedml_tpu.obs.health import HEALTH_SLOS
 from fedml_tpu.utils.journal import durable_append
@@ -84,7 +85,11 @@ PHASES = ("broadcast_serialize", "straggler_wait", "staging", "fold",
           # gate never compares a sharded round against a replicated
           # baseline under one name; fold/admission/journal phases are
           # shared with the replicated path
-          "shard_finalize")
+          "shard_finalize",
+          # ingest observatory (obs/critical_path.py): per-upload codec
+          # decode on the server receive path — its own label so the
+          # attribution sweep can separate wire-format cost from fold
+          "decode")
 
 
 # ---------------------------------------------------------------------------
@@ -362,6 +367,12 @@ class PerfRecorder:
         self._h_phase: Dict[str, object] = {}
         self._closed = False
         self._ledger_disabled = False
+        # round critical-path observatory (obs/critical_path.py): armed
+        # per round in round_start, reduced into the line's
+        # ``critical_path`` record at round_end — every ledger line
+        # carries one, on every algorithm that rides this recorder
+        self.cpath: Optional[_cpath.RoundCriticalPath] = None
+        self._ingest = _cpath.IngestGauges(reg)
 
     # -- registration --------------------------------------------------------
     def register_jit(self, name: str, fn) -> bool:
@@ -404,6 +415,7 @@ class PerfRecorder:
             self._phases = {}
         self._round = round_idx
         self._round_t0 = time.perf_counter()
+        self.cpath = _cpath.RoundCriticalPath(t0=self._round_t0)
         self.rss.reset_peak()
         self._wire0 = self._wire_totals()
         if self.device is not None:
@@ -418,6 +430,20 @@ class PerfRecorder:
     def add_phase(self, name: str, seconds: float) -> None:
         with self._lock:
             self._phases[name] = self._phases.get(name, 0.0) + float(seconds)
+        # every caller follows the measure-then-add idiom (the sample
+        # ENDED now), so the critical-path accumulator gets an honest
+        # ``[now - seconds, now)`` interval for the overlap sweep
+        cp = self.cpath
+        if cp is not None:
+            cp.note(name, float(seconds))
+
+    def note_arrival(self) -> None:
+        """One upload landed off the wire (receive-path handlers call
+        this): stamps the critical-path arrival timeline that classifies
+        the round's idle time into network/straggler/barrier_wait."""
+        cp = self.cpath
+        if cp is not None:
+            cp.note_arrival()
 
     def round_end(self, round_idx, **extra) -> Optional[dict]:
         """Close the round: sentry check, RSS watermark, wire deltas,
@@ -459,6 +485,17 @@ class PerfRecorder:
         if self.device is not None:
             line["device"] = self.device.round_snapshot(round_s)
         line.update(extra)
+        cp, self.cpath = self.cpath, None
+        if cp is not None:
+            # known compile wall time (device observatory's per-round
+            # compile ledger) is carved into the ``compile`` bucket
+            compile_s = sum(
+                float(e.get("wall_s") or 0.0)
+                for e in (line.get("device") or {}).get("compiles") or ()
+                if isinstance(e, dict))
+            record = cp.finalize(duration=round_s, compile_s=compile_s)
+            line["critical_path"] = record
+            self._ingest.export(record, line["wire"]["bytes_in"])
         self._write(line)
         self._c_rounds.inc()
         if rss_peak is not None:

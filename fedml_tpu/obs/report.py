@@ -248,6 +248,48 @@ def _device_lines(rows: List[dict]) -> List[str]:
     return out
 
 
+# -- critical-path section ---------------------------------------------------
+
+
+def _critical_path_lines(rows: List[dict]) -> List[str]:
+    """Per-round binding-constraint table from the perf ledger's
+    ``critical_path`` records (obs/critical_path.py): what the round was
+    actually waiting on, the wall-clock attribution shares, coverage,
+    and the fold-overlap ratio — plus a summary naming the dominant
+    constraint across the run."""
+    out = ["  " + "  ".join(
+        [f"{'round':>6s}", f"{'binding':>12s}", f"{'uploads':>7s}",
+         f"{'coverage':>8s}", f"{'fold_ovl':>8s}",
+         "attribution (top shares)"])]
+    tally: dict = {}
+    for r in rows:
+        cp = r.get("critical_path")
+        if not isinstance(cp, dict):
+            continue
+        binding = str(cp.get("binding", "?"))
+        tally[binding] = tally.get(binding, 0) + 1
+        attr = cp.get("attribution") or {}
+        round_s = cp.get("round_s") or 0.0
+        top = sorted(attr.items(), key=lambda kv: -kv[1])[:3]
+        shares = "  ".join(
+            f"{k}={v * 1e3:.1f}ms"
+            + (f" ({v / round_s:.0%})" if round_s else "")
+            for k, v in top)
+        ovl = cp.get("fold_overlap_ratio")
+        out.append("  " + "  ".join(
+            [f"{str(r.get('round', '?')):>6s}", f"{binding:>12s}",
+             f"{cp.get('uploads', 0):>7d}",
+             f"{cp.get('coverage', 0.0):8.3f}",
+             f"{ovl:8.2f}" if isinstance(ovl, (int, float))
+             else f"{'-':>8s}", shares]))
+    if tally:
+        dominant = max(tally.items(), key=lambda kv: kv[1])
+        out.append(f"  binding constraint: {dominant[0]} in "
+                   f"{dominant[1]}/{sum(tally.values())} round(s) "
+                   f"({', '.join(f'{k}={v}' for k, v in sorted(tally.items()))})")
+    return out
+
+
 # -- health ledger section ---------------------------------------------------
 
 
@@ -385,6 +427,10 @@ def render_report(run_dir: Optional[str] = None,
     if perf_rows:
         out += ["", "-- perf ledger (perf.jsonl, phase ms) " + "-" * 25]
         out += _perf_lines(perf_rows)
+        if any(isinstance(r.get("critical_path"), dict) for r in perf_rows):
+            out += ["", "-- critical path (perf.jsonl critical_path "
+                        "section) " + "-" * 15]
+            out += _critical_path_lines(perf_rows)
         if any(isinstance(r.get("device"), dict) for r in perf_rows):
             out += ["", "-- device observatory (perf.jsonl device "
                         "section) " + "-" * 17]

@@ -344,6 +344,16 @@ def start_http_server(port: int, registry=None, host: str = ""):
     import http.server
 
     reg = registry if registry is not None else get_registry()
+    if isinstance(reg, NullRegistry):
+        # fail loud, not silent: a scrape endpoint over the Null registry
+        # would serve an empty exposition forever and every dashboard
+        # would read "healthy, no traffic" — the exact lie --metrics_port
+        # exists to prevent.  Callers must enable() first.
+        raise ValueError(
+            "start_http_server needs a live telemetry registry, but "
+            "telemetry is disabled (NullRegistry): call "
+            "telemetry.enable() first (--telemetry / --metrics_port "
+            "imply it in the experiment runner)")
 
     class _Handler(http.server.BaseHTTPRequestHandler):
         # socket read timeout (StreamRequestHandler applies it to the

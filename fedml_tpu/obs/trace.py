@@ -40,6 +40,12 @@ from typing import Optional
 # so this module stays import-cycle-free (stdlib only).
 CTX_KEY = "_trace"
 
+# the ONE null context instrumented call sites reuse when tracing is
+# disabled: nullcontext is reentrant and stateless, so sharing a single
+# instance makes the disabled path literally allocation-free (the
+# zero-allocation pin in tests/test_critical_path.py holds it to that)
+NULL_CONTEXT = contextlib.nullcontext()
+
 _USE_CURRENT = object()  # start_span default: parent = the active span
 _tracer_ids = itertools.count()
 
@@ -172,6 +178,26 @@ class SpanTracer:
         finally:
             stack.pop()
             sp.end()
+
+    def record_span(self, name: str, dur_s: float,
+                    t0: Optional[float] = None, parent=None,
+                    trace_id: Optional[str] = None, node=None,
+                    **args) -> None:
+        """Record an already-finished span retroactively: the hot-path
+        form for schedulers that know a phase's duration only after it
+        ran (serve queue wait, batch execution, decode steps) — one call
+        per event, no context-manager entry on the critical path.
+        ``t0`` defaults to ``now - dur_s`` on this tracer's clock; pass
+        a Span/SpanContext as ``parent`` to hang it under a request."""
+        if isinstance(parent, Span):
+            parent = parent.context
+        if t0 is None:
+            t0 = self._clock() - dur_s
+        sp = self.start_span(name, parent=parent, trace_id=trace_id,
+                             node=node, **args)
+        sp.t0 = t0
+        sp._ended = True
+        self._record(sp, dur_s)
 
     def _record(self, span: Span, dur_s: float) -> None:
         rec = {"name": span.name, "trace_id": span.trace_id,

@@ -154,6 +154,14 @@ def validate_ledger(rows: List[dict]) -> List[str]:
             problems.append(f"line {i + 1}: rss without peak_bytes")
         if "device" in row and row["device"] is not None:
             problems += _validate_device_section(row["device"], i + 1)
+        if "critical_path" in row and row["critical_path"] is not None:
+            # the ingest observatory's per-round record (ISSUE 17) —
+            # optional, so pre-observatory ledgers keep validating, but
+            # where present its binding must name a known constraint and
+            # its attribution must agree with its coverage claim
+            from fedml_tpu.obs import critical_path as _cpath
+            problems += _cpath.validate_record(
+                row["critical_path"], path=f"line {i + 1}: critical_path")
     return problems
 
 
@@ -345,6 +353,78 @@ def validate_release_bench(obj: dict,
     return problems
 
 
+def validate_ingest_bench(obj: dict,
+                          allow_smoke: bool = True) -> List[str]:
+    """Schema + honesty check for ``BENCH_ingest.json`` v1 (ISSUE 17):
+    the round critical-path observatory's committed artifact.  The bench
+    SCRIPT enforces the numeric gates at measurement time; this
+    validates an artifact still carries PASSING verdicts — and
+    re-derives the claims a regenerated artifact must never lose: every
+    round of every traffic arm carries a well-formed ``critical_path``
+    record whose attribution covers >= 95%% of the round's wall clock,
+    zero recompiles after warmup with tracing enabled, and a green
+    disabled-mode overhead pin.  ``allow_smoke=False`` (the
+    committed-trend-line mode — ``perf_trend.py --ingest_bench``)
+    rejects smoke-labeled artifacts outright."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return ["ingest bench is not a JSON object"]
+    if obj.get("bench") != "ingest":
+        problems.append(f"bench != 'ingest' (got {obj.get('bench')!r})")
+    if obj.get("version") != 1:
+        problems.append(f"version != 1 (got {obj.get('version')!r})")
+    if obj.get("smoke") and not allow_smoke:
+        problems.append("smoke-labeled artifact on the committed trend "
+                        "line (smoke runs carry relaxed scale and belong "
+                        "in /tmp, never committed)")
+    arms = obj.get("arms")
+    if not isinstance(arms, dict) or not arms:
+        return problems + ["no arms section"]
+    for name in ("cross_silo", "cross_device", "sharded", "secagg",
+                 "disabled_pin"):
+        if name not in arms:
+            problems.append(f"missing required arm {name!r}")
+    from fedml_tpu.obs import critical_path as _cpath
+    for name, arm in arms.items():
+        if not isinstance(arm, dict):
+            problems.append(f"arm {name!r} is not an object")
+            continue
+        if arm.get("backend") not in ("cpu", "gpu", "tpu"):
+            problems.append(f"arm {name!r}: no honest backend label "
+                            f"(got {arm.get('backend')!r})")
+        gates = arm.get("gates")
+        if not isinstance(gates, dict) or not gates:
+            problems.append(f"arm {name!r}: no recorded gate verdicts")
+            continue
+        for gname, verdict in gates.items():
+            if not isinstance(verdict, dict) or "ok" not in verdict:
+                problems.append(f"arm {name!r}: gate {gname!r} without "
+                                f"an ok verdict")
+            elif not verdict["ok"]:
+                problems.append(f"arm {name!r}: gate {gname!r} FAILED "
+                                f"({verdict})")
+        if name == "disabled_pin":
+            continue   # the pin arm runs no rounds
+        rounds = arm.get("rounds")
+        if not isinstance(rounds, list) or not rounds:
+            problems.append(f"arm {name!r}: no per-round critical_path "
+                            f"records")
+            continue
+        for i, rec in enumerate(rounds):
+            problems += _cpath.validate_record(
+                rec, path=f"arm {name!r} round {i}")
+            cov = rec.get("coverage") if isinstance(rec, dict) else None
+            if isinstance(cov, (int, float)) and cov < 0.95:
+                problems.append(f"arm {name!r} round {i}: attribution "
+                                f"covers {cov:.0%} of the round wall "
+                                f"clock (< 95%)")
+        if arm.get("recompiles_after_warmup", 0) != 0:
+            problems.append(f"arm {name!r}: "
+                            f"{arm['recompiles_after_warmup']} recompiles "
+                            f"after warmup with tracing enabled")
+    return problems
+
+
 def phase_medians(rows: List[dict],
                   skip_first: bool = True) -> Dict[str, float]:
     """Median per-phase seconds across the ledger (plus ``round_s``).
@@ -510,13 +590,21 @@ def main(argv=None) -> int:
                         "present, honest backend labels, recorded gate "
                         "verdicts all passing, zero responses from the "
                         "poisoned version, zero recompiles after warmup")
+    p.add_argument("--ingest_bench", default=None,
+                   help="BENCH_ingest.json (v1) to validate: every "
+                        "traffic arm present with per-round "
+                        "critical_path records covering >= 95%% of each "
+                        "round, honest backend labels, passing gate "
+                        "verdicts, zero recompiles after warmup, and a "
+                        "green disabled-mode overhead pin")
     args = p.parse_args(argv)
     if args.ledger is None and not args.lint_mfu \
             and args.health_ledger is None and args.serve_bench is None \
-            and args.release_bench is None:
+            and args.release_bench is None and args.ingest_bench is None:
         p.print_usage()
         print("perf_trend: nothing to do (pass --ledger, --health_ledger, "
-              "--serve_bench, --release_bench and/or --lint_mfu)")
+              "--serve_bench, --release_bench, --ingest_bench and/or "
+              "--lint_mfu)")
         return 2
 
     failures: List[str] = []
@@ -634,6 +722,24 @@ def main(argv=None) -> int:
                   f"({pipe.get('promotions')} promotions, poisoned "
                   f"v{pipe.get('poisoned_version')} contained, p99 "
                   f"{pipe.get('latency_ms', {}).get('p99')}ms)")
+
+    if args.ingest_bench is not None:
+        try:
+            with open(args.ingest_bench) as f:
+                ingest_obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"perf_trend: cannot read ingest bench: {e}")
+            return 2
+        # committed-trend-line mode: a smoke artifact must not anchor it
+        problems = validate_ingest_bench(ingest_obj, allow_smoke=False)
+        failures += [f"ingest bench: {x}" for x in problems]
+        if not problems:
+            arms = ingest_obj.get("arms", {})
+            bindings = sorted({r.get("binding")
+                               for a in arms.values()
+                               for r in (a.get("rounds") or [])})
+            print(f"ingest bench: {len(arms)} arm(s) green "
+                  f"(bindings seen: {bindings})")
 
     if args.lint_mfu:
         paths = _expand(args.lint_mfu)

@@ -43,7 +43,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from fedml_tpu.obs import telemetry
+from fedml_tpu.obs import telemetry, trace
 
 log = logging.getLogger(__name__)
 
@@ -193,15 +193,17 @@ def _settle(fut: Future, result=None, exc=None) -> None:
 
 
 class _Request:
-    __slots__ = ("x", "deadline", "enq_t", "future", "tier")
+    __slots__ = ("x", "deadline", "enq_t", "future", "tier", "ctx")
 
     def __init__(self, x, deadline: Optional[float], enq_t: float,
-                 future: Future, tier: str = "interactive"):
+                 future: Future, tier: str = "interactive", ctx=None):
         self.x = x
         self.deadline = deadline
         self.enq_t = enq_t
         self.future = future
         self.tier = tier
+        self.ctx = ctx   # the submitter's span context (serve_request),
+        #                  so queue-wait spans hang under their request
 
 
 class MicroBatcher:
@@ -243,6 +245,9 @@ class MicroBatcher:
         self.default_deadline_s = default_deadline_s
         self.worker = worker
         self.shadow = shadow
+        # captured once (the actor idiom): the hot paths pay exactly one
+        # `is None` branch per event when tracing is disabled
+        self._tracer = trace.get_tracer()
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._stopped = False      # rejects new submits
         self._drain = True         # False: fail queued requests on stop
@@ -301,8 +306,10 @@ class MicroBatcher:
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         now = time.monotonic()
+        ctx = (self._tracer.current_context()
+               if self._tracer is not None else None)
         req = _Request(x, None if deadline_s is None else now + deadline_s,
-                       now, Future(), tier)
+                       now, Future(), tier, ctx)
         with self._admit_lock:
             if self._stopped:
                 raise self._shed("shutdown", tier)
@@ -498,7 +505,8 @@ class MicroBatcher:
                 rows = np.concatenate([rows, pad])
             t0 = time.perf_counter()
             out = np.asarray(snapshot.apply_fn(snapshot.params, rows))
-            self._h_predict.observe(time.perf_counter() - t0)
+            pred_s = time.perf_counter() - t0
+            self._h_predict.observe(pred_s)
         except Exception as e:  # noqa: BLE001 — bad payload/model: fail
             # the batch's requests, never the worker thread
             log.exception("batch of %d failed", len(live))
@@ -510,6 +518,17 @@ class MicroBatcher:
             # batch applied cleanly, so its shape IS the model's
         self._c_batches.inc()
         self._h_occupancy.observe(len(live))
+        if self._tracer is not None:
+            # retroactive spans off the hot path: one batch-execution
+            # span, plus each request's queue wait hung under ITS
+            # serve_request span (enq_t/now are monotonic — only the
+            # DURATION crosses clocks)
+            self._tracer.record_span("serve_batch", pred_s,
+                                     size=len(live), bucket=bucket,
+                                     version=snapshot.version)
+            for r in live:
+                self._tracer.record_span("serve_queue", now - r.enq_t,
+                                         parent=r.ctx, tier=r.tier)
         done = time.monotonic()
         for i, r in enumerate(live):
             if r.deadline is not None and done > r.deadline:

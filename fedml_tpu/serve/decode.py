@@ -51,7 +51,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from fedml_tpu.obs import telemetry
+from fedml_tpu.obs import telemetry, trace
 from fedml_tpu.serve.batcher import (SHED_REASONS, TIERS, ShedError,
                                      TierAdmission, _settle,
                                      best_effort_cap)
@@ -74,10 +74,10 @@ class DecodeResult:
 
 class _DecodeRequest:
     __slots__ = ("prompt", "max_new", "deadline", "enq_t", "future",
-                 "tier", "capped")
+                 "tier", "capped", "ctx")
 
     def __init__(self, prompt, max_new, deadline, enq_t, future, tier,
-                 capped=False):
+                 capped=False, ctx=None):
         self.prompt = prompt
         self.max_new = max_new
         self.deadline = deadline
@@ -86,6 +86,7 @@ class _DecodeRequest:
         self.tier = tier
         self.capped = capped   # max_new was cut at admission to fit the
         #                        cache bucket: the result is `truncated`
+        self.ctx = ctx         # submitter's span context, if any
 
 
 class _Slot:
@@ -142,6 +143,9 @@ class DecodeScheduler:
         self.continuous = continuous
         self.default_deadline_s = default_deadline_s
         self.worker = worker
+        # captured once (the actor idiom): disabled tracing pays one
+        # `is None` branch per step/finish, no lookups on the hot loop
+        self._tracer = trace.get_tracer()
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._slots: List[Optional[_Slot]] = [None] * slots
         self._snapshot = None           # pinned ServedModel
@@ -261,10 +265,12 @@ class DecodeScheduler:
         if deadline_s is None:
             deadline_s = self.default_deadline_s
         now = time.monotonic()
+        ctx = (self._tracer.current_context()
+               if self._tracer is not None else None)
         req = _DecodeRequest(
             prompt, max_new,
             None if deadline_s is None else now + deadline_s,
-            now, Future(), tier, capped)
+            now, Future(), tier, capped, ctx)
         with self._admit_lock:
             if self._stopped:
                 raise self._shed("shutdown", tier)
@@ -402,6 +408,13 @@ class DecodeScheduler:
         self._slots[i] = None
         done = time.monotonic()
         self._h_request.observe(done - slot.req.enq_t)
+        if self._tracer is not None:
+            # one retroactive span per finished sequence, hung under
+            # the submitter's request span when it carried one
+            self._tracer.record_span(
+                "serve_decode", done - slot.req.enq_t,
+                parent=slot.req.ctx, tokens=len(slot.generated),
+                version=self._snapshot.version, truncated=truncated)
         _settle(slot.req.future,
                 DecodeResult(slot.generated, self._snapshot.version,
                              truncated))
@@ -417,9 +430,14 @@ class DecodeScheduler:
             tokens[i] = s.next_token()
             positions[i] = s.pos
         self._ensure_cache()
+        t0 = time.perf_counter()
         out, self._cache = self._step_fn(self._params_dev, self._cache,
                                          tokens, positions)
         out = np.asarray(out)
+        if self._tracer is not None:
+            self._tracer.record_span("decode_step",
+                                     time.perf_counter() - t0,
+                                     live=len(live_idx))
         self.steps += 1
         self.live_steps += len(live_idx)
         self._c_steps.inc()

@@ -39,6 +39,7 @@ import http.server
 import json
 import logging
 import threading
+import time
 from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Optional
 
@@ -219,35 +220,44 @@ def _make_handler(registry: ModelRegistry, batcher: MicroBatcher,
             except (ValueError, KeyError, TypeError) as e:
                 self._reply(400, {"error": "bad_request", "detail": str(e)})
                 return
+            # the context-manager form makes the request span the
+            # thread's CURRENT span, so the batcher's submit sees it and
+            # the queue/batch/respond spans hang under this request
             tracer = trace.get_tracer()
-            span = (tracer.start_span("serve_request", parent=None,
-                                      version=registry.version)
-                    if tracer is not None else None)
-            try:
-                result = batcher.predict(x, deadline_s=deadline_s,
-                                         tier=tier)
-                self._reply(200, {"y": np.asarray(result.y).tolist(),
-                                  "version": result.version})
-            except ShedError as e:
-                self._reply(503 if e.reason == "no_model" else 429,
-                            {"error": "shed", "reason": e.reason,
-                             "tier": tier})
-            except FuturesTimeout:
-                # the batcher never answered: a server-side stall, not a
-                # client error — 503 so LBs retry/fail over instead of
-                # blaming the request
-                self._reply(503, {"error": "timeout"})
-            except BadInstanceError as e:
-                # the one prediction failure that IS the client's fault
-                self._reply(400, {"error": "bad_instance",
-                                  "detail": str(e)})
-            except Exception as e:  # noqa: BLE001 — model/params fault:
-                # a 4xx here would stop LBs retrying a broken instance
-                self._reply(500, {"error": "predict_failed",
-                                  "detail": str(e)})
-            finally:
-                if span is not None:
-                    span.end()
+            ctx = (tracer.span("serve_request", parent=None,
+                               version=registry.version)
+                   if tracer is not None else trace.NULL_CONTEXT)
+            with ctx as span:
+                try:
+                    result = batcher.predict(x, deadline_s=deadline_s,
+                                             tier=tier)
+                    t_resp = time.perf_counter()
+                    self._reply(200,
+                                {"y": np.asarray(result.y).tolist(),
+                                 "version": result.version})
+                    if tracer is not None:
+                        tracer.record_span(
+                            "serve_respond",
+                            time.perf_counter() - t_resp, parent=span)
+                except ShedError as e:
+                    self._reply(503 if e.reason == "no_model" else 429,
+                                {"error": "shed", "reason": e.reason,
+                                 "tier": tier})
+                except FuturesTimeout:
+                    # the batcher never answered: a server-side stall,
+                    # not a client error — 503 so LBs retry/fail over
+                    # instead of blaming the request
+                    self._reply(503, {"error": "timeout"})
+                except BadInstanceError as e:
+                    # the one prediction failure that IS the client's
+                    # fault
+                    self._reply(400, {"error": "bad_instance",
+                                      "detail": str(e)})
+                except Exception as e:  # noqa: BLE001 — model/params
+                    # fault: a 4xx here would stop LBs retrying a
+                    # broken instance
+                    self._reply(500, {"error": "predict_failed",
+                                      "detail": str(e)})
 
         def log_message(self, *args):  # no per-request stderr spam
             pass

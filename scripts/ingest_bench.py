@@ -1,0 +1,270 @@
+#!/usr/bin/env python
+"""Round critical-path observatory bench (ISSUE 17): the cost contract
+behind `BENCH_ingest.json`.
+
+Each traffic arm is a fresh subprocess running the REAL federation with
+the full observatory on — ``--trace_dir`` (per-upload ingest spans),
+``--perf --perf_strict`` (ledger + recompile sentry), ``--telemetry``
+(fedml_ingest_* gauges) — and the committed claims are re-derived from
+the run's own artifacts, not summarized by the script:
+
+  * every perf.jsonl round line carries a well-formed ``critical_path``
+    record (obs/critical_path.validate_record), on all four arms;
+  * the record's attribution covers >= 95%% of the round's wall clock
+    (the sweep PARTITIONS the round, so this is ~1.0 by construction —
+    the gate catches a future regression, not noise);
+  * zero recompiles after warmup with tracing enabled, under the strict
+    sentry (tracing must not poison jit caches);
+  * the receive path actually emitted ingest spans into the trace dir
+    (the observatory is on, not silently disabled);
+  * disabled mode retains ZERO bytes and reuses the one module-level
+    null context — the one-branch-per-event contract, pinned in-process
+    with tracemalloc (deterministic, unlike wall-clock thresholds on a
+    shared CPU container).
+
+Any gate failure exits 1 and writes nothing.  CPU-container honest:
+``backend`` is labeled per arm; wall times in the records are advisory
+context — the pinned claims are structural (record shape, coverage,
+recompiles, allocation).
+
+    python scripts/ingest_bench.py             # full arms -> BENCH_ingest.json
+    python scripts/ingest_bench.py --smoke     # relaxed scale, /tmp output
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import tracemalloc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _base_cmd(rounds, run_dir, trace_dir):
+    return [sys.executable, "-m", "fedml_tpu",
+            "--model", "lr", "--dataset", "mnist",
+            "--comm_round", str(rounds),
+            "--frequency_of_the_test", str(rounds),
+            "--batch_size", "4", "--log_stdout", "false",
+            "--perf", "true", "--perf_strict", "true",
+            "--telemetry", "true",
+            "--run_dir", run_dir, "--trace_dir", trace_dir,
+            "--perf_ledger", os.path.join(run_dir, "perf.jsonl")]
+
+
+def arm_cmds(smoke):
+    n = 4 if smoke else 8
+    rounds = 2 if smoke else 4
+    silo = ["--algo", "cross_silo",
+            "--client_num_in_total", str(n),
+            "--client_num_per_round", str(n)]
+    return {
+        # int8 wire codec: the production cross-silo shape, and it puts
+        # the per-upload ingest:decode micro-span on the receive path
+        "cross_silo": (rounds, silo + ["--wire_compression", "int8"]),
+        "cross_device": (rounds, [
+            "--algo", "cross_device",
+            "--client_num_in_total", str(8 * n),
+            "--client_num_per_round", str(4 * n),
+            "--wave_size", str(n)]),
+        "sharded": (rounds, silo + ["--agg_mode", "stream",
+                                    "--model_shards", "2"]),
+        "secagg": (rounds, silo + ["--agg_mode", "stream",
+                                   "--secagg", "pairwise"]),
+    }
+
+
+def run_arm(name, rounds, extra, workdir):
+    import subprocess
+    run_dir = os.path.join(workdir, name)
+    trace_dir = os.path.join(run_dir, "trace")
+    cmd = _base_cmd(rounds, run_dir, trace_dir) + extra
+    print(f"== arm {name}: rounds={rounds}")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=1800)
+    if proc.returncode != 0:
+        raise SystemExit(f"arm {name} failed rc={proc.returncode}:\n"
+                         f"{proc.stderr[-3000:]}")
+
+    from fedml_tpu.obs import critical_path as cpath
+    from fedml_tpu.obs import report
+    ledger = os.path.join(run_dir, "perf.jsonl")
+    rows = [json.loads(l) for l in open(ledger) if l.strip()]
+
+    gates, failures = {}, []
+    records = [r.get("critical_path") for r in rows]
+    present = all(isinstance(r, dict) for r in records)
+    gates["critical_path_on_every_round"] = {
+        "ok": present, "rounds": len(rows)}
+    if not present:
+        failures.append(f"{name}: ledger line(s) without a critical_path "
+                        f"record")
+        return None, failures
+
+    problems = []
+    for i, rec in enumerate(records):
+        problems += cpath.validate_record(rec, path=f"round {i}")
+    gates["record_shape"] = {"ok": not problems, "problems": problems[:5]}
+    if problems:
+        failures.append(f"{name}: malformed critical_path record(s): "
+                        f"{problems[:3]}")
+
+    min_cov = min(r["coverage"] for r in records)
+    gates["coverage"] = {"ok": min_cov >= 0.95, "min": round(min_cov, 4),
+                         "threshold": 0.95}
+    if min_cov < 0.95:
+        failures.append(f"{name}: attribution covers only {min_cov:.0%} "
+                        f"of the round wall clock")
+
+    warm = sum(r.get("recompiles", 0) for r in rows[1:])
+    gates["recompiles_after_warmup"] = {"ok": warm == 0, "count": warm}
+    if warm:
+        failures.append(f"{name}: {warm} recompiles after warmup with "
+                        f"tracing enabled (under --perf_strict)")
+
+    spans = report.load_trace_events(trace_dir)
+    n_ingest = sum(1 for e in spans
+                   if str(e.get("name", "")).startswith("ingest:"))
+    n_recv = sum(1 for e in spans
+                 if str(e.get("name", "")).startswith("recv:"))
+    # cross_device waves fold device-side at wave completion — arrivals
+    # ride the perf recorder, not per-upload receive spans
+    want_spans = name != "cross_device"
+    gates["ingest_spans_emitted"] = {
+        "ok": (n_ingest > 0 and n_recv > 0) or not want_spans,
+        "ingest": n_ingest, "recv": n_recv, "required": want_spans}
+    if want_spans and (n_ingest == 0 or n_recv == 0):
+        failures.append(f"{name}: trace dir carries no per-upload "
+                        f"receive-path spans (ingest={n_ingest}, "
+                        f"recv={n_recv}) — the ingest path ran untraced")
+
+    import jax
+    bindings = sorted({r["binding"] for r in records})
+    print(f"   rounds={len(rows)} min_coverage={min_cov:.3f} "
+          f"recompiles_after_warmup={warm} ingest_spans={n_ingest} "
+          f"bindings={bindings}")
+    arm = {"backend": jax.default_backend(), "rounds": records,
+           "recompiles_after_warmup": warm, "gates": gates,
+           "bindings": bindings, "ingest_spans": n_ingest}
+    return arm, failures
+
+
+def disabled_pin_arm():
+    """The cost contract's other half, measured in THIS process with
+    observability off: the span helpers return the shared null context
+    (identity) and the hot path retains zero bytes."""
+    from fedml_tpu.comm.actors import ServerManager
+    from fedml_tpu.comm.local import LocalHub
+    from fedml_tpu.obs import trace
+
+    failures = []
+    if trace.get_tracer() is not None:
+        return None, ["disabled_pin: a tracer is live in the bench "
+                      "process — the pin needs observability OFF"]
+
+    class Probe(ServerManager):
+        def register_handlers(self):
+            pass
+
+    mgr = Probe(0, LocalHub().transport(0))
+    null_ok = (mgr._span("ingest:fold", deterministic=True)
+               is trace.NULL_CONTEXT
+               and mgr._perf_phase("fold") is trace.NULL_CONTEXT)
+    if not null_ok:
+        failures.append("disabled_pin: span helpers allocate a fresh "
+                        "context with tracing off")
+
+    def hot_path():
+        for _ in range(1000):
+            with mgr._span("ingest:decode", deterministic=True):
+                pass
+            with mgr._perf_phase("decode"):
+                pass
+            mgr._note_arrival()
+
+    import gc
+    # two warm-up passes: the second crosses the interpreter's adaptive
+    # specialization threshold, so the measured pass is steady-state
+    hot_path()
+    hot_path()
+    tracemalloc.start()
+    gc.collect()
+    before = tracemalloc.take_snapshot()
+    hot_path()
+    gc.collect()   # collectible cycles are transients, not retention
+    after = tracemalloc.take_snapshot()
+    tracemalloc.stop()
+    # attribute retained bytes to the observatory's own code: the pin is
+    # about what the disabled span/perf helpers keep, not interpreter
+    # noise elsewhere in a process that just ran four subprocess arms
+    flt = [tracemalloc.Filter(True, "*fedml_tpu*")]
+    stats = after.filter_traces(flt).compare_to(
+        before.filter_traces(flt), "lineno")
+    retained = sum(s.size_diff for s in stats)
+    if retained > 0:
+        failures.append(f"disabled_pin: hot path retained {retained} "
+                        f"bytes with observability off")
+    import jax
+    print(f"== arm disabled_pin: null_context={null_ok} "
+          f"retained_bytes={retained}")
+    arm = {"backend": jax.default_backend(),
+           "gates": {
+               "shared_null_context": {"ok": null_ok},
+               "zero_retained_bytes": {"ok": retained <= 0,
+                                       "bytes": max(retained, 0),
+                                       "events": 3000}}}
+    return arm, failures
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true",
+                   help="relaxed scale; output under /tmp (never the "
+                        "committed artifact)")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    out_path = args.out or (
+        os.path.join(tempfile.gettempdir(), "BENCH_ingest.json")
+        if args.smoke else os.path.join(REPO, "BENCH_ingest.json"))
+    workdir = tempfile.mkdtemp(prefix="ingest_bench.")
+
+    arms, failures = {}, []
+    for name, (rounds, extra) in arm_cmds(args.smoke).items():
+        arm, fails = run_arm(name, rounds, extra, workdir)
+        failures += fails
+        if arm is not None:
+            arms[name] = arm
+    arm, fails = disabled_pin_arm()
+    failures += fails
+    if arm is not None:
+        arms["disabled_pin"] = arm
+
+    artifact = {
+        "bench": "ingest", "version": 1, "smoke": bool(args.smoke),
+        "note": ("1-core-CPU-container run: wall attributions in the "
+                 "records are advisory context; the pinned claims are "
+                 "structural (record on every round, >=95%% coverage, 0 "
+                 "recompiles after warmup with tracing, zero-allocation "
+                 "disabled mode)"),
+        "arms": arms,
+    }
+    from fedml_tpu.obs import trend
+    failures += [f"schema: {x}"
+                 for x in trend.validate_ingest_bench(artifact)]
+    if failures:
+        for f in failures:
+            print(f"FAIL {f}")
+        return 1
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"== ingest bench OK -> {out_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

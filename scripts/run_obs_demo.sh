@@ -212,4 +212,32 @@ echo "stream ledger OK: fold phase recorded, trend gate green"
 # BENCH_shard.json keeps full-bench numbers)
 env JAX_PLATFORMS=cpu python scripts/shard_bench.py --smoke
 echo "shard spine smoke OK: per-device scaling + fused finalize gates green"
+
+echo "== asserting the critical-path observatory (ISSUE 17)"
+# every ledger line of the chaos run carries a critical_path record
+# naming the round's binding constraint, with the attribution
+# partitioning the round's wall clock — and the report renders it
+python - "$RUN/perf.jsonl" <<'EOF'
+import json, sys
+from fedml_tpu.obs import critical_path as cpath
+rows = [json.loads(l) for l in open(sys.argv[1]) if l.strip()]
+assert rows, "no ledger lines"
+for r in rows:
+    cp = r["critical_path"]
+    assert cpath.validate_record(cp, path=f"round {r['round']}") == []
+    assert cp["coverage"] >= 0.95, cp
+bindings = sorted({r["critical_path"]["binding"] for r in rows})
+print(f"critical_path on all {len(rows)} ledger lines; bindings {bindings}")
+EOF
+grep -q "critical path" "$REPORT"
+grep -q "binding constraint" "$REPORT"
+# ingest gauges land beside the rest of the telemetry snapshot
+grep -q "fedml_ingest_uploads_total" "$RUN/telemetry.prom"
+# full cost-contract smoke: four traffic arms + the disabled-mode pin
+# (output to /tmp so the committed BENCH_ingest.json keeps full-bench
+# numbers), then the committed artifact through the trend gate
+env JAX_PLATFORMS=cpu python scripts/ingest_bench.py --smoke
+env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
+    --ingest_bench BENCH_ingest.json
+echo "ingest smoke OK: critical-path records, gauges, and cost gates green"
 echo "== obs demo OK ($DIR)"

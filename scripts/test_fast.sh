@@ -41,5 +41,8 @@ grep -q "fused=True" tests/test_shard_spine.py  # fused-finalize parity too
 # the fast tier (registry states, verdict matrix, crash consistency,
 # poisoned-round containment)
 [ -f tests/test_release.py ]
+# ISSUE 17 critical-path observatory: attribution sweep, binding
+# constraints, disabled-mode zero-allocation pin, ingest-bench schema
+[ -f tests/test_critical_path.py ]
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

@@ -170,6 +170,14 @@ EXPECTED = {
     "fedml_release_eval_score_value",
     "fedml_release_cooldown_seconds",
     "fedml_release_verdict_seconds",
+    # PR 17: the round critical-path observatory (obs/critical_path.py):
+    # wire ingest rate, fold-overlap ratio (aggregation hidden behind
+    # the network), per-constraint utilization share of the round, and
+    # the per-round upload count the attribution sweep saw
+    "fedml_ingest_bytes_per_second_value",
+    "fedml_ingest_fold_overlap_ratio",
+    "fedml_ingest_phase_utilization_ratio",
+    "fedml_ingest_uploads_total",
 }
 
 

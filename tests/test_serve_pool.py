@@ -194,6 +194,45 @@ def test_pool_worker_labeled_telemetry():
         telemetry.disable()
 
 
+def test_pool_workers_land_on_one_metrics_scrape():
+    """--metrics_port exposes EVERY pool worker on a single scrape:
+    the workers are threads over one process registry, so one exposition
+    carries each worker's labeled series side by side."""
+    telemetry.enable()
+    server = None
+    try:
+        registry, pool = _pool(workers=2)
+        pool.start()
+        for i in range(8):
+            _post(pool.port, {"x": _probe_x().tolist()})
+        server = telemetry.start_http_server(0, host="127.0.0.1")
+        assert server is not None
+        port = server.server_address[1]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/metrics")
+        text = conn.getresponse().read().decode()
+        conn.close()
+        workers_seen = {w for w in ("0", "1")
+                        if f'fedml_serve_requests_total{{worker="{w}"}}'
+                        in text}
+        assert workers_seen == {"0", "1"}, \
+            f"one scrape must carry every worker, saw {workers_seen}"
+        pool.stop()
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        telemetry.disable()
+
+
+def test_metrics_endpoint_fails_loud_when_telemetry_disabled():
+    """start_http_server over the Null registry would serve an empty
+    exposition forever — it must raise, not lie."""
+    assert telemetry.get_registry().__class__.__name__ == "NullRegistry"
+    with pytest.raises(ValueError, match="telemetry is disabled"):
+        telemetry.start_http_server(0, host="127.0.0.1")
+
+
 # -- tiered admission + SLO coupling ----------------------------------------
 
 def test_best_effort_sheds_at_soft_watermark_interactive_keeps_reserve():
