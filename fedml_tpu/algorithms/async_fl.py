@@ -100,7 +100,8 @@ class AsyncFedServerActor(ServerManager):
                  health=None,
                  extra_state: Optional[tuple] = None,
                  journal=None,
-                 faultline=None):
+                 faultline=None,
+                 server_opt=None):
         """``checkpointer``: a `RoundCheckpointer`; every applied version
         is saved per its ``save_every`` gating and ``start()`` resumes
         from the latest saved version — a crashed async server restarts
@@ -232,6 +233,12 @@ class AsyncFedServerActor(ServerManager):
                 "buffer has no incremental fold state to snapshot")
         self.journal = journal
         self.faultline = faultline
+        # the server-optimizer seam (ISSUE 18), staleness-aware: the
+        # buffer's discounted mean delta becomes the pseudo-gradient
+        # (Δ = −davg·mean_delta), so stale buffers move the moments
+        # LESS — the discount scales the gradient, never the state
+        # dynamics.  None keeps the legacy host-f64 apply bit-exactly.
+        self.server_opt = server_opt
         if health is not None:
             # no per-version barrier set exists — the silo universe is
             # the fairness denominator from version 0.  The starvation
@@ -458,9 +465,16 @@ class AsyncFedServerActor(ServerManager):
         if self.journal is None:
             return
         from fedml_tpu.utils.journal import tree_crc
+        srvopt = ""
+        if self.server_opt is not None and self.server_opt.name != "plain":
+            # a non-plain server optimizer tags the journal mode: a
+            # resumed fold replayed into a run that would apply a
+            # DIFFERENT server step silently changes the version's update
+            srvopt = f"+srvopt={self.server_opt.name}"
         with self._perf_phase("journal"):
             self.journal.round_start(
-                self.version, mode=f"stream_{self.stream_agg.method}",
+                self.version,
+                mode=f"stream_{self.stream_agg.method}{srvopt}",
                 resumable=self.stream_agg.method == "mean",
                 global_crc=tree_crc(self._host_params()))
 
@@ -739,6 +753,20 @@ class AsyncFedServerActor(ServerManager):
                 # two modes' bit-identity cannot silently fork
                 davg = float((discounts * samples).sum()
                              / max(samples.sum(), 1e-12))
+                if self.server_opt is not None \
+                        and self.server_opt.name != "plain":
+                    # server-optimizer seam: Δ = −davg·d (the descent
+                    # convention — w − lr·Δ recovers the legacy
+                    # w + lr·davg·d), formed in host f64 like the
+                    # legacy step, then one jitted optimizer step
+                    pseudo = jax.tree.map(
+                        lambda p, d: np.asarray(
+                            -davg * np.asarray(d, np.float64)).astype(
+                                np.asarray(p).dtype),
+                        self.params, robust)
+                    self.params = self.server_opt.apply_delta(
+                        self.params, pseudo, self.version)
+                    return
                 self.params = jax.tree.map(
                     lambda p, d: (np.asarray(p, np.float64)
                                   + self.server_lr * davg
@@ -838,7 +866,10 @@ class AsyncFedServerActor(ServerManager):
             # the line would make round_s medians swing with eval cadence
             # and trip the trend gate on a non-regression (the sync
             # server closes before its eval hook for the same reason)
-            self.perf.round_end(self.version - 1, buffered=len(silos))
+            vextra = ({"server_opt": self.server_opt.name}
+                      if self.server_opt is not None else {})
+            self.perf.round_end(self.version - 1, buffered=len(silos),
+                                **vextra)
         if self.on_version is not None:
             self.on_version(self.version, self.params)
         if self.version >= self.num_versions:

@@ -102,7 +102,7 @@ class CrossDevice(FedAvg):
 
     def __init__(self, workload, data, config: CrossDeviceConfig,
                  mesh=None, sink=None, perf=None, health=None, slo=None,
-                 publish=None):
+                 publish=None, server_opt=None, controller=None):
         cfg = config
         if cfg.local_alg not in LOCAL_ALGS:
             raise ValueError(f"--local_alg must be one of {LOCAL_ALGS}, "
@@ -158,6 +158,23 @@ class CrossDevice(FedAvg):
         # finalized global as ``publish(params, version)`` — version =
         # round_idx + 1 so a pre-published baseline can hold version 0
         self.publish = publish
+        # the server-optimizer seam (ISSUE 18): the round's finalize
+        # (post local_alg transform — fednova's tau_eff step defines the
+        # round's effective mean) becomes the pseudo-gradient the
+        # optimizer steps on.  None keeps the pre-seam round exactly.
+        if server_opt is not None and cfg.local_alg == "fednova":
+            raise ValueError(
+                "--server_opt with --local_alg fednova is refused: "
+                "fednova's tau_eff step IS a server update; stacking a "
+                "second optimizer on top silently changes its normalized "
+                "averaging semantics")
+        self.server_opt = server_opt
+        if controller is not None and health is None:
+            raise ValueError(
+                "controller (--adaptive) requires the health observatory "
+                "(--health): its decisions are a pure function of the "
+                "per-round drift-alarm line")
+        self.controller = controller
         # seeded wave-summary poisoning, injected PRE-admission — the
         # mega-cohort path's first-class attacker (no per-silo message
         # seam exists inside a compiled wave)
@@ -249,14 +266,21 @@ class CrossDevice(FedAvg):
         jax in (seed, round) — so a resumed run re-samples the exact
         cohorts the crashed run would have."""
         cfg = self.cfg
+        per = cfg.client_num_per_round
+        if self.controller is not None:
+            # the adaptive cohort lever is LIVE here: the sampler draws
+            # from the full population and the wave planner pads any
+            # cohort into static-width waves, so widening never retraces
+            # a compiled program (the per-round count itself is ledgered)
+            per = max(1, min(self.controller.cohort,
+                             self.data.client_num))
         if cfg.sampler == "jax":
             key = jax.random.fold_in(
                 jax.random.fold_in(jax.random.key(cfg.seed), 0x5A4D50),
                 round_idx)
             return np.asarray(sample_clients_jax(
-                key, self.data.client_num, cfg.client_num_per_round))
-        return sample_clients(round_idx, self.data.client_num,
-                              cfg.client_num_per_round)
+                key, self.data.client_num, per))
+        return sample_clients(round_idx, self.data.client_num, per)
 
     # -- lazy round machinery -------------------------------------------------
     def _ensure_bound(self, params) -> None:
@@ -425,6 +449,11 @@ class CrossDevice(FedAvg):
                 self.c_global = jax.tree.map(
                     lambda cg, dv: cg + dv / n_total,
                     self.c_global, c_delta_acc)
+            if self.server_opt is not None:
+                # the server-optimizer seam: Δ = params − finalize, one
+                # jitted step (plain returns the finalize untouched)
+                new_params = self.server_opt.apply(params, new_params,
+                                                   round_idx)
         self._c_rounds.inc()
         if self.health is not None:
             self.health.round_end(
@@ -462,10 +491,24 @@ class CrossDevice(FedAvg):
             jax.block_until_ready(params)
             if self.publish is not None:
                 self.publish(params, round_idx + 1)
+            decision = None
+            if self.controller is not None:
+                # the pacing verdict for the NEXT round, from this
+                # round's health line (decided before the checkpoint so
+                # a resume continues the same trajectory)
+                decision = self.controller.decide(
+                    round_idx,
+                    self.health.last_line if self.health is not None
+                    else None)
             round_s = time.time() - t0
             if self.perf is not None:
+                extra = dict(info)
+                if self.server_opt is not None:
+                    extra["server_opt"] = self.server_opt.name
+                if decision is not None:
+                    extra["adapt"] = decision.as_ledger()
                 self.perf.round_end(round_idx, cohort=len(ids),
-                                    wave_size=cfg.wave_size, **info)
+                                    wave_size=cfg.wave_size, **extra)
             if self.slo is not None:
                 self.slo.evaluate()
             if (round_idx % cfg.frequency_of_the_test == 0
@@ -491,22 +534,39 @@ class CrossDevice(FedAvg):
             checkpointer.flush()
         return params
 
-    # -- checkpoint extra state (scaffold control variates) -------------------
+    # -- checkpoint extra state (scaffold control variates, server
+    # optimizer, adaptive controller) -----------------------------------------
     def _extra_state(self) -> Dict[str, Any]:
-        if self.cfg.local_alg != "scaffold" or self.c_global is None:
-            return {}
-        return {"c_global": self.c_global, "c_locals": self.c_locals}
+        out: Dict[str, Any] = {}
+        if self.cfg.local_alg == "scaffold" and self.c_global is not None:
+            out["scaffold"] = {"c_global": self.c_global,
+                               "c_locals": self.c_locals}
+        if self.server_opt is not None:
+            out["srv_opt"] = self.server_opt.state_dict()
+        if self.controller is not None:
+            out["adapt"] = self.controller.state_dict()
+        return out
 
     def _extra_state_template(self, params) -> Dict[str, Any]:
-        if self.cfg.local_alg != "scaffold":
-            return {}
-        return {"c_global": jax.tree.map(jnp.zeros_like, params),
+        out: Dict[str, Any] = {}
+        if self.cfg.local_alg == "scaffold":
+            out["scaffold"] = {
+                "c_global": jax.tree.map(jnp.zeros_like, params),
                 "c_locals": zeros_client_state(
                     jax.tree.map(np.asarray, params),
                     self.data.client_num)}
+        if self.server_opt is not None:
+            out["srv_opt"] = self.server_opt.state_template()
+        if self.controller is not None:
+            out["adapt"] = self.controller.state_dict()
+        return out
 
     def _load_extra_state(self, extra) -> None:
-        if self.cfg.local_alg != "scaffold":
-            return
-        self.c_global = extra["c_global"]
-        self.c_locals = jax.tree.map(np.asarray, extra["c_locals"])
+        if self.cfg.local_alg == "scaffold" and "scaffold" in extra:
+            self.c_global = extra["scaffold"]["c_global"]
+            self.c_locals = jax.tree.map(np.asarray,
+                                         extra["scaffold"]["c_locals"])
+        if self.server_opt is not None and "srv_opt" in extra:
+            self.server_opt.load_state_dict(extra["srv_opt"])
+        if self.controller is not None and "adapt" in extra:
+            self.controller.load_state_dict(extra["adapt"])

@@ -165,7 +165,9 @@ class FedAvgServerActor(ServerManager):
                  secagg=None,
                  journal=None,
                  faultline=None,
-                 shard_wire=None):
+                 shard_wire=None,
+                 server_opt=None,
+                 controller=None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -390,6 +392,25 @@ class FedAvgServerActor(ServerManager):
                 "stack path has no incremental fold state to snapshot")
         self.journal = journal
         self.faultline = faultline
+        # server_opt: a fedml_tpu.server_opt.ServerOptimizer — the round's
+        # finalize output becomes a pseudo-gradient Δ = global − finalize
+        # and the optimizer's one jitted step applies it (None keeps the
+        # pre-seam assignment `self.params = finalize(...)` byte-for-byte)
+        if server_opt is not None and secagg is not None:
+            raise ValueError(
+                "server_opt and secagg are mutually exclusive: the "
+                "masked-sum finalize yields a plain mean by protocol "
+                "construction; there is no seam to re-step it through "
+                "a server optimizer without unmasking intermediate state")
+        self.server_opt = server_opt
+        # controller: a fedml_tpu.server_opt.AdaptiveController — consulted
+        # once per round close on the health observatory's verdict
+        if controller is not None and health is None:
+            raise ValueError(
+                "controller (--adaptive) requires the health observatory "
+                "(--health): its decisions are a pure function of the "
+                "per-round drift-alarm line")
+        self.controller = controller
         self.shard_wire = shard_wire
         if shard_wire is not None:
             if secagg is not None:
@@ -531,9 +552,15 @@ class FedAvgServerActor(ServerManager):
         unflattening foreign fold state into the wrong slots."""
         if self.secagg is not None:
             return "secagg"
+        # a non-plain server optimizer tags the mode: resuming its fold
+        # into a run that would finalize through a DIFFERENT server step
+        # (or none) silently changes the update the replay applies
+        srvopt = ""
+        if self.server_opt is not None and self.server_opt.name != "plain":
+            srvopt = f"+srvopt={self.server_opt.name}"
         if self.shard_wire is not None:
-            return self.shard_wire.journal_mode()
-        return f"stream_{self.stream_agg.method}"
+            return self.shard_wire.journal_mode() + srvopt
+        return f"stream_{self.stream_agg.method}{srvopt}"
 
     def _journal_recovery(self):
         """Inspect the journal for a round the crash left mid-flight.
@@ -607,8 +634,17 @@ class FedAvgServerActor(ServerManager):
     def _sampled(self) -> np.ndarray:
         # deterministic per-round sampling, parity with
         # FedAVGAggregator.client_sampling:89-97 (np.random.seed(round_idx))
+        per = self.client_num_per_round
+        if self.controller is not None:
+            # the adaptive cohort lever, capped at the CONFIGURED cohort:
+            # the local backend constructs exactly client_num_per_round
+            # silo actors, so cross_silo can never task a wider cohort
+            # than exists (the controller ledgers the clamp; cross_device
+            # samples from the full population and genuinely widens)
+            per = min(max(1, self.controller.cohort),
+                      self.client_num_per_round)
         return sample_clients(self.round_idx, self.client_num_in_total,
-                              self.client_num_per_round)
+                              per)
 
     def _host_params(self):
         """The round's host copy of the global, transferred device→host
@@ -1517,13 +1553,14 @@ class FedAvgServerActor(ServerManager):
         with self._span("aggregate", parent=self._round_span,
                         round=self.round_idx, quorum=len(admitted)), \
                 self._perf_phase(agg_phase):
+            finalized = None
             if not admitted:
                 log.warning("round %d: no admissible uploads; the global "
                             "model is unchanged this round", self.round_idx)
             elif self.stream_agg is not None:
                 # stream mode: every admitted upload already folded at
                 # arrival — the barrier-close is one finalize, O(model)
-                self.params = self.stream_agg.finalize(self.round_idx)
+                finalized = self.stream_agg.finalize(self.round_idx)
             elif self.aggregate_fn is not None:
                 if self._staging_active():
                     stacked, w = self._staged_cohort(admitted)
@@ -1535,13 +1572,24 @@ class FedAvgServerActor(ServerManager):
                 # shardings) — a silent double compile of the defended
                 # aggregate.  jnp.asarray is a no-op on a jax output.
                 dev_params = jax.tree.map(jnp.asarray, self.params)
-                self.params = self.aggregate_fn(dev_params, stacked, w,
-                                                self.round_idx)
+                finalized = self.aggregate_fn(dev_params, stacked, w,
+                                              self.round_idx)
             else:
                 trees = [admitted[s][0] for s in sorted(admitted)]
                 weights = np.array([admitted[s][1] for s in sorted(admitted)],
                                    dtype=np.float32)
-                self.params = tree_weighted_mean(trees, weights)
+                finalized = tree_weighted_mean(trees, weights)
+            if finalized is not None:
+                # the server-optimizer seam: the finalize output becomes
+                # the pseudo-gradient Δ = global − finalize and the
+                # optimizer's jitted step applies it.  server_opt=None
+                # (and the plain optimizer, which returns `finalized`
+                # itself) keep this assignment byte-for-byte pre-seam.
+                if self.server_opt is not None:
+                    self.params = self.server_opt.apply(
+                        self.params, finalized, self.round_idx)
+                else:
+                    self.params = finalized
         self._finish_round(len(admitted))
 
     def _finish_round(self, quorum: int) -> None:
@@ -1575,6 +1623,14 @@ class FedAvgServerActor(ServerManager):
                 self.health.round_end(self.round_idx,
                                       new_global=self._host_params(),
                                       quorum=quorum)
+        decision = None
+        if self.controller is not None:
+            # the adaptive verdict for the NEXT round, decided BEFORE the
+            # checkpoint thunk runs so the controller's levers land in
+            # this round's boundary (a resume continues the trajectory)
+            decision = self.controller.decide(
+                self.round_idx,
+                self.health.last_line if self.health is not None else None)
 
         if self.faultline is not None:
             # the aggregate is applied in memory but not yet durable:
@@ -1613,6 +1669,11 @@ class FedAvgServerActor(ServerManager):
             # and fails the run loudly (the test-mode contract).
             extra = ({"shards": self.shard_wire.num_shards}
                      if self.shard_wire is not None else {})
+            if self.server_opt is not None:
+                extra["server_opt"] = self.server_opt.name
+            if decision is not None:
+                # every pacing decision named on the round's ledger line
+                extra["adapt"] = decision.as_ledger()
             self.perf.round_end(self.round_idx, quorum=quorum,
                                 dropped=len(self.dropped_silos.get(
                                     self.round_idx, [])), **extra)

@@ -36,12 +36,16 @@ rides the checkpoint as server state.
 from __future__ import annotations
 
 import dataclasses
+import warnings
+import zlib
 from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
+from fedml_tpu.server_opt import ServerOptMismatchError
 from fedml_tpu.trainer.workload import Workload
 
 Pytree = Any
@@ -146,6 +150,12 @@ class FedAC(FedAvg):
             raise ValueError(f"fedac needs alpha >= 1 and beta >= 1 "
                              f"(got alpha={alpha:g}, beta={beta:g}){hint}")
         self.coupling = {"gamma": gamma, "alpha": alpha, "beta": beta}
+        # identifies the coupling this x sequence belongs to; x is only
+        # meaningful relative to (gamma, alpha, beta, lr) — restoring it
+        # under different coupling silently de-accelerates the run
+        self._opt_tag = np.asarray(zlib.crc32(
+            f"fedac:{gamma!r}:{alpha!r}:{beta!r}:{cfg.lr!r}".encode()),
+            np.int64)
         self._x_state = None  # the coupled x sequence (params == x^ag)
         local = make_fedac_local(workload, cfg.lr, cfg.epochs, gamma,
                                  alpha, beta)
@@ -201,10 +211,27 @@ class FedAC(FedAvg):
 
     # the x sequence rides the round checkpoint beside params (= x^ag)
     def _extra_state(self):
-        return {"x_state": self._x_state}
+        return {"x_state": self._x_state, "opt_tag": self._opt_tag}
 
     def _extra_state_template(self, params):
-        return {"x_state": jax.tree.map(jnp.zeros_like, params)}
+        return {"x_state": jax.tree.map(jnp.zeros_like, params),
+                "opt_tag": np.asarray(0, np.int64)}
 
     def _load_extra_state(self, extra) -> None:
+        tag = extra.get("opt_tag")
+        if tag is None:
+            warnings.warn(
+                "fedac: restoring a pre-tag x-sequence snapshot (no "
+                "opt_tag recorded) — cannot verify it matches this "
+                "run's (gamma, alpha, beta, lr) coupling", stacklevel=2)
+        elif int(tag) != int(self._opt_tag):
+            raise ServerOptMismatchError(
+                f"fedac: snapshot's coupling tag {int(tag)} != this "
+                f"run's {int(self._opt_tag)} (gamma="
+                f"{self.coupling['gamma']:g}, alpha="
+                f"{self.coupling['alpha']:g}, beta="
+                f"{self.coupling['beta']:g}, lr={self.cfg.lr:g}); the x "
+                f"sequence is only meaningful under the coupling that "
+                f"produced it — rerun with the snapshot's --fedac_* / "
+                f"--lr flags or start fresh")
         self._x_state = extra["x_state"]

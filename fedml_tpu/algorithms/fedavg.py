@@ -236,7 +236,15 @@ class FedAvg:
         extra_t = self._extra_state_template(params)
         if extra_t:
             template["extra"] = extra_t
-        state = checkpointer.restore(like=template)
+        try:
+            state = checkpointer.restore(like=template)
+        except ValueError:
+            # the snapshot's extra-state layout differs from this run's
+            # template (older snapshot, or a different server optimizer)
+            # — restore untemplated and let _load_extra_state decide
+            # whether that is back-compat (accept + warn) or a foreign
+            # trajectory (named refusal)
+            state = checkpointer.restore()
         if "extra" in state:
             self._load_extra_state(state["extra"])
         logger.info("resumed from round %d (%s)", state["round"],

@@ -18,13 +18,17 @@ cheap tree-op server step runs as a second dispatch.
 from __future__ import annotations
 
 import dataclasses
+import warnings
+import zlib
 from typing import Any, Optional
 
 import jax
+import numpy as np
 import optax
 
 from fedml_tpu.algorithms.fedavg import FedAvg, FedAvgConfig
 from fedml_tpu.core.pytree import tree_sub
+from fedml_tpu.server_opt import ServerOptMismatchError
 
 Pytree = Any
 
@@ -61,6 +65,12 @@ class FedOpt(FedAvg):
                 f"available: {sorted(SERVER_OPTIMIZERS)}") from None
         self.server_opt = factory(config.server_lr, config.server_momentum)
         self.server_opt_state = None
+        # identifies the optimizer family + hyperparameters this state
+        # belongs to; a snapshot from a differently-configured run must
+        # refuse to restore, not silently continue a foreign trajectory
+        self._opt_tag = np.asarray(zlib.crc32(
+            f"fedopt:{config.server_optimizer}:{config.server_lr!r}:"
+            f"{config.server_momentum!r}".encode()), np.int64)
 
         @jax.jit
         def srv_step(w_old, w_avg, opt_state):
@@ -81,10 +91,29 @@ class FedOpt(FedAvg):
     # server optimizer state (momentum / Adam moments) rides the round
     # checkpoint so a resumed run continues the same trajectory
     def _extra_state(self):
-        return {"server_opt_state": self.server_opt_state}
+        return {"server_opt_state": self.server_opt_state,
+                "opt_tag": self._opt_tag}
 
     def _extra_state_template(self, params):
-        return {"server_opt_state": self.server_opt.init(params)}
+        return {"server_opt_state": self.server_opt.init(params),
+                "opt_tag": np.asarray(0, np.int64)}
 
     def _load_extra_state(self, extra) -> None:
+        tag = extra.get("opt_tag")
+        if tag is None:
+            warnings.warn(
+                "fedopt: restoring a pre-tag server-optimizer snapshot "
+                "(no opt_tag recorded) — cannot verify it matches "
+                "--server_optimizer/--server_lr/--server_momentum",
+                stacklevel=2)
+        elif int(tag) != int(self._opt_tag):
+            raise ServerOptMismatchError(
+                f"fedopt: snapshot's server-optimizer tag {int(tag)} != "
+                f"this run's {int(self._opt_tag)} "
+                f"(--server_optimizer {self.cfg.server_optimizer} "
+                f"--server_lr {self.cfg.server_lr} "
+                f"--server_momentum {self.cfg.server_momentum}); "
+                f"restoring foreign optimizer state would silently "
+                f"continue a different trajectory — rerun with the "
+                f"snapshot's server flags or start fresh")
         self.server_opt_state = extra["server_opt_state"]

@@ -49,6 +49,31 @@ class ExperimentConfig:
     server_lr: float = 1.0
     server_momentum: float = 0.9
 
+    # ---- server-optimizer spine (fedml_tpu/server_opt, ISSUE 18) -------
+    server_opt: str = "plain"         # LIVE server step over the
+    #                                   streaming/sharded finalize:
+    #                                   plain (bit-identical pre-seam
+    #                                   assignment) | momentum | adam |
+    #                                   fedac — the finalize output
+    #                                   becomes a pseudo-gradient and
+    #                                   the optimizer's one jitted step
+    #                                   applies it (lr/momentum ride
+    #                                   --server_lr/--server_momentum;
+    #                                   fedac knobs ride --fedac_*)
+    server_adam_beta1: float = 0.9    # server_opt adam first moment
+    server_adam_beta2: float = 0.999  # server_opt adam second moment
+    server_adam_eps: float = 1e-8     # server_opt adam denominator floor
+    adaptive: bool = False            # health-driven adaptive round
+    #                                   controller (server_opt/
+    #                                   controller.py): steer cohort /
+    #                                   epochs / wave pacing from the
+    #                                   PR 8 drift alarms; every decision
+    #                                   named on the perf-ledger line.
+    #                                   Requires --health
+    adapt_min_cohort: int = 2         # adaptive: cohort backoff floor
+    adapt_patience: int = 2           # adaptive: calm rounds before
+    #                                   levers decay back to baseline
+
     # ---- algorithm extras ----------------------------------------------
     mu: float = 0.1                      # FedProx proximal term
     ditto_lambda: float = 0.1            # Ditto: personalization pull λ

@@ -222,6 +222,37 @@ def _compose_extra_state(named):
     return (get, set_)
 
 
+def _make_server_opt(cfg: ExperimentConfig, template, *, plan=None,
+                     sentry=None, device=None):
+    """The live server-optimizer seam (fedml_tpu/server_opt, ISSUE 18).
+    ``plain`` returns None — the actors then keep the pre-seam
+    ``params = finalize(...)`` assignment byte-for-byte, which IS the
+    bit-identity parity contract."""
+    if cfg.server_opt == "plain":
+        return None
+    from fedml_tpu.server_opt import ServerOptimizer
+    return ServerOptimizer(
+        cfg.server_opt, template, lr=cfg.server_lr,
+        momentum=cfg.server_momentum,
+        beta1=cfg.server_adam_beta1, beta2=cfg.server_adam_beta2,
+        eps=cfg.server_adam_eps,
+        fedac_mu=cfg.fedac_mu, fedac_gamma=cfg.fedac_gamma,
+        fedac_alpha=cfg.fedac_alpha, fedac_beta=cfg.fedac_beta,
+        local_steps=cfg.epochs, plan=plan, sentry=sentry, device=device)
+
+
+def _make_controller(cfg: ExperimentConfig, *, cohort, epochs,
+                     wave_size=0, max_cohort=None, epochs_live=False):
+    """The health-driven adaptive round controller (--adaptive)."""
+    if not cfg.adaptive:
+        return None
+    from fedml_tpu.server_opt import AdaptiveController
+    return AdaptiveController(
+        cohort=cohort, epochs=epochs, wave_size=wave_size,
+        min_cohort=cfg.adapt_min_cohort, max_cohort=max_cohort,
+        patience=cfg.adapt_patience, epochs_live=epochs_live)
+
+
 def _make_slo(cfg: ExperimentConfig):
     """SLO evaluator over the telemetry registry (obs/perf.py) backing
     the serve frontend's ``/healthz?deep=1``; ``--slo`` overrides the
@@ -861,13 +892,23 @@ def run_async_fl(cfg, data, mesh, sink):
             history.append(stats)
             sink.log(stats, step=version)
 
+    # the staleness-aware server-optimizer seam (ISSUE 18): the
+    # discounted buffer mean becomes the pseudo-gradient
+    server_opt = _make_server_opt(
+        cfg, init, sentry=perf.sentry if perf else None,
+        device=perf.device if perf else None)
+
     # version-checkpoint extra state: the trust ledger survives crashes
     # (the sync runner's composition, mirrored)
     trust_extra = None
     if admission is not None:
         trust_extra = (lambda: admission.trust.state_dict(n_silos),
                        admission.trust.load_state_dict)
-    extra_state = _compose_extra_state([("trust", trust_extra)])
+    srv_opt_extra = None
+    if server_opt is not None:
+        srv_opt_extra = (server_opt.state_dict, server_opt.load_state_dict)
+    extra_state = _compose_extra_state([("trust", trust_extra),
+                                        ("srv_opt", srv_opt_extra)])
 
     hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
     server = AsyncFedServerActor(
@@ -879,7 +920,8 @@ def run_async_fl(cfg, data, mesh, sink):
         retask_timeout_s=cfg.retask_timeout_s or None,
         admission=admission, defended_aggregate=defended,
         stream_agg=stream, perf=perf, health=health,
-        extra_state=extra_state, journal=_make_journal(cfg))
+        extra_state=extra_state, journal=_make_journal(cfg),
+        server_opt=server_opt)
     server.register_handlers()
     silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
                                encode_upload=delta_encoder)
@@ -1256,6 +1298,18 @@ def run_cross_silo(cfg, data, mesh, sink):
                 _th.Thread(target=lambda: _warm_target(_sample_x),
                            daemon=True, name="serve-warmup").start()
 
+    # the server-optimizer seam + adaptive controller (ISSUE 18): the
+    # optimizer's O(model) state shards along the spine's plan when one
+    # exists, and both ride the round checkpoint by name below
+    server_opt = _make_server_opt(
+        cfg, init,
+        plan=shard_spine.plan if shard_spine is not None else None,
+        sentry=perf.sentry if perf else None,
+        device=perf.device if perf else None)
+    controller = _make_controller(
+        cfg, cohort=(n_edges if n_edges > 0 else n_silos),
+        epochs=cfg.epochs)
+
     # round-checkpoint extra state, composed by name: silo-side EF
     # residuals (PR 3) + the admission trust ledger (ISSUE 12 — a
     # resumed server must keep strikes, quarantine sentences, and
@@ -1279,9 +1333,19 @@ def run_cross_silo(cfg, data, mesh, sink):
         # restoring sharded fold state into a different layout
         shard_extra = (shard_spine.checkpoint_state,
                        shard_spine.restore_checkpoint_state)
+    srv_opt_extra = adapt_extra = None
+    if server_opt is not None:
+        # bit-exact optimizer-state roundtrip; a restore under a
+        # different --server_opt (or shard plan) refuses loudly
+        # (ServerOptMismatchError — the PR 14 mode-mismatch mirror)
+        srv_opt_extra = (server_opt.state_dict, server_opt.load_state_dict)
+    if controller is not None:
+        adapt_extra = (controller.state_dict, controller.load_state_dict)
     extra_state = _compose_extra_state([("ef", ef_extra),
                                         ("trust", trust_extra),
-                                        ("shard", shard_extra)])
+                                        ("shard", shard_extra),
+                                        ("srv_opt", srv_opt_extra),
+                                        ("adapt", adapt_extra)])
     journal = _make_journal(cfg)
 
     def make_server(transport):
@@ -1300,7 +1364,8 @@ def run_cross_silo(cfg, data, mesh, sink):
             admission=admission, aggregate_fn=defended,
             stream_agg=stream, perf=perf, health=health,
             secagg=secagg_root, journal=journal,
-            shard_wire=shard_spine)
+            shard_wire=shard_spine,
+            server_opt=server_opt, controller=controller)
         s.register_handlers()
         return s
 
@@ -1527,6 +1592,24 @@ def run_cross_device(cfg, data, mesh, sink):
     # them against the round's global exactly like cross-silo uploads
     health = _make_health(cfg, kind="params")
     wl = _make_workload(cfg, data)
+    server_opt = controller = None
+    if cfg.server_opt != "plain" or cfg.adaptive:
+        import jax
+        # the optimizer template must BE the run's initial global
+        # (fedac's coupled x sequence starts at it): reproduce run()'s
+        # exact rng chain — same seed, same split, same init
+        _, _init_rng = jax.random.split(jax.random.key(cfg.seed))
+        _tmpl = wl.init(_init_rng, jax.tree.map(
+            lambda v: v[0, 0],
+            {k: data.train[k] for k in ("x", "y", "mask")}))
+        server_opt = _make_server_opt(
+            cfg, _tmpl, sentry=perf.sentry if perf else None,
+            device=perf.device if perf else None)
+        # cross_device's cohort lever is LIVE: the sampler draws from
+        # the full population, so the ceiling is the population itself
+        controller = _make_controller(
+            cfg, cohort=cfg.client_num_per_round, epochs=cfg.epochs,
+            wave_size=cfg.wave_size, max_cohort=data.client_num)
     algo = CrossDevice(
         wl, data, CrossDeviceConfig(
             wave_size=cfg.wave_size, local_alg=cfg.local_alg,
@@ -1537,7 +1620,8 @@ def run_cross_device(cfg, data, mesh, sink):
             norm_screen_min_history=cfg.norm_screen_min_history,
             wave_adversary=cfg.wave_adversary,
             **_fedavg_cfg_kwargs(cfg)),
-        mesh=mesh, sink=sink, perf=perf, health=health, slo=slo)
+        mesh=mesh, sink=sink, perf=perf, health=health, slo=slo,
+        server_opt=server_opt, controller=controller)
     try:
         algo.run(checkpointer=_make_checkpointer(cfg))
     finally:
@@ -2150,6 +2234,63 @@ def main(argv=None) -> Dict[str, Any]:
             f"lifecycle and apply to --algo cross_silo/async_fl/"
             f"cross_device only; --algo {cfg.algo} would silently write "
             f"no ledger and never evaluate the objectives.")
+    # server-optimizer spine (fedml_tpu/server_opt, ISSUE 18): every
+    # incompatible combo fails AT CONFIG TIME with its reason — the
+    # named ServerOptConfigError, so a mislabeled run never trains
+    from fedml_tpu.server_opt import SERVER_OPT_NAMES, ServerOptConfigError
+    if cfg.server_opt not in SERVER_OPT_NAMES:
+        raise ServerOptConfigError(
+            f"unknown --server_opt {cfg.server_opt!r}; available: "
+            f"{list(SERVER_OPT_NAMES)}")
+    if cfg.server_opt != "plain":
+        if cfg.algo not in ("cross_silo", "async_fl", "cross_device"):
+            raise ServerOptConfigError(
+                f"--server_opt {cfg.server_opt} rides the live finalize "
+                f"seam and applies to --algo cross_silo/async_fl/"
+                f"cross_device only; --algo {cfg.algo} would silently "
+                f"run its own server step and label the run "
+                f"{cfg.server_opt}.  The standalone forks stay at "
+                f"--algo fedopt/fedac.")
+        if cfg.robust_agg != "mean":
+            raise ServerOptConfigError(
+                f"--server_opt {cfg.server_opt} with --robust_agg "
+                f"{cfg.robust_agg}: an order-statistic finalize is a "
+                f"selection, not a cohort mean — there is no "
+                f"pseudo-gradient Δ = global − finalize whose "
+                f"expectation the server optimizer's moments assume; "
+                f"use --robust_agg mean (with --norm_clip/"
+                f"--agg_noise_std for defense)")
+        if cfg.secagg != "off":
+            raise ServerOptConfigError(
+                f"--server_opt {cfg.server_opt} and --secagg are "
+                f"mutually exclusive: the masked-sum protocol yields "
+                f"the plain mean by construction; there is no seam to "
+                f"re-step it without unmasking intermediate state")
+        if cfg.local_alg == "fednova" and cfg.algo == "cross_device":
+            raise ServerOptConfigError(
+                "--server_opt with --local_alg fednova: fednova's "
+                "tau_eff step IS a server update; stacking a second "
+                "optimizer on top would silently change its normalized "
+                "averaging semantics")
+    if cfg.adaptive:
+        if not (cfg.health or cfg.health_ledger):
+            raise ServerOptConfigError(
+                "--adaptive steers pacing from the health observatory's "
+                "drift alarms and requires --health (or "
+                "--health_ledger); without it every decision would be "
+                "a vacuous hold and the run would be labeled adaptive")
+        if cfg.algo not in ("cross_silo", "cross_device"):
+            raise ServerOptConfigError(
+                f"--adaptive steers the per-round cohort sampler and "
+                f"applies to --algo cross_silo/cross_device only; "
+                f"--algo {cfg.algo} has no round cohort to pace")
+    if cfg.adapt_min_cohort < 1:
+        raise ServerOptConfigError(
+            f"--adapt_min_cohort must be >= 1, got "
+            f"{cfg.adapt_min_cohort}")
+    if cfg.adapt_patience < 1:
+        raise ServerOptConfigError(
+            f"--adapt_patience must be >= 1, got {cfg.adapt_patience}")
     # decentralized_online consumes a streaming dataset (UCI SUSY/RO or a
     # synthetic stream) that the registry doesn't serve — its runner builds
     # it; loading here would KeyError on --dataset SUSY

@@ -425,6 +425,140 @@ def validate_ingest_bench(obj: dict,
     return problems
 
 
+def _opt_rounds_to_target(curve, target):
+    """First (1-based) round count at which the committed accuracy
+    curve reaches the target; None when it never does."""
+    for r, acc in curve:
+        if acc >= target:
+            return int(r) + 1
+    return None
+
+
+def validate_opt_bench(obj: dict, allow_smoke: bool = True) -> List[str]:
+    """Schema + honesty check for ``BENCH_opt.json`` v1 (ISSUE 18): the
+    server-optimizer spine's committed convergence contract.  The bench
+    SCRIPT enforces the gates at measurement time; this validates an
+    artifact still carries PASSING verdicts — and RE-DERIVES the
+    headline claims from the committed per-round accuracy curves rather
+    than trusting the summary numbers: on >= 2 workloads the optimizer
+    arm reaches the workload's stated target accuracy in >= 1.5x fewer
+    rounds than plain FedAvg (same seed, same data), its final accuracy
+    is no worse than plain's minus the stated tolerance, zero recompiles
+    after warmup under ``--perf_strict`` on every arm, and the adaptive
+    controller's decision is on every optimizer-arm ledger round.
+    ``allow_smoke=False`` (the committed-trend-line mode —
+    ``perf_trend.py --opt_bench``) rejects smoke-labeled artifacts
+    outright."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return ["opt bench is not a JSON object"]
+    if obj.get("bench") != "opt":
+        problems.append(f"bench != 'opt' (got {obj.get('bench')!r})")
+    if obj.get("version") != 1:
+        problems.append(f"version != 1 (got {obj.get('version')!r})")
+    smoke = bool(obj.get("smoke"))
+    if smoke and not allow_smoke:
+        problems.append("smoke-labeled artifact on the committed trend "
+                        "line (smoke runs carry relaxed scale and belong "
+                        "in /tmp, never committed)")
+    wls = obj.get("workloads")
+    if not isinstance(wls, dict) or not wls:
+        return problems + ["no workloads section"]
+    if len(wls) < 2:
+        problems.append(f"only {len(wls)} workload(s); the claim needs "
+                        f">= 2")
+    for name, wl in wls.items():
+        if not isinstance(wl, dict):
+            problems.append(f"workload {name!r} is not an object")
+            continue
+        target = wl.get("target_acc")
+        if not isinstance(target, (int, float)):
+            problems.append(f"workload {name!r}: no target_acc")
+            continue
+        arms = wl.get("arms")
+        if not isinstance(arms, dict) or "plain" not in arms \
+                or len(arms) != 2:
+            problems.append(f"workload {name!r}: needs exactly a "
+                            f"'plain' arm and one optimizer arm")
+            continue
+        opt_name = next(a for a in arms if a != "plain")
+        if opt_name not in ("momentum", "adam", "fedac"):
+            problems.append(f"workload {name!r}: unknown optimizer arm "
+                            f"{opt_name!r}")
+        rtt = {}
+        for aname, arm in arms.items():
+            if not isinstance(arm, dict):
+                problems.append(f"workload {name!r} arm {aname!r}: not "
+                                f"an object")
+                continue
+            if arm.get("backend") not in ("cpu", "gpu", "tpu"):
+                problems.append(f"workload {name!r} arm {aname!r}: no "
+                                f"honest backend label "
+                                f"(got {arm.get('backend')!r})")
+            curve = arm.get("test_acc_by_round")
+            if not (isinstance(curve, list) and curve
+                    and all(isinstance(p, list) and len(p) == 2
+                            for p in curve)):
+                problems.append(f"workload {name!r} arm {aname!r}: no "
+                                f"committed per-round accuracy curve")
+                continue
+            rtt[aname] = _opt_rounds_to_target(curve, target)
+            if arm.get("recompiles_after_warmup", 0) != 0:
+                problems.append(
+                    f"workload {name!r} arm {aname!r}: "
+                    f"{arm['recompiles_after_warmup']} recompiles after "
+                    f"warmup under --perf_strict")
+        gates = wl.get("gates")
+        if not isinstance(gates, dict) or not gates:
+            problems.append(f"workload {name!r}: no recorded gate "
+                            f"verdicts")
+            continue
+        for gname, verdict in gates.items():
+            if not isinstance(verdict, dict) or "ok" not in verdict:
+                problems.append(f"workload {name!r}: gate {gname!r} "
+                                f"without an ok verdict")
+            elif not verdict["ok"]:
+                problems.append(f"workload {name!r}: gate {gname!r} "
+                                f"FAILED ({verdict})")
+        if smoke:
+            continue   # relaxed scale: curves too short to re-derive
+        # re-derive the headline claims from the raw curves
+        if rtt.get("plain") is None:
+            problems.append(f"workload {name!r}: plain never reaches "
+                            f"the target accuracy {target}")
+        if len(rtt) == 2 and None not in rtt.values():
+            p, o = rtt["plain"], rtt[opt_name]
+            thr = float(gates.get("speedup", {}).get("threshold", 1.5))
+            if p < thr * o:
+                problems.append(
+                    f"workload {name!r}: rounds-to-target {p} (plain) "
+                    f"vs {o} ({opt_name}) — ratio {p / o:.2f} < {thr}")
+        finals = {a: arm["test_acc_by_round"][-1][1]
+                  for a, arm in arms.items()
+                  if isinstance(arm, dict)
+                  and isinstance(arm.get("test_acc_by_round"), list)
+                  and arm["test_acc_by_round"]}
+        tol = float(gates.get("final_accuracy_not_worse", {})
+                    .get("tolerance", 0.02))
+        if len(finals) == 2 \
+                and finals[opt_name] < finals["plain"] - tol:
+            problems.append(
+                f"workload {name!r}: {opt_name} final accuracy "
+                f"{finals[opt_name]:.3f} worse than plain "
+                f"{finals['plain']:.3f} - {tol}")
+        opt_arm = arms.get(opt_name)
+        if isinstance(opt_arm, dict):
+            n_adapt = opt_arm.get("adapt_rounds")
+            n_ledger = opt_arm.get("ledger_rounds")
+            if not (isinstance(n_adapt, int) and isinstance(n_ledger, int)
+                    and n_ledger > 0 and n_adapt == n_ledger):
+                problems.append(
+                    f"workload {name!r}: controller decisions on "
+                    f"{n_adapt!r} of {n_ledger!r} ledger rounds — the "
+                    f"adaptive decision must be visible on every round")
+    return problems
+
+
 def phase_medians(rows: List[dict],
                   skip_first: bool = True) -> Dict[str, float]:
     """Median per-phase seconds across the ledger (plus ``round_s``).
@@ -597,14 +731,24 @@ def main(argv=None) -> int:
                         "round, honest backend labels, passing gate "
                         "verdicts, zero recompiles after warmup, and a "
                         "green disabled-mode overhead pin")
+    p.add_argument("--opt_bench", default=None,
+                   help="BENCH_opt.json (v1) to validate: >= 2 workloads "
+                        "each with a plain arm and one optimizer arm, "
+                        "honest backend labels, passing gate verdicts, "
+                        "and the headline claims RE-DERIVED from the "
+                        "committed accuracy curves — rounds-to-target "
+                        "ratio >= 1.5, final accuracy not worse, zero "
+                        "recompiles after warmup, controller decisions "
+                        "on every optimizer-arm round")
     args = p.parse_args(argv)
     if args.ledger is None and not args.lint_mfu \
             and args.health_ledger is None and args.serve_bench is None \
-            and args.release_bench is None and args.ingest_bench is None:
+            and args.release_bench is None and args.ingest_bench is None \
+            and args.opt_bench is None:
         p.print_usage()
         print("perf_trend: nothing to do (pass --ledger, --health_ledger, "
-              "--serve_bench, --release_bench, --ingest_bench and/or "
-              "--lint_mfu)")
+              "--serve_bench, --release_bench, --ingest_bench, "
+              "--opt_bench and/or --lint_mfu)")
         return 2
 
     failures: List[str] = []
@@ -740,6 +884,23 @@ def main(argv=None) -> int:
                                for r in (a.get("rounds") or [])})
             print(f"ingest bench: {len(arms)} arm(s) green "
                   f"(bindings seen: {bindings})")
+
+    if args.opt_bench is not None:
+        try:
+            with open(args.opt_bench) as f:
+                opt_obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"perf_trend: cannot read opt bench: {e}")
+            return 2
+        # committed-trend-line mode: a smoke artifact must not anchor it
+        problems = validate_opt_bench(opt_obj, allow_smoke=False)
+        failures += [f"opt bench: {x}" for x in problems]
+        if not problems:
+            wls = opt_obj.get("workloads", {})
+            arms = sorted({a for wl in wls.values()
+                           for a in wl.get("arms", {}) if a != "plain"})
+            print(f"opt bench: {len(wls)} workload(s) green "
+                  f"(optimizer arms: {arms})")
 
     if args.lint_mfu:
         paths = _expand(args.lint_mfu)

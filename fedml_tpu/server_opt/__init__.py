@@ -1,0 +1,19 @@
+"""fedml_tpu.server_opt — the server-optimizer spine (ISSUE 18).
+
+* `optimizer` — the pluggable pseudo-gradient step over the streaming
+  and sharded finalize (plain | momentum | adam | fedac), with
+  checkpoint/journal-riding O(model) state and the PR 14-style
+  mismatch refusals;
+* `controller` — the health-driven adaptive round controller steering
+  cohort/epochs/wave pacing from the PR 8 drift alarms.
+"""
+
+from fedml_tpu.server_opt.controller import AdaptiveController, Decision
+from fedml_tpu.server_opt.optimizer import (SERVER_OPT_NAMES,
+                                            ServerOptConfigError,
+                                            ServerOptMismatchError,
+                                            ServerOptimizer)
+
+__all__ = ["AdaptiveController", "Decision", "SERVER_OPT_NAMES",
+           "ServerOptConfigError", "ServerOptMismatchError",
+           "ServerOptimizer"]

@@ -1,0 +1,426 @@
+"""The server-optimizer seam (ISSUE 18) — a pluggable step over the
+streaming AND sharded finalize.
+
+The live spine's ``StreamingAggregator.finalize()`` produces the
+cohort's weighted-mean model; today the server actors assign it to the
+global wholesale.  The seam reinterprets that output as a
+pseudo-gradient (the FedOpt contract, Reddi et al. 2020,
+FedOptAggregator.py:108-122)
+
+    Δ = w_global − finalize(round)
+
+and lets a ``ServerOptimizer`` apply it:
+
+    plain     — the finalized tree verbatim, ZERO arithmetic.  Not
+                ``w − 1.0·Δ``: a float round-trip through the delta is
+                not bit-identical (``a − (a − b) ≠ b`` in f32), and the
+                plain mode's whole job is the bit-identity parity pin
+                against the pre-seam finalize.
+    momentum  — optax-sgd trace: ``t ← Δ + m·t;  w ← w − lr·t``.
+    adam      — optax-adam moments (b1/b2/eps, eps_root=0, count
+                incremented before bias correction) on Δ.
+    fedac     — FedAC (Yuan & Ma 2020, arXiv:2006.08950) Algorithm 1 at
+                server granularity: the global IS the output iterate
+                x^ag, the coupled x sequence is optimizer state, and the
+                round's pseudo-gradient stands in for the local
+                gradient:
+
+                    x^md  = x/β + (1 − 1/β)·x^ag
+                    x^ag' = x^md − lr·Δ
+                    x'    = (1 − 1/α)·x + x^md/α − γ·Δ
+
+                ``(α=1, β=1, γ=lr)`` collapses the recurrence onto the
+                plain SGD step — the parity hook against
+                ``algorithms/fedac.py``'s local form.  ``fedac_mu > 0``
+                derives (γ, α, β) via the same Lemma-1 coupling
+                (``fedac.fedac_coupling``).
+
+Contracts the seam inherits from the spine it sits on:
+
+* O(model) state, eagerly zero-initialized at construction so the
+  checkpoint/extra-state template has fixed shapes from round 0 (the
+  orbax ``restore(like=)`` requirement).
+* One jitted step, registered with the RecompileSentry under
+  ``server_opt[<name>]`` — the jit-once pin holds across rounds.
+* ``state_dict``/``load_state_dict`` ride the PR 12 journal and round
+  checkpoints; restore is bit-exact and REFUSES a snapshot written
+  under a different optimizer or a different shard plan (the PR 14
+  mode-mismatch refusal, mirrored — ``ServerOptMismatchError``).
+* Under the PR 14 sharded spine the step always sees the FULL joined
+  tree (the sharded finalize joins host-side before the seam); only
+  the serialized state lays out shard-major along the leaf→shard plan,
+  so per-shard checkpoint shards stay O(model/S).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import zlib
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+SERVER_OPT_NAMES = ("plain", "momentum", "adam", "fedac")
+
+
+class ServerOptConfigError(ValueError):
+    """A --server_opt / --adaptive flag combination that would silently
+    mislabel a run — refused at config time with the reason."""
+
+
+class ServerOptMismatchError(ValueError):
+    """A checkpoint/journal snapshot written under a DIFFERENT server
+    optimizer (or shard plan) than the one restoring it — restoring
+    would continue a foreign trajectory; refused loudly instead (the
+    PR 14 shard-fingerprint refusal, mirrored)."""
+
+
+def _tree_sub(a: Pytree, b: Pytree) -> Pytree:
+    return jax.tree.map(lambda x, y: x - y.astype(x.dtype), a, b)
+
+
+def _global_norm(tree: Pytree):
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                        for l in jax.tree.leaves(tree)))
+
+
+class ServerOptimizer:
+    """One pseudo-gradient step per round over the finalize seam.
+
+    ``apply(params, finalized, round_idx)`` — the sync seam: the
+    pseudo-gradient ``Δ = params − finalized`` forms INSIDE the jitted
+    step.  ``apply_delta(params, delta, round_idx)`` — the async seam:
+    the caller supplies Δ directly (async_fl's staleness discount
+    scales the GRADIENT, so stale buffers move the momentum less).
+
+    Both mutate ``self.state`` (the O(model) slots) and return the new
+    global.  ``plain`` short-circuits ``apply`` to the finalized tree
+    itself and ``apply_delta`` to the exact SGD step — no moments, no
+    state.
+    """
+
+    def __init__(self, name: str, template: Pytree, *,
+                 lr: float = 1.0, momentum: float = 0.9,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 eps: float = 1e-8,
+                 fedac_mu: float = 0.0, fedac_gamma: float = 0.0,
+                 fedac_alpha: float = 1.0, fedac_beta: float = 1.0,
+                 local_steps: int = 1,
+                 plan=None, sentry=None, device=None):
+        if name not in SERVER_OPT_NAMES:
+            raise ServerOptConfigError(
+                f"unknown --server_opt {name!r}; "
+                f"have {list(SERVER_OPT_NAMES)}")
+        self.name = name
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.beta1, self.beta2, self.eps = float(beta1), float(beta2), \
+            float(eps)
+        if name == "fedac":
+            if fedac_mu > 0.0:
+                from fedml_tpu.algorithms.fedac import fedac_coupling
+                gamma, alpha, beta = fedac_coupling(
+                    self.lr, fedac_mu, max(int(local_steps), 1))
+            else:
+                gamma = fedac_gamma or self.lr
+                alpha, beta = fedac_alpha, fedac_beta
+            if alpha < 1.0 or beta < 1.0:
+                raise ServerOptConfigError(
+                    f"--server_opt fedac needs alpha >= 1 and beta >= 1 "
+                    f"(got alpha={alpha:g}, beta={beta:g}); with "
+                    f"--fedac_mu the coupling needs mu <= 1/lr")
+            self.coupling = {"gamma": float(gamma), "alpha": float(alpha),
+                             "beta": float(beta)}
+        else:
+            self.coupling = None
+        self.plan = plan
+        self._treedef = jax.tree.structure(template)
+        self._template_leaves = [np.asarray(l)
+                                 for l in jax.tree.leaves(template)]
+        # the hyperparameter fingerprint a restore must match: same
+        # optimizer NAME and same step rule — a momentum trace restored
+        # under a different decay is a silent trajectory fork
+        self.fp = zlib.crc32(json.dumps(
+            {"name": name, "lr": self.lr, "momentum": self.momentum,
+             "beta1": self.beta1, "beta2": self.beta2, "eps": self.eps,
+             "coupling": self.coupling}, sort_keys=True).encode())
+        self.step_count = 0
+        self.state = self._init_state(template)
+        self._build_steps()
+
+        from fedml_tpu.obs import telemetry as _tel
+        reg = _tel.get_registry()
+        self._m_steps = reg.counter("fedml_srvopt_steps_total")
+        self._m_delta = reg.gauge("fedml_srvopt_delta_norm_value")
+        self._m_update = reg.gauge("fedml_srvopt_update_norm_value")
+        self._m_secs = reg.histogram(
+            "fedml_srvopt_step_seconds",
+            buckets=(.0005, .002, .01, .05, .2, 1., 5.))
+        if device is not None and self._step_jit is not None:
+            self._step_jit = device.instrument(
+                f"srvopt_step[{name}]", self._step_jit, sentry=sentry,
+                sentry_name=f"server_opt[{name}]")
+            self._delta_step_jit = device.instrument(
+                f"srvopt_delta_step[{name}]", self._delta_step_jit)
+        if sentry is not None:
+            sentry.register(f"server_opt[{name}]", self)
+
+    # -- state ----------------------------------------------------------------
+
+    def _init_state(self, template: Pytree) -> dict:
+        z = lambda: jax.tree.map(  # noqa: E731
+            lambda l: jnp.zeros(np.shape(l), jnp.asarray(l).dtype),
+            template)
+        if self.name == "plain":
+            return {}
+        if self.name == "momentum":
+            return {"trace": z()}
+        if self.name == "adam":
+            return {"mu": z(), "nu": z(),
+                    "count": jnp.zeros((), jnp.int32)}
+        # fedac: the coupled x sequence starts AT the global (x^0 =
+        # x^ag,0 — fedac.py's fresh-run convention)
+        return {"x": jax.tree.map(
+            lambda l: jnp.asarray(l), template)}
+
+    # -- the jitted step ------------------------------------------------------
+
+    def _build_steps(self):
+        name, lr = self.name, self.lr
+        if name == "plain":
+            self._step_jit = None
+
+            @jax.jit
+            def plain_delta(w, delta):
+                new = jax.tree.map(lambda wi, di: wi - lr
+                                   * di.astype(wi.dtype), w, delta)
+                return new, _global_norm(delta), _global_norm(
+                    _tree_sub(new, w))
+            self._delta_step_jit = plain_delta
+            return
+
+        if name == "momentum":
+            m = self.momentum
+
+            def step(w, delta, state):
+                t = jax.tree.map(lambda d, ti: d.astype(ti.dtype)
+                                 + m * ti, delta, state["trace"])
+                new = jax.tree.map(lambda wi, ti: wi
+                                   - lr * ti.astype(wi.dtype), w, t)
+                return new, {"trace": t}
+        elif name == "adam":
+            b1, b2, eps = self.beta1, self.beta2, self.eps
+
+            def step(w, delta, state):
+                count = state["count"] + 1
+                mu = jax.tree.map(
+                    lambda mi, d: b1 * mi + (1.0 - b1)
+                    * d.astype(mi.dtype), state["mu"], delta)
+                nu = jax.tree.map(
+                    lambda ni, d: b2 * ni + (1.0 - b2)
+                    * jnp.square(d.astype(ni.dtype)), state["nu"], delta)
+                c = count.astype(jnp.float32)
+                bc1 = 1.0 - jnp.power(jnp.float32(b1), c)
+                bc2 = 1.0 - jnp.power(jnp.float32(b2), c)
+                new = jax.tree.map(
+                    lambda wi, mi, ni: wi - (lr * (mi / bc1)
+                                             / (jnp.sqrt(ni / bc2) + eps)
+                                             ).astype(wi.dtype),
+                    w, mu, nu)
+                return new, {"mu": mu, "nu": nu, "count": count}
+        else:  # fedac
+            gamma = self.coupling["gamma"]
+            alpha, beta = self.coupling["alpha"], self.coupling["beta"]
+
+            def step(w_ag, delta, state):
+                x = state["x"]
+                x_md = jax.tree.map(
+                    lambda xi, ai: xi / beta + (1.0 - 1.0 / beta) * ai,
+                    x, w_ag)
+                new_ag = jax.tree.map(
+                    lambda m_, d: m_ - lr * d.astype(m_.dtype),
+                    x_md, delta)
+                new_x = jax.tree.map(
+                    lambda xi, m_, d: (1.0 - 1.0 / alpha) * xi
+                    + m_ / alpha - gamma * d.astype(xi.dtype),
+                    x, x_md, delta)
+                return new_ag, {"x": new_x}
+
+        @jax.jit
+        def from_finalized(w, finalized, state):
+            delta = _tree_sub(w, finalized)
+            new, state = step(w, delta, state)
+            return new, state, _global_norm(delta), _global_norm(
+                _tree_sub(new, w))
+
+        @jax.jit
+        def from_delta(w, delta, state):
+            new, state = step(w, delta, state)
+            return new, state, _global_norm(delta), _global_norm(
+                _tree_sub(new, w))
+
+        self._step_jit = from_finalized
+        self._delta_step_jit = from_delta
+
+    # -- recompile-sentry probe (PerfRecorder.register_jit contract) ----------
+
+    def _cache_size(self) -> int:
+        n = 0
+        for fn in (self._step_jit, self._delta_step_jit):
+            if fn is not None:
+                n += int(fn._cache_size())
+        return n
+
+    # -- the seam -------------------------------------------------------------
+
+    def apply(self, params: Pytree, finalized: Pytree,
+              round_idx: int = 0) -> Pytree:
+        """The sync finalize seam.  ``plain`` returns the finalized tree
+        ITSELF (bit-identity — no delta round-trip)."""
+        self.step_count += 1
+        self._m_steps.inc()
+        if self.name == "plain":
+            return finalized
+        t0 = time.perf_counter()
+        new, self.state, dn, un = self._step_jit(params, finalized,
+                                                 self.state)
+        self._m_delta.set(float(dn))
+        self._m_update.set(float(un))
+        self._m_secs.observe(time.perf_counter() - t0)
+        return new
+
+    def apply_delta(self, params: Pytree, delta: Pytree,
+                    round_idx: int = 0) -> Pytree:
+        """The async seam: Δ supplied by the caller (already
+        staleness-discounted).  ``plain`` is the exact SGD step
+        ``w − lr·Δ``."""
+        self.step_count += 1
+        self._m_steps.inc()
+        t0 = time.perf_counter()
+        if self.name == "plain":
+            new, dn, un = self._delta_step_jit(params, delta)
+        else:
+            new, self.state, dn, un = self._delta_step_jit(
+                params, delta, self.state)
+        self._m_delta.set(float(dn))
+        self._m_update.set(float(un))
+        self._m_secs.observe(time.perf_counter() - t0)
+        return new
+
+    # -- checkpoint / journal (bit-exact, refusal-guarded) --------------------
+
+    def _tree_slots(self):
+        return [k for k in ("trace", "mu", "nu", "x") if k in self.state]
+
+    def _split_flat(self, leaves):
+        """Ordered leaf list → one flat host list laid out shard-major
+        in sorted-slice-key order along the plan (the
+        ShardedStreamingAggregator.state_dict layout, so per-shard
+        checkpoint shards stay O(model/S))."""
+        flat = []
+        for body in self.plan.split_leaves(leaves):
+            (_, d), = body.items()
+            for k in sorted(d):
+                flat.append(np.asarray(d[k]))
+        return flat
+
+    def _join_flat(self, flat):
+        proto = self.plan.split_leaves(self._template_leaves)
+        it = iter(flat)
+        for body in proto:
+            (_, d), = body.items()
+            for k in sorted(d):
+                d[k] = np.asarray(next(it))
+        return self.plan.join_slices(proto)
+
+    def state_dict(self) -> dict:
+        """Host snapshot: every slot's leaves as numpy in their own
+        dtype (bit-exact round trip), stamped with the optimizer
+        identity/fingerprint (and the shard-plan fingerprint when
+        sharded).  All leaves are numpy arrays (scalars travel as 0-d
+        arrays — orbax rejects bare numpy scalars) — never strings —
+        so the dict rides orbax checkpoints unmodified (the optimizer
+        NAME travels as its index into ``SERVER_OPT_NAMES``)."""
+        out = {"opt_id": np.asarray(SERVER_OPT_NAMES.index(self.name),
+                                    np.int32),
+               "fp": np.asarray(self.fp, np.int64),
+               "step": np.asarray(self.step_count, np.int64)}
+        if self.plan is not None:
+            out["shard_fp"] = np.asarray(self.plan.fingerprint(),
+                                         np.int64)
+        for slot in self._tree_slots():
+            leaves = [np.asarray(l)
+                      for l in jax.tree.leaves(self.state[slot])]
+            out[slot] = (self._split_flat(leaves)
+                         if self.plan is not None else leaves)
+        if "count" in self.state:
+            out["count"] = np.asarray(self.state["count"], np.int32)
+        return out
+
+    def load_state_dict(self, state: dict) -> None:
+        opt_id = int(np.asarray(state.get("opt_id", -1)))
+        got = (SERVER_OPT_NAMES[opt_id]
+               if 0 <= opt_id < len(SERVER_OPT_NAMES) else f"#{opt_id}")
+        if got != self.name:
+            raise ServerOptMismatchError(
+                f"checkpoint was written under --server_opt {got!r} but "
+                f"this run is --server_opt {self.name!r}; restoring its "
+                f"optimizer state would continue a foreign trajectory — "
+                f"restart from scratch or rerun with --server_opt {got}")
+        if int(np.asarray(state.get("fp", -1))) != int(self.fp):
+            raise ServerOptMismatchError(
+                f"server_opt[{self.name}] checkpoint hyperparameters "
+                f"differ from this run's (fingerprint "
+                f"{state.get('fp')!r} != {self.fp}) — the restored "
+                f"moments would step under a different rule")
+        snap_fp = state.get("shard_fp")
+        if self.plan is not None:
+            if snap_fp is None:
+                raise ServerOptMismatchError(
+                    "server_opt snapshot carries no shard-plan "
+                    "fingerprint (it was written by the replicated "
+                    "path); the sharded spine refuses to restore it")
+            if int(snap_fp) != int(self.plan.fingerprint()):
+                raise ServerOptMismatchError(
+                    "server_opt snapshot was written under a DIFFERENT "
+                    "shard plan (fingerprint mismatch — --model_shards "
+                    "or the model changed); restoring it would place "
+                    "optimizer state into the wrong slots")
+        elif snap_fp is not None:
+            raise ServerOptMismatchError(
+                "server_opt snapshot is laid out along a shard plan but "
+                "this run is replicated; refusing the restore")
+        for slot in self._tree_slots():
+            leaves = state[slot]
+            if self.plan is not None:
+                leaves = self._join_flat(leaves)
+            self.state[slot] = jax.tree.unflatten(
+                self._treedef, [jnp.asarray(np.asarray(l))
+                                for l in leaves])
+        if "count" in self.state:
+            self.state["count"] = jnp.asarray(int(np.asarray(
+                state["count"])), jnp.int32)
+        self.step_count = int(np.asarray(state.get("step", 0)))
+
+    # extra-state template for orbax restore(like=): fixed shapes,
+    # zero-filled, same layout as state_dict
+    def state_template(self) -> dict:
+        out = {"opt_id": np.asarray(SERVER_OPT_NAMES.index(self.name),
+                                    np.int32),
+               "fp": np.asarray(self.fp, np.int64),
+               "step": np.asarray(0, np.int64)}
+        if self.plan is not None:
+            out["shard_fp"] = np.asarray(self.plan.fingerprint(),
+                                         np.int64)
+        zeros = [np.zeros(l.shape, l.dtype) for l in self._template_leaves]
+        for slot in self._tree_slots():
+            out[slot] = (self._split_flat(zeros)
+                         if self.plan is not None else list(zeros))
+        if "count" in self.state:
+            out["count"] = np.asarray(0, np.int32)
+        return out

@@ -240,4 +240,16 @@ env JAX_PLATFORMS=cpu python scripts/ingest_bench.py --smoke
 env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
     --ingest_bench BENCH_ingest.json
 echo "ingest smoke OK: critical-path records, gauges, and cost gates green"
+
+echo "== asserting the server-optimizer spine (ISSUE 18)"
+# structural pipe-cleaner for the convergence contract: both workloads,
+# plain + optimizer arms, controller decisions on every ledger line,
+# zero recompiles under --perf_strict (output to /tmp — the committed
+# BENCH_opt.json keeps full-bench numbers), then the committed
+# artifact through the trend gate, which re-derives the rounds-to-
+# target and final-accuracy claims from the committed curves
+env JAX_PLATFORMS=cpu python scripts/opt_bench.py --smoke
+env JAX_PLATFORMS=cpu python scripts/perf_trend.py \
+    --opt_bench BENCH_opt.json
+echo "opt smoke OK: server-optimizer arms, pacing decisions, and convergence gates green"
 echo "== obs demo OK ($DIR)"

@@ -44,5 +44,9 @@ grep -q "fused=True" tests/test_shard_spine.py  # fused-finalize parity too
 # ISSUE 17 critical-path observatory: attribution sweep, binding
 # constraints, disabled-mode zero-allocation pin, ingest-bench schema
 [ -f tests/test_critical_path.py ]
+# ISSUE 18 server-optimizer spine: seam parity vs optax/fedac math,
+# plain bit-identity, sharded state round-trip, crash kill->resume with
+# optimizer slots, controller determinism, config-gate matrix
+[ -f tests/test_server_opt.py ]
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

@@ -178,6 +178,18 @@ EXPECTED = {
     "fedml_ingest_fold_overlap_ratio",
     "fedml_ingest_phase_utilization_ratio",
     "fedml_ingest_uploads_total",
+    # PR 18: the server-optimizer spine (server_opt/optimizer.py): steps
+    # applied, pseudo-gradient/update norms, per-step wall time; and the
+    # adaptive round controller (server_opt/controller.py): the live
+    # cohort/epochs/wave levers plus total decisions taken
+    "fedml_srvopt_steps_total",
+    "fedml_srvopt_delta_norm_value",
+    "fedml_srvopt_update_norm_value",
+    "fedml_srvopt_step_seconds",
+    "fedml_adapt_cohort_value",
+    "fedml_adapt_epochs_value",
+    "fedml_adapt_wave_value",
+    "fedml_adapt_decisions_total",
 }
 
 
