@@ -101,7 +101,8 @@ class AsyncFedServerActor(ServerManager):
                  extra_state: Optional[tuple] = None,
                  journal=None,
                  faultline=None,
-                 server_opt=None):
+                 server_opt=None,
+                 degrade=None):
         """``checkpointer``: a `RoundCheckpointer`; every applied version
         is saved per its ``save_every`` gating and ``start()`` resumes
         from the latest saved version — a crashed async server restarts
@@ -239,6 +240,14 @@ class AsyncFedServerActor(ServerManager):
         # LESS — the discount scales the gradient, never the state
         # dynamics.  None keeps the legacy host-f64 apply bit-exactly.
         self.server_opt = server_opt
+        # degrade: a fedml_tpu.robust.degrade.ReliabilityTracker (ISSUE
+        # 19).  In the async regime the per-silo completion history
+        # (task→upload latency) adapts the WATCHDOG's quiet threshold —
+        # the async analog of the sync round deadline — and every
+        # watchdog nudge books a network-attributed drop (debt), never
+        # a trust strike.
+        self.degrade = degrade
+        self._tasked_at: Dict[int, float] = {}
         if health is not None:
             # no per-version barrier set exists — the silo universe is
             # the fairness denominator from version 0.  The starvation
@@ -396,6 +405,17 @@ class AsyncFedServerActor(ServerManager):
         # version to close, not lost — re-tasking it would only produce a
         # duplicate the at-most-once guard rejects
         buffered = {s for _, _, _, s, _ in self._buffer}
+        # adaptive quiet threshold (ISSUE 19): the observed task→upload
+        # completion quantile adapts the watchdog — a warmed tracker
+        # nudges a wedged silo in ~p90×slack instead of paying the full
+        # static window; deadline_s clamps to [deadline_floor_s,
+        # retask_timeout_s] and falls back to the static value cold
+        quiet_after = self.retask_timeout_s
+        if self.degrade is not None:
+            adaptive = self.degrade.deadline_s(
+                range(1, self.n_silos + 1), self.retask_timeout_s)
+            if adaptive is not None:
+                quiet_after = adaptive
         for silo in range(1, self.n_silos + 1):
             if silo in buffered or silo in self._benched:
                 # benched silos are OWNED by the version-close probation
@@ -406,10 +426,15 @@ class AsyncFedServerActor(ServerManager):
                     silo, self.version) == "quarantined":
                 continue  # jailed but never benched: wait out the sentence
             quiet = now - self._last_heard.get(silo, now)
-            if quiet >= self.retask_timeout_s:
-                log.warning("silo %d quiet for %.1fs; re-tasking against "
-                            "version %d", silo, quiet, self.version)
+            if quiet >= quiet_after:
+                log.warning("silo %d quiet for %.1fs (threshold %.1fs); "
+                            "re-tasking against version %d", silo, quiet,
+                            quiet_after, self.version)
                 self._last_heard[silo] = now  # one nudge per timeout window
+                if self.degrade is not None:
+                    # a quiet silo is a NETWORK verdict (debt + fault
+                    # ledger) — the trust tracker is never touched here
+                    self.degrade.note_drop(silo)
                 # watchdog ticks are self-messages with no inbound trace
                 # context — root each nudge so its train/upload stitch
                 with self._root_span("retask",
@@ -422,6 +447,7 @@ class AsyncFedServerActor(ServerManager):
         return self._host_mirror.get(self.params)
 
     def _task(self, silo: int, client_idx: int, msg_type=MsgType.S2C_SYNC):
+        self._tasked_at[silo] = time.monotonic()
         self.send(msg_type, silo,
                   **{Message.ARG_MODEL_PARAMS: self._host_params(),
                      Message.ARG_CLIENT_INDEX: client_idx,
@@ -438,6 +464,9 @@ class AsyncFedServerActor(ServerManager):
             for silo in sorted(assignments):
                 self._task(silo, assignments[silo], msg_type)
             return
+        now = time.monotonic()
+        for silo in assignments:
+            self._tasked_at[silo] = now
         self.send_many(
             msg_type, sorted(assignments),
             shared_params={Message.ARG_MODEL_PARAMS: self._host_params(),
@@ -593,6 +622,11 @@ class AsyncFedServerActor(ServerManager):
                 if crc is None:
                     crc = _payload_crc(delta)
                 self._rejected_crcs.setdefault(pair, set()).add(crc)
+                if self.degrade is not None:
+                    from fedml_tpu.robust.degrade import FaultClass
+                    self.degrade.note_fault(FaultClass.PAYLOAD,
+                                            silo=msg.sender_id,
+                                            detail=verdict.reason)
                 if self.admission.trust.state(
                         msg.sender_id, self.version) == "quarantined":
                     self._bench(msg.sender_id)
@@ -618,6 +652,14 @@ class AsyncFedServerActor(ServerManager):
                     f"invalid num_samples {raw_samples!r} "
                     f"(version {base_version})")
                 return
+        if self.degrade is not None:
+            # admitted: the task→upload latency feeds the watchdog's
+            # adaptive threshold, and any accrued debt is repaid
+            t0 = self._tasked_at.get(msg.sender_id)
+            if t0 is not None:
+                self.degrade.observe_completion(msg.sender_id,
+                                                time.monotonic() - t0)
+            self.degrade.note_accept(msg.sender_id)
         staleness = self.version - base_version
         discount = float(1.0 + staleness) ** (-self.alpha)
         self.staleness_seen.append(staleness)
@@ -699,6 +741,10 @@ class AsyncFedServerActor(ServerManager):
         seen.add(crc)
         log.warning("rejecting upload from silo %d: %s", msg.sender_id,
                     detail)
+        if self.degrade is not None:
+            from fedml_tpu.robust.degrade import FaultClass
+            self.degrade.note_fault(FaultClass.PAYLOAD,
+                                    silo=msg.sender_id, detail=detail)
         if self.health is not None:
             with self._perf_phase("health"):
                 self.health.observe_rejected(msg.sender_id, "malformed")

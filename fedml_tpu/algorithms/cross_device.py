@@ -102,7 +102,8 @@ class CrossDevice(FedAvg):
 
     def __init__(self, workload, data, config: CrossDeviceConfig,
                  mesh=None, sink=None, perf=None, health=None, slo=None,
-                 publish=None, server_opt=None, controller=None):
+                 publish=None, server_opt=None, controller=None,
+                 degrade=None):
         cfg = config
         if cfg.local_alg not in LOCAL_ALGS:
             raise ValueError(f"--local_alg must be one of {LOCAL_ALGS}, "
@@ -175,6 +176,13 @@ class CrossDevice(FedAvg):
                 "(--health): its decisions are a pure function of the "
                 "per-round drift-alarm line")
         self.controller = controller
+        # degrade: a fedml_tpu.robust.degrade.ReliabilityTracker (ISSUE
+        # 19).  The wave engine is synchronous — nothing times out — so
+        # only the participation-debt lever is live here: indebted
+        # clients (keyed client_id+1 in the tracker) claim cohort seats
+        # at the head of the next sample, and per-wave completion times
+        # feed the latency history.  None keeps sampling bit-identical.
+        self.degrade = degrade
         # seeded wave-summary poisoning, injected PRE-admission — the
         # mega-cohort path's first-class attacker (no per-silo message
         # seam exists inside a compiled wave)
@@ -278,9 +286,22 @@ class CrossDevice(FedAvg):
             key = jax.random.fold_in(
                 jax.random.fold_in(jax.random.key(cfg.seed), 0x5A4D50),
                 round_idx)
-            return np.asarray(sample_clients_jax(
+            ids = np.asarray(sample_clients_jax(
                 key, self.data.client_num, per))
-        return sample_clients(round_idx, self.data.client_num, per)
+        else:
+            ids = sample_clients(round_idx, self.data.client_num, per)
+        if self.degrade is not None:
+            # priority re-tasking (ISSUE 19): clients carrying
+            # participation debt claim the cohort head, the seeded
+            # sample fills the rest — zero debt leaves the draw
+            # untouched (bit-identical to the pre-19 schedule)
+            pri = [c - 1 for c in self.degrade.priority_clients(per)]
+            if pri:
+                from fedml_tpu.robust.degrade import merge_priority
+                ids = np.asarray(
+                    merge_priority([int(c) for c in ids], pri, per),
+                    dtype=np.int64)
+        return ids
 
     # -- lazy round machinery -------------------------------------------------
     def _ensure_bound(self, params) -> None:
@@ -356,6 +377,12 @@ class CrossDevice(FedAvg):
             self._c_waves.inc()
             self._h_wave.observe(dt)
             self._perf_phase("wave", dt)
+            if self.degrade is not None:
+                # every live client completed with the wave: feed the
+                # latency history and repay any participation debt
+                for cid in wave.ids:
+                    self.degrade.observe_completion(int(cid) + 1, dt)
+                    self.degrade.note_accept(int(cid) + 1)
             if self.perf is not None:
                 # a completed wave is this regime's "upload arrival" on
                 # the round's critical-path timeline
@@ -496,10 +523,12 @@ class CrossDevice(FedAvg):
                 # the pacing verdict for the NEXT round, from this
                 # round's health line (decided before the checkpoint so
                 # a resume continues the same trajectory)
+                kw = ({"debt": self.degrade.max_debt()}
+                      if self.degrade is not None else {})
                 decision = self.controller.decide(
                     round_idx,
                     self.health.last_line if self.health is not None
-                    else None)
+                    else None, **kw)
             round_s = time.time() - t0
             if self.perf is not None:
                 extra = dict(info)
@@ -545,6 +574,8 @@ class CrossDevice(FedAvg):
             out["srv_opt"] = self.server_opt.state_dict()
         if self.controller is not None:
             out["adapt"] = self.controller.state_dict()
+        if self.degrade is not None:
+            out["degrade"] = self.degrade.state_dict()
         return out
 
     def _extra_state_template(self, params) -> Dict[str, Any]:
@@ -559,6 +590,8 @@ class CrossDevice(FedAvg):
             out["srv_opt"] = self.server_opt.state_template()
         if self.controller is not None:
             out["adapt"] = self.controller.state_dict()
+        if self.degrade is not None:
+            out["degrade"] = self.degrade.state_dict()
         return out
 
     def _load_extra_state(self, extra) -> None:
@@ -570,3 +603,5 @@ class CrossDevice(FedAvg):
             self.server_opt.load_state_dict(extra["srv_opt"])
         if self.controller is not None and "adapt" in extra:
             self.controller.load_state_dict(extra["adapt"])
+        if self.degrade is not None and "degrade" in extra:
+            self.degrade.load_state_dict(extra["degrade"])

@@ -167,7 +167,8 @@ class FedAvgServerActor(ServerManager):
                  faultline=None,
                  shard_wire=None,
                  server_opt=None,
-                 controller=None):
+                 controller=None,
+                 degrade=None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -411,6 +412,23 @@ class FedAvgServerActor(ServerManager):
                 "(--health): its decisions are a pure function of the "
                 "per-round drift-alarm line")
         self.controller = controller
+        # degrade: a fedml_tpu.robust.degrade.ReliabilityTracker — the
+        # sustained-degradation spine (ISSUE 19): adaptive straggler
+        # deadlines from observed per-silo completion quantiles,
+        # min_quorum closure with correlated-partition holds, and
+        # network-vs-payload fault attribution (deadline drops and dead
+        # letters NEVER strike trust)
+        if degrade is not None and degrade.adaptive_deadline \
+                and round_timeout_s is None:
+            raise ValueError(
+                "adaptive_deadline requires round_timeout_s: the static "
+                "timeout is the deadline's ceiling (and the cold-start "
+                "fallback before the tracker warms)")
+        self.degrade = degrade
+        # the round's armed deadline (seconds) — derived ONCE per round
+        # at broadcast from the tracker's ledgered history, so a resumed
+        # round re-derives the same value (never recomputed on re-arms)
+        self._round_deadline_s: Optional[float] = None
         self.shard_wire = shard_wire
         if shard_wire is not None:
             if secagg is not None:
@@ -738,6 +756,25 @@ class FedAvgServerActor(ServerManager):
                 sorted(dead))
         self._round_t0 = time.monotonic()
         self._first_upload_t = None
+        self._round_deadline_s = None
+        if self.degrade is not None:
+            self.degrade.round_start(self.round_idx, self._expected)
+            # the deadline derives from history BEFORE any of this
+            # round's arrivals (including journal-restored folds below):
+            # the crashed process armed from exactly this state, so the
+            # resumed round re-derives the same value
+            self._round_deadline_s = self.degrade.deadline_s(
+                self._expected, self.round_timeout_s)
+            if resume is not None:
+                # replay the restored folds' completion latencies (they
+                # ride each accept record's extra) so the NEXT round's
+                # deadline sees the same history the crashed process did
+                for silo, _w, extra in resume.folded:
+                    lat = (extra or {}).get("lat_s")
+                    if lat is not None:
+                        self.degrade.observe_completion(int(silo),
+                                                        float(lat))
+                    self.degrade.note_accept(int(silo))
         if self.perf is not None:
             # the ledger round opens HERE: broadcast serialize is its
             # first phase, round_end closes it after publish
@@ -878,15 +915,24 @@ class FedAvgServerActor(ServerManager):
         return len(self._received) >= self._num_silos
 
     # -- straggler timer ----------------------------------------------------
+    def _effective_timeout_s(self) -> Optional[float]:
+        """The round's armed deadline: the tracker's adaptive value
+        (derived once at broadcast) when degrade is on, else the static
+        ``round_timeout_s``."""
+        if self._round_deadline_s is not None:
+            return self._round_deadline_s
+        return self.round_timeout_s
+
     def _arm_timer(self) -> None:
-        if self.round_timeout_s is None:
+        timeout = self._effective_timeout_s()
+        if timeout is None:
             return
         round_at_arm = self.round_idx
         # fire only ENQUEUES a self-message; all policy logic runs on the
         # transport's event loop, so handler state stays single-threaded
         # (SURVEY.md §5.2)
         self._timer.arm(
-            self.round_timeout_s,
+            timeout,
             lambda: self.send(MsgType.ROUND_TIMEOUT, 0,
                               **{Message.ARG_ROUND: round_at_arm}))
 
@@ -921,11 +967,67 @@ class FedAvgServerActor(ServerManager):
         # quorum over the EXPECTED (live) cohort: dead-excluded silos
         # neither count toward nor against it
         quorum = max(1, math.ceil(self.min_silo_frac * len(self._expected)))
+        if self.degrade is not None and self.straggler_policy == "drop":
+            # degrade spine (ISSUE 19): --min_quorum may RAISE the close
+            # threshold (never lower it below min_silo_frac's), and the
+            # tracker adjudicates close/hold/abandon with partition
+            # evidence (dead-letters this round, detector states)
+            floor = self.degrade.quorum_for(len(self._expected))
+            if floor is not None:
+                quorum = max(quorum, floor)
+            verdict = self.degrade.assess_timeout(
+                self.round_idx, self._expected, set(self._received), quorum,
+                detector_states=(self.failure_detector.states()
+                                 if self.failure_detector is not None
+                                 else None))
+            log.warning("round %d: degrade verdict %s", self.round_idx,
+                        verdict.as_dict())
+            if verdict.action == "hold":
+                # correlated miss with network evidence: a partition, not
+                # a mass failure — hold the round (global unchanged) and
+                # give the partition a chance to heal before folding a
+                # minority view into the global
+                self._arm_timer()
+                return
+            if verdict.action == "abandon":
+                self._abandon_partitioned_round(missing, verdict)
+                return
+            if verdict.action == "close":
+                # the dropped silos are HONEST until payload evidence
+                # says otherwise: debt accrues (priority re-task next
+                # round), the fault ledger books a network entry, and
+                # TrustTracker is never touched from here
+                for silo in missing:
+                    self.degrade.note_drop(silo)
+                self.dropped_silos.setdefault(self.round_idx, []).extend(
+                    missing)
+                self._complete_round()
+                return
+            self._arm_timer()  # below quorum: keep waiting
+            return
         if self.straggler_policy == "drop" and len(self._received) >= quorum:
             self.dropped_silos.setdefault(self.round_idx, []).extend(missing)
             self._complete_round()
             return
         self._arm_timer()  # wait (or drop below quorum): keep waiting
+
+    def _abandon_partitioned_round(self, missing, verdict) -> None:
+        """The suspected partition outlived its hold budget: abandon the
+        round LOUDLY with the global unchanged (the secagg-abandon
+        pattern) plus an explicit journal abandon record, so the resume
+        path never re-folds the minority view."""
+        log.error("round %d: abandoning after %d partition holds "
+                  "(missing=%s; %s); the global model is unchanged",
+                  self.round_idx, verdict.holds, missing, verdict.reason)
+        self._cancel_timer()
+        self.dropped_silos.setdefault(self.round_idx, []).extend(missing)
+        self._received.clear()
+        self._last_accepted = np.asarray([], np.int32)
+        if self.journal is not None:
+            with self._perf_phase("journal"):
+                self.journal.abandon(self.round_idx,
+                                     "partition: " + verdict.reason)
+        self._finish_round(0)
 
     # -- secure aggregation (secure/protocol.py) -----------------------------
     def _on_secagg_advert(self, msg: Message) -> None:
@@ -1341,6 +1443,14 @@ class FedAvgServerActor(ServerManager):
         per-leaf stacking at all.  In stream mode the upload FOLDS into
         the O(model) running aggregate here instead, and nothing
         model-sized survives the fold."""
+        # degrade spine: the arrival's round-relative latency feeds the
+        # adaptive-deadline history, and it rides the journal accept
+        # record (extra={"lat_s"}) so a resumed round replays the SAME
+        # history the crashed process observed
+        payload_rejected = entry is None
+        lat_s = (None if self._round_t0 is None
+                 else round(time.monotonic() - self._round_t0, 6))
+        lat_extra = {"lat_s": lat_s} if lat_s is not None else None
         if entry is not None and self.faultline is not None:
             # admitted, not yet folded: the crash that loses exactly
             # this one upload (its fold never happened)
@@ -1370,7 +1480,8 @@ class FedAvgServerActor(ServerManager):
                     with self._span("ingest:journal", deterministic=True), \
                             self._perf_phase("journal"):
                         self.journal.note_accept(self.round_idx, silo,
-                                                 float(entry[1]))
+                                                 float(entry[1]),
+                                                 extra=lat_extra)
                 entry = (self._STAGED, entry[1])
         elif entry is not None and self.stream_agg is not None:
             with self._span("ingest:fold", deterministic=True), \
@@ -1393,6 +1504,7 @@ class FedAvgServerActor(ServerManager):
                         self._perf_phase("journal"):
                     self.journal.note_accept(self.round_idx, silo,
                                              float(entry[1]),
+                                             extra=lat_extra,
                                              state_fn=state_fn)
             entry = (self._STAGED, entry[1])
         elif entry is not None and self._staging_active():
@@ -1412,6 +1524,20 @@ class FedAvgServerActor(ServerManager):
             # re-tasks only past it
             self.faultline.maybe_crash("post_fold_pre_ack",
                                        round_idx=self.round_idx, silo=silo)
+        if self.degrade is not None:
+            # admitted OR rejected, the silo completed the round trip:
+            # its latency is real evidence either way (an unmeasured
+            # silo would otherwise pin the deadline at the static cap)
+            if lat_s is not None:
+                self.degrade.observe_completion(silo, lat_s)
+            if entry is not None:
+                self.degrade.note_accept(silo)
+            elif payload_rejected:
+                # admission-rejected report: a PAYLOAD fault on the
+                # attribution ledger (the strike itself already landed
+                # at the admission site)
+                from fedml_tpu.robust.degrade import FaultClass
+                self.degrade.note_fault(FaultClass.PAYLOAD, silo=silo)
         self._received[silo] = entry
         if not self._barrier_met():
             return
@@ -1628,9 +1754,19 @@ class FedAvgServerActor(ServerManager):
             # the adaptive verdict for the NEXT round, decided BEFORE the
             # checkpoint thunk runs so the controller's levers land in
             # this round's boundary (a resume continues the trajectory)
+            kw = {}
+            if self.degrade is not None:
+                # composition contract (ISSUE 19): the controller may
+                # WIDEN the cohort on participation debt, but a shrink
+                # can never fight the quorum floor
+                kw["debt"] = self.degrade.max_debt()
+                qf = self.degrade.quorum_for(self._num_silos)
+                if qf is not None:
+                    kw["quorum_floor"] = qf
             decision = self.controller.decide(
                 self.round_idx,
-                self.health.last_line if self.health is not None else None)
+                self.health.last_line if self.health is not None else None,
+                **kw)
 
         if self.faultline is not None:
             # the aggregate is applied in memory but not yet durable:
@@ -1674,6 +1810,10 @@ class FedAvgServerActor(ServerManager):
             if decision is not None:
                 # every pacing decision named on the round's ledger line
                 extra["adapt"] = decision.as_ledger()
+            if self.degrade is not None:
+                # every degrade decision named on the round's ledger
+                # line: deadline, accepts/drops, holds, fault mix
+                extra["degrade"] = self.degrade.as_ledger()
             self.perf.round_end(self.round_idx, quorum=quorum,
                                 dropped=len(self.dropped_silos.get(
                                     self.round_idx, [])), **extra)

@@ -65,17 +65,24 @@ class Partition:
 
     ``after_round``: drop messages whose round tag (Message.ARG_ROUND)
     is >= this value — models a silo that dies at a known round.
+    ``until_round``: upper bound for ``after_round`` — cut only rounds
+    in ``[after_round, until_round)``, modelling a transient split that
+    heals at a KNOWN round.  Round-space windows are deterministic under
+    chaos-induced wall-time variance (a wall-clock ``window_s`` can
+    drift past the rounds it meant to hit when an earlier round stalls).
     ``window_s``: (start, end) seconds relative to ChaosTransport
     creation — models a transient mid-round network split.  A message
     is cut if it matches EITHER active criterion.
     """
     after_round: Optional[int] = None
+    until_round: Optional[int] = None
     window_s: Optional[Tuple[float, float]] = None
 
     def cuts(self, msg: Message, elapsed_s: float) -> bool:
         if self.after_round is not None:
             r = msg.get(Message.ARG_ROUND)
-            if r is not None and r >= self.after_round:
+            if r is not None and r >= self.after_round and (
+                    self.until_round is None or r < self.until_round):
                 return True
         if self.window_s is not None:
             t0, t1 = self.window_s

@@ -90,11 +90,18 @@ class ResilientTransport(Transport):
                  max_in_flight: int = 256,
                  on_dead_letter: Optional[
                      Callable[[Message, Exception], None]] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 fault_feed: Optional[Callable[[str, Message], None]] = None):
         # no super().__init__(): observers belong to the inner transport
         self.inner = inner
         self.policy = policy if policy is not None else RetryPolicy()
         self.on_dead_letter = on_dead_letter
+        # fault_feed(reason, msg): the reliability tracker's attribution
+        # feed (robust/degrade) — ALWAYS called on a dead letter, in
+        # addition to on_dead_letter/log, so dead letters classify as
+        # network faults (partition evidence, never a trust strike) even
+        # when a caller installed its own drop handler
+        self.fault_feed = fault_feed
         self._rng = np.random.RandomState(seed)
         self._queue: "queue.Queue" = queue.Queue(maxsize=max_in_flight)
         self._stopped = False
@@ -107,7 +114,10 @@ class ResilientTransport(Transport):
         reg = telemetry.get_registry()
         self._m_ok = reg.counter("fedml_comm_send_ok_total")
         self._m_retry = reg.counter("fedml_comm_send_retries_total")
-        self._m_dead = reg.counter("fedml_comm_dead_letter_total")
+        # fedml_comm_dead_letter_total{reason} registers LAZILY on the
+        # first dead letter of each reason (the PR 6 no-fabricated-0
+        # contract: a healthy run exports no dead-letter series at all)
+        self._m_dead_by_reason: dict = {}
         self._sender = threading.Thread(target=self._drain, daemon=True,
                                         name="resilient-sender")
         self._sender.start()
@@ -140,9 +150,32 @@ class ResilientTransport(Transport):
                 f"in-flight queue full ({self._queue.maxsize}); "
                 f"dropping {msg!r}"))
 
+    @staticmethod
+    def _dead_letter_reason(exc: Exception) -> str:
+        """The dead letter's labeled reason — a closed, low-cardinality
+        set (each reason is one labeled series)."""
+        if isinstance(exc, SendDeadlineExceeded):
+            return "deadline"
+        if isinstance(exc, SendQueueFull):
+            return "queue_full"
+        if isinstance(exc, RuntimeError) and "transport stopped" in str(exc):
+            return "stopped"
+        return "send_failed"
+
     def _dead_letter(self, msg: Message, exc: Exception) -> None:
         self.dead_letters += 1
-        self._m_dead.inc()
+        reason = self._dead_letter_reason(exc)
+        c = self._m_dead_by_reason.get(reason)
+        if c is None:
+            c = telemetry.get_registry().counter(
+                "fedml_comm_dead_letter_total", reason=reason)
+            self._m_dead_by_reason[reason] = c
+        c.inc()
+        if self.fault_feed is not None:
+            try:
+                self.fault_feed(reason, msg)
+            except Exception:  # noqa: BLE001 — attribution must not kill
+                log.exception("dead-letter fault_feed raised")
         if self.on_dead_letter is not None:
             self.on_dead_letter(msg, exc)
         else:

@@ -188,6 +188,30 @@ class ExperimentConfig:
     #                                   ResilientTransport with this many
     #                                   send attempts (backoff + jitter +
     #                                   reconnect between attempts)
+    # ---- sustained degradation (fedml_tpu/robust/degrade.py, ISSUE 19) -
+    min_quorum: float = 0.0           # >0: quorum-aware closure — the
+    #                                   deadline may close the round only
+    #                                   once ceil(frac*expected) silos
+    #                                   folded (raises the drop-policy
+    #                                   quorum, never lowers it); needs
+    #                                   --straggler_policy drop
+    adaptive_deadline: bool = False   # arm the straggler timer from the
+    #                                   observed per-silo completion
+    #                                   quantile (p90 * slack) instead of
+    #                                   the static --round_timeout_s
+    #                                   (which stays the ceiling and the
+    #                                   cold-start fallback)
+    deadline_floor_s: float = 0.5     # adaptive deadline lower clamp
+    deadline_quantile: float = 0.9    # completion quantile the deadline
+    #                                   derives from
+    deadline_slack: float = 1.5       # deadline = quantile * slack
+    partition_frac: float = 0.0       # >0: a deadline miss of at least
+    #                                   this cohort fraction WITH network
+    #                                   evidence (dead-letters / detector
+    #                                   suspects) HOLDS the round instead
+    #                                   of folding a minority view
+    partition_max_holds: int = 3      # holds before the round abandons
+    #                                   loudly (global unchanged)
     wire_compression: str = "none"    # cross_silo uploads: none|topk|int8
     topk_frac: float = 0.1            # topk: fraction of entries kept
     error_feedback: bool = False      # carry the compression residual into

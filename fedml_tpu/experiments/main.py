@@ -253,6 +253,72 @@ def _make_controller(cfg: ExperimentConfig, *, cohort, epochs,
         patience=cfg.adapt_patience, epochs_live=epochs_live)
 
 
+def _degrade_setup(cfg: ExperimentConfig, n_silos: int,
+                   mode: str = "sync"):
+    """The sustained-degradation spine (--min_quorum /
+    --adaptive_deadline / --partition_frac → robust/degrade.py,
+    ISSUE 19), with fail-loud config gates: every misconfiguration is a
+    NAMED error at startup, never a silently-ignored flag.  ``mode``:
+    "sync" (cross_silo round barrier), "async" (the watchdog is the
+    deadline analog; barrier flags are refused by name)."""
+    wanted = (cfg.min_quorum > 0 or cfg.adaptive_deadline
+              or cfg.partition_frac > 0)
+    if not wanted:
+        return None
+    if not 0.0 < cfg.min_quorum <= 1.0 and cfg.min_quorum != 0.0:
+        raise ValueError(
+            f"--min_quorum must be in (0, 1] (a cohort fraction), got "
+            f"{cfg.min_quorum}")
+    if mode == "async":
+        if cfg.min_quorum > 0 or cfg.partition_frac > 0:
+            raise ValueError(
+                "--min_quorum/--partition_frac adjudicate the sync round "
+                "barrier; the async server has no barrier to close — "
+                "only --adaptive_deadline (the watchdog analog) applies")
+        if not cfg.retask_timeout_s:
+            raise ValueError(
+                "--adaptive_deadline under --algo async_fl adapts the "
+                "re-task watchdog and needs --retask_timeout_s > 0 (the "
+                "ceiling and cold-start fallback)")
+    elif mode == "sync":
+        if cfg.straggler_policy != "drop":
+            raise ValueError(
+                "--min_quorum/--adaptive_deadline/--partition_frac "
+                "adjudicate the close-early deadline, which only the "
+                "'drop' straggler policy has; use --straggler_policy "
+                "drop (wait never closes early, abort never degrades "
+                "gracefully)")
+        if (cfg.adaptive_deadline or cfg.partition_frac > 0) \
+                and not cfg.round_timeout_s:
+            raise ValueError(
+                "--adaptive_deadline/--partition_frac need "
+                "--round_timeout_s > 0: the static timeout is the "
+                "deadline's ceiling and the cold-start fallback, and "
+                "without a timer the deadline can never fire")
+    if cfg.partition_frac > 0 and not 0.0 < cfg.partition_frac <= 1.0:
+        raise ValueError(
+            f"--partition_frac must be in (0, 1] (a cohort fraction), "
+            f"got {cfg.partition_frac}")
+    if cfg.partition_frac > 0 and cfg.min_quorum > 0 \
+            and cfg.partition_frac > 1.0 - cfg.min_quorum + 1e-9:
+        raise ValueError(
+            f"--partition_frac {cfg.partition_frac} exceeds the quorum "
+            f"gap 1 - min_quorum = {1.0 - cfg.min_quorum:.3f}: a miss "
+            f"that large already blocks the quorum, so the partition "
+            f"hold would be unreachable dead code — lower "
+            f"--partition_frac or --min_quorum")
+    from fedml_tpu.robust.degrade import ReliabilityTracker
+    return ReliabilityTracker(
+        n_silos,
+        min_quorum=cfg.min_quorum,
+        adaptive_deadline=cfg.adaptive_deadline,
+        deadline_floor_s=cfg.deadline_floor_s,
+        deadline_quantile=cfg.deadline_quantile,
+        deadline_slack=cfg.deadline_slack,
+        partition_frac=cfg.partition_frac,
+        partition_max_holds=cfg.partition_max_holds)
+
+
 def _make_slo(cfg: ExperimentConfig):
     """SLO evaluator over the telemetry registry (obs/perf.py) backing
     the serve frontend's ``/healthz?deep=1``; ``--slo`` overrides the
@@ -907,8 +973,15 @@ def run_async_fl(cfg, data, mesh, sink):
     srv_opt_extra = None
     if server_opt is not None:
         srv_opt_extra = (server_opt.state_dict, server_opt.load_state_dict)
+    # the degrade tracker's async role (ISSUE 19): the observed
+    # task→upload latency adapts the re-task watchdog's quiet threshold
+    degrade = _degrade_setup(cfg, n_silos, mode="async")
+    degrade_extra = None
+    if degrade is not None:
+        degrade_extra = (degrade.state_dict, degrade.load_state_dict)
     extra_state = _compose_extra_state([("trust", trust_extra),
-                                        ("srv_opt", srv_opt_extra)])
+                                        ("srv_opt", srv_opt_extra),
+                                        ("degrade", degrade_extra)])
 
     hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
     server = AsyncFedServerActor(
@@ -921,7 +994,7 @@ def run_async_fl(cfg, data, mesh, sink):
         admission=admission, defended_aggregate=defended,
         stream_agg=stream, perf=perf, health=health,
         extra_state=extra_state, journal=_make_journal(cfg),
-        server_opt=server_opt)
+        server_opt=server_opt, degrade=degrade)
     server.register_handlers()
     silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
                                encode_upload=delta_encoder)
@@ -1309,6 +1382,11 @@ def run_cross_silo(cfg, data, mesh, sink):
     controller = _make_controller(
         cfg, cohort=(n_edges if n_edges > 0 else n_silos),
         epochs=cfg.epochs)
+    # the sustained-degradation spine (ISSUE 19): per-silo reliability
+    # tracking drives the adaptive deadline, the quorum-aware close, and
+    # network-vs-payload fault attribution; under the edge topology the
+    # root's cohort IS the edge tier, so the tracker sizes to it
+    degrade = _degrade_setup(cfg, n_edges if n_edges > 0 else n_silos)
 
     # round-checkpoint extra state, composed by name: silo-side EF
     # residuals (PR 3) + the admission trust ledger (ISSUE 12 — a
@@ -1341,11 +1419,18 @@ def run_cross_silo(cfg, data, mesh, sink):
         srv_opt_extra = (server_opt.state_dict, server_opt.load_state_dict)
     if controller is not None:
         adapt_extra = (controller.state_dict, controller.load_state_dict)
+    degrade_extra = None
+    if degrade is not None:
+        # the reliability history rides the round checkpoint: a resumed
+        # server re-derives the SAME adaptive deadline and quorum
+        # verdict the crashed process would have (ISSUE 19 determinism)
+        degrade_extra = (degrade.state_dict, degrade.load_state_dict)
     extra_state = _compose_extra_state([("ef", ef_extra),
                                         ("trust", trust_extra),
                                         ("shard", shard_extra),
                                         ("srv_opt", srv_opt_extra),
-                                        ("adapt", adapt_extra)])
+                                        ("adapt", adapt_extra),
+                                        ("degrade", degrade_extra)])
     journal = _make_journal(cfg)
 
     def make_server(transport):
@@ -1365,7 +1450,8 @@ def run_cross_silo(cfg, data, mesh, sink):
             stream_agg=stream, perf=perf, health=health,
             secagg=secagg_root, journal=journal,
             shard_wire=shard_spine,
-            server_opt=server_opt, controller=controller)
+            server_opt=server_opt, controller=controller,
+            degrade=degrade)
         s.register_handlers()
         return s
 
@@ -1548,7 +1634,15 @@ def run_cross_silo(cfg, data, mesh, sink):
                                                       RetryPolicy)
                 transport = ResilientTransport(
                     transport, RetryPolicy(max_attempts=cfg.silo_retries),
-                    seed=cfg.seed)
+                    seed=cfg.seed,
+                    # the server's dead letters are NETWORK evidence for
+                    # the degrade tracker's partition discrimination —
+                    # routed by reason, never a trust strike
+                    fault_feed=(
+                        (lambda reason, msg:
+                         degrade.note_dead_letter(reason))
+                        if degrade is not None and cfg.node_id == 0
+                        else None))
             if cfg.node_id == 0:
                 server = make_server(transport)
                 server.start()

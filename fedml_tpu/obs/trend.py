@@ -559,6 +559,171 @@ def validate_opt_bench(obj: dict, allow_smoke: bool = True) -> List[str]:
     return problems
 
 
+def validate_degrade_bench(obj: dict, allow_smoke: bool = True) -> List[str]:
+    """Schema + honesty check for ``BENCH_degrade.json`` v1 (ISSUE 19):
+    the sustained-degradation soak's committed survivability contract.
+    The soak SCRIPT (scripts/degrade_soak.py) enforces the gates at
+    measurement time; this validates an artifact still carries PASSING
+    verdicts — and RE-DERIVES the headline claims from the committed
+    per-round rows rather than trusting the summary numbers:
+
+    * ZERO network- or unknown-attributed trust strikes (the fault
+      attribution invariant — flaky links never look Byzantine);
+    * the adaptive deadline undercuts the static timeout cap on >= 80%%
+      of warm rounds (rounds past ``warmup_rounds``), and round
+      wall-clock tracks it (wall <= deadline + slack on those rounds);
+    * bounded starvation — no honest silo's rounds-since-last-accept
+      ever exceeded the stated bound (debt-priority re-tasking works);
+    * the degraded arm's final global lands within the stated tolerance
+      of the chaos-free clean arm;
+    * zero recompiles after warmup under ``--perf_strict`` on every
+      measured arm;
+    * the mid-soak kill resumed to the SAME derived deadline (the
+      deadline is a pure function of ledgered history).
+
+    ``allow_smoke=False`` (the committed-trend-line mode —
+    ``perf_trend.py --degrade_bench``) rejects smoke-labeled artifacts
+    outright."""
+    problems: List[str] = []
+    if not isinstance(obj, dict):
+        return ["degrade bench is not a JSON object"]
+    if obj.get("bench") != "degrade":
+        problems.append(f"bench != 'degrade' (got {obj.get('bench')!r})")
+    if obj.get("version") != 1:
+        problems.append(f"version != 1 (got {obj.get('version')!r})")
+    smoke = bool(obj.get("smoke"))
+    if smoke and not allow_smoke:
+        problems.append("smoke-labeled artifact on the committed trend "
+                        "line (smoke runs carry relaxed scale and belong "
+                        "in /tmp, never committed)")
+    arms = obj.get("arms")
+    if not isinstance(arms, dict):
+        return problems + ["no arms section"]
+    for req in ("clean", "static", "degrade"):
+        if req not in arms or not isinstance(arms[req], dict):
+            problems.append(f"missing arm {req!r} (needs clean, static "
+                            f"and degrade)")
+    for aname, arm in arms.items():
+        if isinstance(arm, dict) and arm.get("backend") not in (
+                "cpu", "gpu", "tpu"):
+            problems.append(f"arm {aname!r}: no honest backend label "
+                            f"(got {arm.get('backend')!r})")
+    gates = obj.get("gates")
+    if not isinstance(gates, dict) or not gates:
+        problems.append("no recorded gate verdicts")
+        gates = {}
+    for gname, verdict in gates.items():
+        if not isinstance(verdict, dict) or "ok" not in verdict:
+            problems.append(f"gate {gname!r} without an ok verdict")
+        elif not verdict["ok"]:
+            problems.append(f"gate {gname!r} FAILED ({verdict})")
+    deg = arms.get("degrade")
+    if not isinstance(deg, dict):
+        return problems
+    # -- attribution invariant: re-derive from the committed totals -----
+    sft = deg.get("strike_fault_totals")
+    if not isinstance(sft, dict):
+        problems.append("degrade arm: no strike_fault_totals — the "
+                        "zero-network-strikes claim cannot be re-derived")
+    else:
+        for cls in ("network", "unknown"):
+            if sft.get(cls, 0) != 0:
+                problems.append(
+                    f"degrade arm: {sft[cls]} {cls}-attributed trust "
+                    f"strike(s) — connectivity faults must NEVER strike")
+    # -- recompile silence on every measured arm ------------------------
+    for aname in ("static", "degrade"):
+        arm = arms.get(aname)
+        if isinstance(arm, dict) \
+                and arm.get("recompiles_after_warmup", 0) != 0:
+            problems.append(
+                f"arm {aname!r}: {arm['recompiles_after_warmup']} "
+                f"recompiles after warmup under --perf_strict")
+    if smoke:
+        return problems   # relaxed scale: too few rounds to re-derive
+    # -- adaptive deadline vs the static cap, from the raw rows ---------
+    cap = obj.get("round_timeout_s")
+    warmup = int(obj.get("warmup_rounds", 0) or 0)
+    rows = deg.get("rounds")
+    if not (isinstance(rows, list) and rows
+            and all(isinstance(r, dict) for r in rows)):
+        problems.append("degrade arm: no committed per-round rows")
+    elif isinstance(cap, (int, float)):
+        warm = [r for r in rows
+                if isinstance(r.get("round"), int)
+                and r["round"] >= warmup
+                and isinstance(r.get("deadline_s"), (int, float))]
+        if not warm:
+            problems.append(f"degrade arm: no warm rounds past "
+                            f"warmup_rounds={warmup} carry a derived "
+                            f"deadline")
+        else:
+            thr = float(gates.get("adaptive_beats_static", {})
+                        .get("threshold", 0.8))
+            under = sum(1 for r in warm if r["deadline_s"] < float(cap))
+            frac = under / len(warm)
+            if frac < thr:
+                problems.append(
+                    f"adaptive deadline < static cap {cap}s on only "
+                    f"{frac:.0%} of {len(warm)} warm rounds "
+                    f"(claim needs >= {thr:.0%})")
+            slack = float(gates.get("deadline_tracks_wall", {})
+                          .get("slack_s", 0.5))
+            # partition-hold rounds legitimately exceed the deadline
+            # (bounded by partition_max_holds) — excluded from tracking
+            nohold = [r for r in warm if not r.get("holds")]
+            tracked = sum(1 for r in nohold
+                          if isinstance(r.get("wall_s"), (int, float))
+                          and r["wall_s"] <= r["deadline_s"] + slack)
+            if nohold and tracked / len(nohold) < thr:
+                problems.append(
+                    f"round wall-clock within deadline+{slack}s on only "
+                    f"{tracked}/{len(nohold)} warm hold-free rounds — the "
+                    f"adaptive deadline is not tracking real round cost")
+    else:
+        problems.append("no round_timeout_s (static cap) committed — "
+                        "the adaptive-beats-static claim cannot be "
+                        "re-derived")
+    # -- bounded starvation, from the committed per-silo maxima ---------
+    starve = deg.get("max_rounds_since_accept")
+    bound = gates.get("bounded_starvation", {}).get("bound")
+    if not isinstance(starve, dict) or not starve:
+        problems.append("degrade arm: no max_rounds_since_accept — the "
+                        "bounded-starvation claim cannot be re-derived")
+    elif isinstance(bound, (int, float)):
+        for silo, worst in starve.items():
+            if worst > bound:
+                problems.append(
+                    f"honest silo {silo} went {worst} rounds without an "
+                    f"accepted upload (bound {bound})")
+    # -- convergence vs the chaos-free clean arm ------------------------
+    delta = deg.get("final_delta_vs_clean")
+    tol = gates.get("convergence_vs_clean", {}).get("tolerance")
+    if not isinstance(delta, (int, float)):
+        problems.append("degrade arm: no final_delta_vs_clean")
+    elif isinstance(tol, (int, float)) and delta > tol:
+        problems.append(f"degraded final global {delta} from the clean "
+                        f"arm (tolerance {tol})")
+    # -- the kill re-derived the SAME deadline --------------------------
+    res = deg.get("resume")
+    if not isinstance(res, dict):
+        problems.append("degrade arm: no resume section — the mid-soak "
+                        "kill + deadline-determinism claim is missing")
+    else:
+        pre, post = res.get("deadline_pre_kill"), \
+            res.get("deadline_post_resume")
+        if not (isinstance(pre, (int, float))
+                and isinstance(post, (int, float))):
+            problems.append("degrade arm resume: deadline_pre_kill / "
+                            "deadline_post_resume not both recorded")
+        elif abs(pre - post) > 1e-9:
+            problems.append(
+                f"resumed round re-derived deadline {post}s != {pre}s "
+                f"pre-kill — the deadline is not a pure function of "
+                f"ledgered history")
+    return problems
+
+
 def phase_medians(rows: List[dict],
                   skip_first: bool = True) -> Dict[str, float]:
     """Median per-phase seconds across the ledger (plus ``round_s``).
@@ -740,15 +905,26 @@ def main(argv=None) -> int:
                         "ratio >= 1.5, final accuracy not worse, zero "
                         "recompiles after warmup, controller decisions "
                         "on every optimizer-arm round")
+    p.add_argument("--degrade_bench", default=None,
+                   help="BENCH_degrade.json (v1) to validate: clean/"
+                        "static/degrade arms present with honest backend "
+                        "labels, passing gate verdicts, and the headline "
+                        "claims RE-DERIVED from the committed per-round "
+                        "rows — zero network-attributed strikes, "
+                        "adaptive deadline < static cap on >= 80%% of "
+                        "warm rounds, bounded honest-silo starvation, "
+                        "final global within tolerance of the clean "
+                        "arm, zero recompiles after warmup, and the "
+                        "mid-soak kill re-deriving the same deadline")
     args = p.parse_args(argv)
     if args.ledger is None and not args.lint_mfu \
             and args.health_ledger is None and args.serve_bench is None \
             and args.release_bench is None and args.ingest_bench is None \
-            and args.opt_bench is None:
+            and args.opt_bench is None and args.degrade_bench is None:
         p.print_usage()
         print("perf_trend: nothing to do (pass --ledger, --health_ledger, "
               "--serve_bench, --release_bench, --ingest_bench, "
-              "--opt_bench and/or --lint_mfu)")
+              "--opt_bench, --degrade_bench and/or --lint_mfu)")
         return 2
 
     failures: List[str] = []
@@ -901,6 +1077,24 @@ def main(argv=None) -> int:
                            for a in wl.get("arms", {}) if a != "plain"})
             print(f"opt bench: {len(wls)} workload(s) green "
                   f"(optimizer arms: {arms})")
+
+    if args.degrade_bench is not None:
+        try:
+            with open(args.degrade_bench) as f:
+                degrade_obj = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"perf_trend: cannot read degrade bench: {e}")
+            return 2
+        # committed-trend-line mode: a smoke artifact must not anchor it
+        problems = validate_degrade_bench(degrade_obj, allow_smoke=False)
+        failures += [f"degrade bench: {x}" for x in problems]
+        if not problems:
+            deg = degrade_obj.get("arms", {}).get("degrade", {})
+            sft = deg.get("strike_fault_totals", {})
+            print(f"degrade bench: 3 arm(s) green "
+                  f"({len(deg.get('rounds') or [])} degraded rounds, "
+                  f"strikes by fault {sft}, final delta vs clean "
+                  f"{deg.get('final_delta_vs_clean')})")
 
     if args.lint_mfu:
         paths = _expand(args.lint_mfu)

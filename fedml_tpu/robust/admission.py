@@ -42,6 +42,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from fedml_tpu.obs import telemetry
+from fedml_tpu.robust.degrade import FaultClass
 
 log = logging.getLogger(__name__)
 
@@ -221,6 +222,13 @@ class TrustTracker:
         self._strikes: Dict[int, int] = {}
         self._quarantine_until: Dict[int, int] = {}   # silo -> first free round
         self._probation_left: Dict[int, int] = {}
+        # per-silo strike counts BY ATTRIBUTION CLASS (ISSUE 19): the
+        # invariant above means only the payload column can ever be
+        # nonzero, but the full matrix rides state_dict so the claim
+        # "zero network-attributed strikes" survives a crash and is
+        # auditable from any checkpoint
+        self._strike_faults: Dict[str, Dict[int, int]] = {
+            c: {} for c in FaultClass.ALL}
         self.events: Deque[Tuple[int, int, str]] = collections.deque(
             maxlen=events_window)
         reg = telemetry.get_registry()
@@ -248,8 +256,29 @@ class TrustTracker:
             return self.PROBATION
         return self.TRUSTED
 
-    def strike(self, silo: int, round_idx: int, reason: str) -> bool:
-        """Record a strike; returns True when this strike QUARANTINES."""
+    def strike(self, silo: int, round_idx: int, reason: str,
+               fault: str = FaultClass.PAYLOAD) -> bool:
+        """Record a strike; returns True when this strike QUARANTINES.
+
+        ``fault`` is the ISSUE 19 attribution class, and the hard
+        invariant lives HERE, at the one strike call site: only
+        ``payload`` verdicts may strike.  A ``network`` or ``unknown``
+        fault reaching this method is a programming error — network
+        failures (dead letters, deadline drops, partitions) belong to
+        the reliability tracker (`robust/degrade.ReliabilityTracker`),
+        never to the trust ledger, or a chaotic link could walk an
+        honest silo into Byzantine quarantine."""
+        if fault not in FaultClass.ALL:
+            raise ValueError(f"unknown fault class {fault!r}; the "
+                             f"vocabulary is closed: {FaultClass.ALL}")
+        if fault != FaultClass.PAYLOAD:
+            raise ValueError(
+                f"only payload-attributed verdicts may strike trust "
+                f"(got fault={fault!r}, reason={reason!r}, silo={silo}) "
+                f"— route network/unknown faults to the reliability "
+                f"tracker instead (ISSUE 19 attribution invariant)")
+        self._strike_faults[fault][silo] = \
+            self._strike_faults[fault].get(silo, 0) + 1
         self._c_strikes.inc()
         state = self.state(silo, round_idx)
         if state == self.QUARANTINED:
@@ -300,13 +329,27 @@ class TrustTracker:
                 else:
                     log.warning("trust state_dict: silo %d outside 1..%d "
                                 "not persisted", silo, n_silos)
+        # [n_silos, |FaultClass.ALL|] strike counts by attribution class
+        # (ISSUE 19): column order is FaultClass.ALL
+        strike_reasons = np.zeros((n_silos, len(FaultClass.ALL)), np.int64)
+        for col, cls in enumerate(FaultClass.ALL):
+            for silo, v in self._strike_faults[cls].items():
+                if 1 <= silo <= n_silos:
+                    strike_reasons[silo - 1, col] = int(v)
         return {"strikes": strikes, "quarantine_until": until,
-                "probation_left": probation}
+                "probation_left": probation,
+                "strike_reasons": strike_reasons}
 
     def load_state_dict(self, state) -> None:
         """Restore a `state_dict` snapshot (resume path): sentences and
         probation clocks continue from where the crashed process left
-        them — a quarantined attacker stays jailed."""
+        them — a quarantined attacker stays jailed.
+
+        ``strike_reasons`` restores tolerantly: a pre-19 snapshot
+        carries no attribution matrix, and a foreign-shape one (the
+        fault vocabulary or silo count changed across the restart)
+        cannot be mapped — both accept with a warning (counts restart
+        at zero) instead of refusing the resume."""
         strikes = np.asarray(state["strikes"])
         until = np.asarray(state["quarantine_until"])
         probation = np.asarray(state["probation_left"])
@@ -316,6 +359,23 @@ class TrustTracker:
                                   for i, v in enumerate(until) if v >= 0}
         self._probation_left = {i + 1: int(v)
                                 for i, v in enumerate(probation) if v > 0}
+        self._strike_faults = {c: {} for c in FaultClass.ALL}
+        sr = state.get("strike_reasons") if hasattr(state, "get") else None
+        if sr is None:
+            log.warning("trust snapshot carries no strike_reasons (pre-19 "
+                        "checkpoint); attribution counts restart at zero")
+            return
+        sr = np.asarray(sr)
+        if sr.ndim != 2 or sr.shape[1] != len(FaultClass.ALL):
+            log.warning("trust snapshot strike_reasons shape %s does not "
+                        "match the %d-class fault vocabulary; attribution "
+                        "counts restart at zero", sr.shape,
+                        len(FaultClass.ALL))
+            return
+        for col, cls in enumerate(FaultClass.ALL):
+            for i in range(sr.shape[0]):
+                if sr[i, col] > 0:
+                    self._strike_faults[cls][i + 1] = int(sr[i, col])
 
     def quarantined(self, round_idx: int, silos=None) -> set:
         """The silos serving quarantine at ``round_idx`` (sweeps states,
@@ -327,6 +387,12 @@ class TrustTracker:
                if self.state(s, round_idx) == self.QUARANTINED}
         self._g_quarantined.set(len(out))
         return out
+
+    def strike_fault_totals(self) -> Dict[str, int]:
+        """Lifetime strike count per attribution class (the soak's
+        zero-network-strikes invariant reads this)."""
+        return {c: sum(self._strike_faults[c].values())
+                for c in FaultClass.ALL}
 
 
 @dataclasses.dataclass

@@ -113,23 +113,34 @@ class AdaptiveController:
         sev = val / thr if thr > 0 else 0.0
         return not a.get("ok", True), sev
 
-    def decide(self, round_idx: int, health_line: Optional[dict]) \
+    def decide(self, round_idx: int, health_line: Optional[dict], *,
+               debt: int = 0, quorum_floor: Optional[int] = None) \
             -> Decision:
         """The verdict for the NEXT round, from THIS round's health
         line.  Pure in (controller state, line); mutates only the
-        controller's own levers."""
+        controller's own levers.
+
+        ``debt``/``quorum_floor`` are the degrade spine's composition
+        hooks (ISSUE 19): outstanding participation debt widens the
+        cohort like a starvation alarm (the deadline-dropped honest
+        silos need seats to repay it), and a downward cohort move is
+        clamped at the quorum floor — the controller NEVER fights the
+        quorum.  The defaults keep every pre-19 trajectory
+        bit-identical."""
         reasons: List[str] = []
         misaligned, mis_sev = self._alarm(health_line,
                                           "alignment_collapse")
         blowup, _ = self._alarm(health_line, "norm_variance_blowup")
         starved, _ = self._alarm(health_line,
                                  "participation_starvation")
-        fired = misaligned or blowup or starved
+        indebted = int(debt) > 0
+        fired = misaligned or blowup or starved or indebted
         if fired:
             self.calm = 0
-            if misaligned or starved:
-                why = "alignment_collapse" if misaligned \
-                    else "participation_starvation"
+            if misaligned or starved or indebted:
+                why = ("alignment_collapse" if misaligned
+                       else "participation_starvation" if starved
+                       else f"participation_debt[{int(debt)}]")
                 grown = min(self.max_cohort,
                             self.cohort
                             + max(1, math.ceil(self.cohort * self.GROW)))
@@ -171,6 +182,11 @@ class AdaptiveController:
                         else "[pinned:static-shape]"))
             else:
                 reasons.append("hold")
+        if quorum_floor is not None and self.cohort < int(quorum_floor):
+            # never fight the quorum: a cohort smaller than the close
+            # threshold could never fold a round
+            self.cohort = int(quorum_floor)
+            reasons.append(f"quorum_floor:cohort->{self.cohort}")
         if not reasons:
             reasons.append("hold")
         self.decisions += 1

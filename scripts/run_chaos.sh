@@ -25,6 +25,14 @@ env JAX_PLATFORMS=cpu python scripts/wire_bench.py --smoke \
 env JAX_PLATFORMS=cpu python scripts/soak.py --smoke \
     --out /tmp/soak_smoke.json
 
+# sustained-degradation arm (ISSUE 19): the degrade spine (adaptive
+# deadlines, quorum holds, fault attribution) under flapping links, a
+# round-bounded partition, and a mid-soak kill+respawn.  Smoke output
+# goes to /tmp — the committed BENCH_degrade.json is the full soak's
+# artifact and perf_trend.py --degrade_bench refuses smoke labels.
+env JAX_PLATFORMS=cpu python scripts/degrade_soak.py --smoke \
+    --out /tmp/bench_degrade_smoke.json
+
 exec env JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_resilient.py tests/test_recovery.py \
     tests/test_robust_round.py tests/test_wire.py \

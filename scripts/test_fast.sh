@@ -48,5 +48,9 @@ grep -q "fused=True" tests/test_shard_spine.py  # fused-finalize parity too
 # plain bit-identity, sharded state round-trip, crash kill->resume with
 # optimizer slots, controller determinism, config-gate matrix
 [ -f tests/test_server_opt.py ]
+# ISSUE 19 sustained-degradation spine: adaptive deadline determinism,
+# quorum/partition verdict matrix, the payload-only strike invariant,
+# dead-letter attribution, and the resume-path straggler-timer audit
+[ -f tests/test_degrade.py ]
 exec python -m pytest tests/ -m "not slow" -q \
   -n "${WORKERS:-auto}" --dist loadfile "$@"

@@ -190,6 +190,16 @@ EXPECTED = {
     "fedml_adapt_epochs_value",
     "fedml_adapt_wave_value",
     "fedml_adapt_decisions_total",
+    # PR 19: the sustained-degradation spine (robust/degrade.py): the
+    # adaptive deadline, participation-debt / phi-suspicion gauges, the
+    # partition hold/deadline-drop counters, and the fault-attribution
+    # ledger labeled by the closed FaultClass vocabulary
+    "fedml_degrade_deadline_seconds",
+    "fedml_degrade_debt_max_value",
+    "fedml_degrade_suspicion_max_value",
+    "fedml_degrade_holds_total",
+    "fedml_degrade_drops_total",
+    "fedml_degrade_faults_total",
 }
 
 

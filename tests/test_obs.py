@@ -194,7 +194,9 @@ def test_dead_letter_counter_on_exhaustion(obs):
     rt.send_message(Message("t", 0, 1).add("v", 1))
     _drain(rt, lambda: rt.dead_letters)
     snap = reg.snapshot()["counters"]
-    assert snap["fedml_comm_dead_letter_total"] == 1
+    # ISSUE 19: dead letters are labeled by reason (lazy registration —
+    # the series exists only because this dead letter happened)
+    assert snap['fedml_comm_dead_letter_total{reason="send_failed"}'] == 1
     assert snap["fedml_comm_send_retries_total"] == 2  # attempts 1..2 retried
 
 
