@@ -34,6 +34,7 @@ from __future__ import annotations
 import collections
 import logging
 import math
+import threading
 import time
 import zlib
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -102,7 +103,8 @@ class AsyncFedServerActor(ServerManager):
                  journal=None,
                  faultline=None,
                  server_opt=None,
-                 degrade=None):
+                 degrade=None,
+                 ingest=None):
         """``checkpointer``: a `RoundCheckpointer`; every applied version
         is saved per its ``save_every`` gating and ``start()`` resumes
         from the latest saved version — a crashed async server restarts
@@ -185,7 +187,17 @@ class AsyncFedServerActor(ServerManager):
 
         ``faultline``: a `fedml_tpu.robust.faultline.Faultline` — the
         seeded process-kill injector (test/soak only); the version loop
-        is threaded with the named crash points."""
+        is threaded with the named crash points.
+
+        ``ingest``: a `fedml_tpu.comm.ingest.IngestPipeline`
+        (``--ingest_pipeline``) — the transport thread only checks the
+        version window and the queued-duplicate set, then enqueues; the
+        single fold worker runs screen → fold → buffer in FIFO order
+        (arrival order — the async fold is order-preserving either
+        way), so the pipelined version sequence is bit-identical to
+        inline.  Overflow dead-letters as a network fault, never a
+        strike.  Mutually exclusive with ``faultline`` (ActorKilled
+        cannot escape a worker thread)."""
         super().__init__(0, transport)
         if not 1 <= aggregation_goal <= n_silos:
             raise ValueError(
@@ -234,6 +246,18 @@ class AsyncFedServerActor(ServerManager):
                 "buffer has no incremental fold state to snapshot")
         self.journal = journal
         self.faultline = faultline
+        if ingest is not None and faultline is not None:
+            raise ValueError(
+                "--ingest_pipeline and --faultline are mutually "
+                "exclusive: ActorKilled must escape the transport event "
+                "loop to reach the harness, and an ingest fold worker "
+                "thread has no path there")
+        self.ingest = ingest
+        # (silo, round-tag) pairs whose frames sit queued, not yet
+        # processed: the transport-side duplicate screen (the
+        # authoritative at-most-once guard re-runs on the worker)
+        self._ingest_inflight: Set[Tuple[int, object]] = set()
+        self._ingest_lock = threading.RLock()
         # the server-optimizer seam (ISSUE 18), staleness-aware: the
         # buffer's discounted mean delta becomes the pseudo-gradient
         # (Δ = −davg·mean_delta), so stale buffers move the moments
@@ -398,6 +422,11 @@ class AsyncFedServerActor(ServerManager):
         self._retask_timer.cancel(join=join)
 
     def _on_retask_tick(self, msg: Message) -> None:
+        if self.ingest is not None:
+            # frames already queued are responses, not silence: drain
+            # before judging quiet silos, or the watchdog would re-task
+            # a silo whose upload is simply waiting on the fold worker
+            self.ingest.drain()
         if self.version >= self.num_versions:
             return
         now = time.monotonic()
@@ -556,6 +585,42 @@ class AsyncFedServerActor(ServerManager):
         self._last_heard[msg.sender_id] = time.monotonic()
         if self.version >= self.num_versions:
             return  # late upload after FINISH
+        if self.ingest is not None:
+            # pipelined receive: envelope facts only here, then enqueue
+            # to the single fold worker (FIFO = arrival order = the
+            # inline fold order).  The at-most-once/staleness guards run
+            # on the worker under the ingest lock — the version may
+            # advance while the frame sits queued, and staleness must be
+            # judged against the version that FOLDS it, exactly like a
+            # frame that spent the same time on the wire.
+            key = (msg.sender_id, msg.get(Message.ARG_ROUND))
+            if key in self._ingest_inflight:
+                log.info("ignoring duplicate version-%s upload from silo "
+                         "%d (first copy still queued)", key[1],
+                         msg.sender_id)
+                return
+            self._note_arrival()
+            self._ingest_inflight.add(key)
+            ok = self.ingest.submit(
+                0, lambda: self._ingest_task(msg),
+                detail=f"silo {msg.sender_id} version {key[1]}")
+            if not ok:
+                self._ingest_inflight.discard(key)
+            return
+        self._upload_body(msg, note_arrival=True)
+
+    def _ingest_task(self, msg: Message) -> None:
+        key = (msg.sender_id, msg.get(Message.ARG_ROUND))
+        try:
+            with self._ingest_lock:
+                if self.version >= self.num_versions:
+                    return  # federation closed while the frame was queued
+                self._upload_body(msg, note_arrival=False)
+        finally:
+            with self._ingest_lock:
+                self._ingest_inflight.discard(key)
+
+    def _upload_body(self, msg: Message, note_arrival: bool) -> None:
         try:
             base_version = int(msg.get(Message.ARG_ROUND))
         except (TypeError, ValueError):
@@ -583,7 +648,8 @@ class AsyncFedServerActor(ServerManager):
             log.warning("ignoring duplicate version-%d upload from silo %d",
                         base_version, msg.sender_id)
             return
-        self._note_arrival()  # one wire arrival per (deduped) upload
+        if note_arrival:
+            self._note_arrival()  # one wire arrival per (deduped) upload
         delta = msg.get(Message.ARG_MODEL_PARAMS)
         raw_samples = msg.get(Message.ARG_NUM_SAMPLES)
         delta_norm = None
@@ -914,6 +980,10 @@ class AsyncFedServerActor(ServerManager):
             # server closes before its eval hook for the same reason)
             vextra = ({"server_opt": self.server_opt.name}
                       if self.server_opt is not None else {})
+            # the applied version's global CRC: the ingest bench's
+            # bit-parity gate compares this sequence inline vs pipelined
+            from fedml_tpu.utils.journal import tree_crc
+            vextra["global_crc"] = tree_crc(self._host_params())
             self.perf.round_end(self.version - 1, buffered=len(silos),
                                 **vextra)
         if self.on_version is not None:
@@ -961,4 +1031,8 @@ class AsyncFedServerActor(ServerManager):
     def finish(self) -> None:
         self._finished = True
         self._cancel_retask_timer(join=True)
+        if self.ingest is not None:
+            # no drain: finish may run ON the fold worker (the closing
+            # version applied there); stop() never joins its caller
+            self.ingest.stop()
         super().finish()

@@ -43,6 +43,7 @@ holding the cohort); ``--agg_mode`` remains an actor-mode knob.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import logging
 import time
 from typing import Any, Dict, Optional
@@ -103,7 +104,7 @@ class CrossDevice(FedAvg):
     def __init__(self, workload, data, config: CrossDeviceConfig,
                  mesh=None, sink=None, perf=None, health=None, slo=None,
                  publish=None, server_opt=None, controller=None,
-                 degrade=None):
+                 degrade=None, ingest=None):
         cfg = config
         if cfg.local_alg not in LOCAL_ALGS:
             raise ValueError(f"--local_alg must be one of {LOCAL_ALGS}, "
@@ -183,6 +184,20 @@ class CrossDevice(FedAvg):
         # at the head of the next sample, and per-wave completion times
         # feed the latency history.  None keeps sampling bit-identical.
         self.degrade = degrade
+        # ingest: a comm.ingest.IngestPipeline (ISSUE 20).  The wave
+        # engine has no wire frames to stage — what pipelining buys here
+        # is overlap: the main thread keeps LAUNCHING waves (this
+        # regime's "network") while the single fold worker runs
+        # admission → fold → health → local-alg accumulation for the
+        # waves already completed, in arrival order.  All fold-side
+        # state (stream, admission, health, tau/scaffold accumulators)
+        # is worker-only between round_start and the pre-finalize
+        # drain(); the main thread only reads it after the drain, so the
+        # round stays bit-identical to the inline path.  scaffold's
+        # per-round gathers are safe: a round's cohort is sampled
+        # without replacement, so wave i's scatter and wave i+1's gather
+        # touch disjoint client rows.
+        self.ingest = ingest
         # seeded wave-summary poisoning, injected PRE-admission — the
         # mega-cohort path's first-class attacker (no per-silo message
         # seam exists inside a compiled wave)
@@ -341,6 +356,84 @@ class CrossDevice(FedAvg):
         return jax.device_put(params,
                               NamedSharding(self.wave_mesh, P()))
 
+    def _fold_one(self, round_idx, wi, wave, stacked, w, mean,
+                  wave_weight, aux_sums, new_c, c_delta, host_params,
+                  acc) -> None:
+        """Post-wave work for ONE completed wave: admission screen →
+        stream fold → health sketch → local-alg accumulation.  Runs
+        inline, or (``--ingest_pipeline``) on the single fold worker in
+        wave-completion order — same code, same order, bit-identical.
+        Every argument is bound at submit time (no late-binding loop
+        closures); ``acc`` carries the round's cross-wave accumulators,
+        touched only here until the pre-finalize drain."""
+        cfg = self.cfg
+        if wave_weight <= 0:
+            # a wave of only weightless clients (all-pad / all-empty
+            # shards): folds as weight 0 — skipped entirely, never a
+            # 0/0 in the normalizer (pinned in tests)
+            return
+        t0 = time.perf_counter()
+        mean_host = jax.tree.map(np.asarray, mean)
+        attack = self._wave_attacks.get((round_idx, wi))
+        if attack is not None:
+            # poison the WAVE SUMMARY pre-admission: the screen, the
+            # health sketch, and the fold all see the attacked mean —
+            # exactly what a compromised wave aggregation would ship
+            from fedml_tpu.robust.adversary import poison_wave_summary
+            mean_host = poison_wave_summary(attack, mean_host,
+                                            host_params,
+                                            seed=cfg.seed)
+            logger.warning("round %d wave %d POISONED (%s:%g)",
+                           round_idx, wi, attack.kind, attack.param)
+        verdict = self.admission.screen(mean_host, host_params)
+        self._perf_phase("admission", time.perf_counter() - t0)
+        if not verdict.ok:
+            logger.warning("round %d wave %d REJECTED (%s): %d "
+                           "clients' work discarded", round_idx, wi,
+                           verdict.reason, wave.n_live)
+            if self.health is not None:
+                self.health.observe_rejected(wi + 1, verdict.reason)
+            return
+        t0 = time.perf_counter()
+        if attack is not None:
+            # fold the POISONED mean through the SAME stacked wave
+            # program as every clean wave — each member ships the
+            # attacked mean (the weighted mean of identical rows IS
+            # the row), so the spine receives what admission and
+            # health were shown AND its hot fold never traces a new
+            # path in an attack round (the strict recompile sentry
+            # holds even under attack)
+            poisoned = jax.tree.map(
+                lambda m, s: jnp.broadcast_to(
+                    jnp.asarray(m, dtype=s.dtype), s.shape),
+                mean_host, stacked)
+            self.stream.fold_wave(poisoned, w)
+        else:
+            self.stream.fold_wave(stacked, w)
+        dt = time.perf_counter() - t0
+        self._h_fold.observe(dt)
+        self._perf_phase("fold", dt)
+        acc["folded"] += 1
+        acc["live"] += wave.n_live
+        self._c_clients.inc(wave.n_live)
+        if self.health is not None:
+            t0 = time.perf_counter()
+            self.health.observe_admitted(wi + 1, mean_host,
+                                         wave_weight,
+                                         norm=verdict.norm)
+            self._perf_phase("health", time.perf_counter() - t0)
+        if cfg.local_alg == "fednova":
+            acc["tau"] += float(aux_sums["tau"])
+        elif cfg.local_alg == "scaffold":
+            # admitted waves only: a rejected wave's work — params
+            # AND variates — is discarded for the round
+            self.c_locals = scatter_client_rows(
+                self.c_locals, wave.ids, jax.tree.map(np.asarray,
+                                                      new_c))
+            acc["c_delta"] = (
+                c_delta if acc["c_delta"] is None else
+                jax.tree.map(jnp.add, acc["c_delta"], c_delta))
+
     def _run_round(self, params, ids, round_rng, round_idx):
         cfg = self.cfg
         W = cfg.wave_size
@@ -353,9 +446,12 @@ class CrossDevice(FedAvg):
             self.health.round_start(round_idx, host_params,
                                     expected=range(1, len(waves) + 1))
         self.stream.reset(params)
-        tau_acc = 0.0                  # fednova: Σ n_i·tau_i across waves
-        c_delta_acc = None             # scaffold: Σ live·(c_i+ − c_i)
-        folded = live_clients = 0
+        # cross-wave accumulators: one mutable dict so the fold worker
+        # (--ingest_pipeline) and the inline path share the same code;
+        # the main thread reads it only after the pre-finalize drain
+        acc = {"tau": 0.0,             # fednova: Σ n_i·tau_i across waves
+               "c_delta": None,        # scaffold: Σ live·(c_i+ − c_i)
+               "folded": 0, "live": 0}
 
         for wi, wave in enumerate(waves):
             if wave.n_live == 0:
@@ -372,6 +468,7 @@ class CrossDevice(FedAvg):
             else:
                 stacked, w, mean, total, aux_sums = self._wave_fn(
                     params, wave_data, round_rng, offset)
+                new_c = c_delta = None
             wave_weight = float(total)  # blocks: the wave ran to completion
             dt = time.perf_counter() - t0
             self._c_waves.inc()
@@ -387,71 +484,30 @@ class CrossDevice(FedAvg):
                 # a completed wave is this regime's "upload arrival" on
                 # the round's critical-path timeline
                 self.perf.note_arrival()
-            if wave_weight <= 0:
-                # a wave of only weightless clients (all-pad / all-empty
-                # shards): folds as weight 0 — skipped entirely, never a
-                # 0/0 in the normalizer (pinned in tests)
-                continue
-            t0 = time.perf_counter()
-            mean_host = jax.tree.map(np.asarray, mean)
-            attack = self._wave_attacks.get((round_idx, wi))
-            if attack is not None:
-                # poison the WAVE SUMMARY pre-admission: the screen, the
-                # health sketch, and the fold all see the attacked mean —
-                # exactly what a compromised wave aggregation would ship
-                from fedml_tpu.robust.adversary import poison_wave_summary
-                mean_host = poison_wave_summary(attack, mean_host,
-                                                host_params,
-                                                seed=cfg.seed)
-                logger.warning("round %d wave %d POISONED (%s:%g)",
-                               round_idx, wi, attack.kind, attack.param)
-            verdict = self.admission.screen(mean_host, host_params)
-            self._perf_phase("admission", time.perf_counter() - t0)
-            if not verdict.ok:
-                logger.warning("round %d wave %d REJECTED (%s): %d "
-                               "clients' work discarded", round_idx, wi,
-                               verdict.reason, wave.n_live)
-                if self.health is not None:
-                    self.health.observe_rejected(wi + 1, verdict.reason)
-                continue
-            t0 = time.perf_counter()
-            if attack is not None:
-                # fold the POISONED mean through the SAME stacked wave
-                # program as every clean wave — each member ships the
-                # attacked mean (the weighted mean of identical rows IS
-                # the row), so the spine receives what admission and
-                # health were shown AND its hot fold never traces a new
-                # path in an attack round (the strict recompile sentry
-                # holds even under attack)
-                poisoned = jax.tree.map(
-                    lambda m, s: jnp.broadcast_to(
-                        jnp.asarray(m, dtype=s.dtype), s.shape),
-                    mean_host, stacked)
-                self.stream.fold_wave(poisoned, w)
+            if self.ingest is not None:
+                # hand the post-wave work to the fold worker and go
+                # launch the next wave.  submit_wait (not submit): a
+                # wave the server itself produced can never be load-shed
+                # — the bounded queue applies BACKPRESSURE here, pacing
+                # wave launches to what the folder absorbs.  One shard
+                # queue = arrival-order folds = bit-parity with inline.
+                self.ingest.submit_wait(0, functools.partial(
+                    self._fold_one, round_idx, wi, wave, stacked, w,
+                    mean, wave_weight, aux_sums, new_c, c_delta,
+                    host_params, acc))
             else:
-                self.stream.fold_wave(stacked, w)
-            dt = time.perf_counter() - t0
-            self._h_fold.observe(dt)
-            self._perf_phase("fold", dt)
-            folded += 1
-            live_clients += wave.n_live
-            self._c_clients.inc(wave.n_live)
-            if self.health is not None:
-                t0 = time.perf_counter()
-                self.health.observe_admitted(wi + 1, mean_host,
-                                             wave_weight,
-                                             norm=verdict.norm)
-                self._perf_phase("health", time.perf_counter() - t0)
-            if cfg.local_alg == "fednova":
-                tau_acc += float(aux_sums["tau"])
-            elif cfg.local_alg == "scaffold":
-                # admitted waves only: a rejected wave's work — params
-                # AND variates — is discarded for the round
-                self.c_locals = scatter_client_rows(
-                    self.c_locals, wave.ids, jax.tree.map(np.asarray,
-                                                          new_c))
-                c_delta_acc = (c_delta if c_delta_acc is None else
-                               jax.tree.map(jnp.add, c_delta_acc, c_delta))
+                self._fold_one(round_idx, wi, wave, stacked, w, mean,
+                               wave_weight, aux_sums, new_c, c_delta,
+                               host_params, acc)
+
+        if self.ingest is not None:
+            # rendezvous: every queued fold lands before finalize reads
+            # the stream (the wait is the round's true fold overhang)
+            t0 = time.perf_counter()
+            self.ingest.drain()
+            self._perf_phase("barrier_wait", time.perf_counter() - t0)
+        folded, live_clients = acc["folded"], acc["live"]
+        tau_acc, c_delta_acc = acc["tau"], acc["c_delta"]
 
         if self.stream.count == 0:
             logger.warning("round %d: every wave empty or rejected — "
@@ -532,6 +588,13 @@ class CrossDevice(FedAvg):
             round_s = time.time() - t0
             if self.perf is not None:
                 extra = dict(info)
+                # the round's post-finalize global CRC: the ingest
+                # bench's bit-parity gate compares this sequence between
+                # the inline and pipelined twins (utils.journal.tree_crc
+                # — the same checksum the crash journal trusts)
+                from fedml_tpu.utils.journal import tree_crc
+                extra["global_crc"] = tree_crc(
+                    jax.tree.map(np.asarray, params))
                 if self.server_opt is not None:
                     extra["server_opt"] = self.server_opt.name
                 if decision is not None:
@@ -561,6 +624,9 @@ class CrossDevice(FedAvg):
                     last_round=round_idx == cfg.comm_round - 1)
         if checkpointer is not None:
             checkpointer.flush()
+        if self.ingest is not None:
+            # every round drained before its finalize; nothing queued
+            self.ingest.stop()
         return params
 
     # -- checkpoint extra state (scaffold control variates, server

@@ -168,7 +168,8 @@ class FedAvgServerActor(ServerManager):
                  shard_wire=None,
                  server_opt=None,
                  controller=None,
-                 degrade=None):
+                 degrade=None,
+                 ingest=None):
         """Failure handling (SURVEY.md §5.3 — the reference has none: its
         barrier waits forever and its only exit is ``MPI.Abort``,
         server_manager.py:64):
@@ -340,6 +341,22 @@ class FedAvgServerActor(ServerManager):
         (`faultline.CRASH_POINTS`); an armed faultline raises
         `ActorKilled` (a BaseException — no receive-path guard survives
         it) out of the event loop with zero cleanup, emulating kill -9.
+
+        ``ingest``: a `fedml_tpu.comm.ingest.IngestPipeline`
+        (``--ingest_pipeline``) — the zero-copy pipelined receive path
+        (ROADMAP item 4).  The transport thread only validates the
+        envelope and enqueues; a single-consumer fold worker per shard
+        runs decode → screen → fold, staging float payloads through the
+        pipeline's pre-pinned arenas (one ``device_put`` per shard, the
+        fused admission reduction) when attached.  Fold order per shard
+        is the worker queue's FIFO — the deterministic arrival order —
+        so the pipelined global is bit-identical to the inline path.
+        Queue overflow dead-letters the frame as a NETWORK fault
+        (``fedml_comm_dead_letter_total{reason="ingest_overflow"}``);
+        the silo is simply not heard from this round — never struck.
+        Mutually exclusive with ``faultline``: `ActorKilled` must
+        escape the transport event loop to reach the harness, and a
+        fold worker thread has no path there.
         """
         super().__init__(0, transport)
         if straggler_policy not in ("wait", "drop", "abort"):
@@ -453,6 +470,21 @@ class FedAvgServerActor(ServerManager):
                     "per-shard structural screens ARE the sharded wire "
                     "protocol (slices route by screened structure) — "
                     "build the spine with admission_on=True")
+        if ingest is not None and faultline is not None:
+            raise ValueError(
+                "--ingest_pipeline and --faultline are mutually "
+                "exclusive: ActorKilled must escape the transport event "
+                "loop to reach the harness, and an ingest fold worker "
+                "thread has no path there")
+        self.ingest = ingest
+        # silos whose frames sit in the ingest queue, not yet folded:
+        # the transport-thread duplicate guard must see them (the
+        # authoritative `_received` check re-runs on the worker)
+        self._ingest_inflight: Set[int] = set()
+        # serializes the worker-side upload body against the timeout /
+        # round-close paths (RLock: a worker-side barrier close calls
+        # back into guarded methods)
+        self._ingest_lock = threading.RLock()
         # a mid-round recovery found by start(): consumed by the next
         # _broadcast of the matching round
         self._pending_resume = None
@@ -811,6 +843,17 @@ class FedAvgServerActor(ServerManager):
             # reference like any other)
             with self._perf_phase("admission"):
                 self.shard_wire.round_start(host_params)
+        if self.ingest is not None and self.ingest.has_arenas:
+            # stage the round's screen reference into each shard arena
+            # (one transfer per arena per round — the _ref_cache
+            # discipline, on the device)
+            with self._perf_phase("admission"):
+                if self.shard_wire is not None:
+                    refs = list(
+                        self.shard_wire.broadcast_slices(host_params))
+                else:
+                    refs = [host_params]
+                self.ingest.round_start(refs)
         if self.journal is not None and resume is None:
             from fedml_tpu.utils.journal import tree_crc
             mode = self._journal_mode()
@@ -940,6 +983,16 @@ class FedAvgServerActor(ServerManager):
         self._timer.cancel(join=join)
 
     def _on_timeout(self, msg: Message) -> None:
+        if self.ingest is not None:
+            # frames already off the wire but still queued are NOT
+            # stragglers: drain the pipeline before judging the barrier
+            # (a queued fold may close the round right here — then the
+            # stale-round guard below sees the advanced round and bails)
+            self.ingest.drain()
+        with self._ingest_lock:
+            self._on_timeout_locked(msg)
+
+    def _on_timeout_locked(self, msg: Message) -> None:
         if msg.get(Message.ARG_ROUND) != self.round_idx:
             return  # stale timer from an already-completed round
         if self._secagg_stage == "agreement":
@@ -1237,6 +1290,49 @@ class FedAvgServerActor(ServerManager):
 
     def _on_model(self, msg: Message) -> None:
         self._beat(msg.sender_id)
+        if not self._upload_guards(msg, check_inflight=True):
+            return
+        # one wire arrival per upload frame (shard slices each count —
+        # they are distinct frames): the critical-path observatory's
+        # idle classifier (network → straggler → barrier_wait) keys on
+        # this timeline
+        self._note_arrival()
+        if self.ingest is not None:
+            # pipelined receive: this thread's work ENDS here — header
+            # facts only, then enqueue to the shard's fold worker.  The
+            # worker re-runs the guards under the ingest lock (the
+            # authoritative check: round/stage may move while queued).
+            shard = 0
+            if self.shard_wire is not None:
+                s = msg.get(Message.ARG_SHARD)
+                if isinstance(s, int) and 0 <= s < self.ingest.num_shards:
+                    shard = s
+                # a malformed/missing shard tag rides queue 0: the
+                # worker's offer() rejects it as structural damage
+            else:
+                # replicated: the queued frame must trip the duplicate
+                # guard for this silo until its fold lands
+                self._ingest_inflight.add(msg.sender_id)
+            ok = self.ingest.submit(
+                shard, lambda: self._ingest_task(msg),
+                detail=f"silo {msg.sender_id} round {self.round_idx}")
+            if not ok and self.shard_wire is None:
+                # overflow: the pipeline already dead-lettered + fed the
+                # fault ledger (a NETWORK fault — never a strike); the
+                # silo is simply not heard from this round
+                self._ingest_inflight.discard(msg.sender_id)
+            return
+        self._upload_body(msg)
+
+    def _upload_guards(self, msg: Message,
+                       check_inflight: bool = True) -> bool:
+        """The receive-path envelope guards (round tag, secagg stage,
+        quorum membership, duplicates).  Factored so the pipelined path
+        can run them twice: a cheap screen on the transport thread, and
+        the AUTHORITATIVE re-check on the fold worker under the ingest
+        lock (round state may have moved while the frame sat queued).
+        ``check_inflight`` adds the queued-but-unfolded duplicate guard
+        (transport side only — the worker IS the inflight entry)."""
         # stale-round guard: a straggler's upload arriving after its round
         # was closed out (drop policy) must not pollute the next barrier
         upload_round = msg.get(Message.ARG_ROUND)
@@ -1244,7 +1340,7 @@ class FedAvgServerActor(ServerManager):
             log.warning("discarding round-%s upload from silo %d (current "
                         "round %d)", upload_round, msg.sender_id,
                         self.round_idx)
-            return
+            return False
         if self.secagg is not None and self._secagg_stage != "upload":
             # a masked upload outside the upload stage (a straggler
             # landing after the barrier closed, mid-unmask) must not
@@ -1255,7 +1351,7 @@ class FedAvgServerActor(ServerManager):
             log.info("round %d: discarding masked upload from silo %d "
                      "outside the upload stage (stage=%s)", self.round_idx,
                      msg.sender_id, self._secagg_stage)
-            return
+            return False
         if self._expected and msg.sender_id not in self._expected:
             # an upload from a silo outside the expected quorum (it was
             # declared dead at broadcast, then rejoined mid-round): the
@@ -1263,7 +1359,7 @@ class FedAvgServerActor(ServerManager):
             # participate again from the next broadcast
             log.info("discarding round-%d upload from unexpected silo %d",
                      self.round_idx, msg.sender_id)
-            return
+            return False
         if msg.sender_id in self._received:
             # duplicate delivery of this round's report (chaos dup,
             # transport retry): the first copy already went through
@@ -1272,14 +1368,58 @@ class FedAvgServerActor(ServerManager):
             # could even overwrite an ACCEPTED entry with a rejection
             log.info("ignoring duplicate round-%d upload from silo %d",
                      self.round_idx, msg.sender_id)
-            return
-        # one wire arrival per upload frame (shard slices each count —
-        # they are distinct frames): the critical-path observatory's
-        # idle classifier (network → straggler → barrier_wait) keys on
-        # this timeline
-        self._note_arrival()
+            return False
+        if check_inflight and self.ingest is not None \
+                and self.shard_wire is None \
+                and msg.sender_id in self._ingest_inflight:
+            log.info("ignoring duplicate round-%d upload from silo %d "
+                     "(first copy still queued)", self.round_idx,
+                     msg.sender_id)
+            return False
+        return True
+
+    def _ingest_task(self, msg: Message) -> None:
+        """One queued upload, on its shard's fold worker: arena staging
+        (gather + one device_put + the fused screen) OUTSIDE the ingest
+        lock — that is where per-shard parallelism lives — then the
+        guard re-check and the full upload body under it."""
+        silo = msg.sender_id
+        try:
+            pre = None
+            if self.shard_wire is not None:
+                s = msg.get(Message.ARG_SHARD)
+                arena = (self.ingest.arena_for(s)
+                         if isinstance(s, int)
+                         and 0 <= s < self.ingest.num_shards else None)
+            else:
+                arena = self.ingest.arena_for(0)
+            if arena is not None:
+                with self._span("ingest:decode", deterministic=True), \
+                        self._perf_phase("decode"):
+                    pre = arena.stage_message(msg,
+                                              Message.ARG_MODEL_PARAMS)
+                    if pre is None:
+                        # in-process object message (pump mode without a
+                        # codec roundtrip): stage from the decoded tree
+                        pre = arena.stage_tree(
+                            msg.get(Message.ARG_MODEL_PARAMS))
+            with self._ingest_lock:
+                if not self._upload_guards(msg, check_inflight=False):
+                    return
+                self._upload_body(msg, pre=pre)
+        finally:
+            if self.shard_wire is None:
+                with self._ingest_lock:
+                    self._ingest_inflight.discard(silo)
+
+    def _upload_body(self, msg: Message, pre=None) -> None:
+        """Everything past the envelope guards: decode, admission (the
+        ``pre`` seam carries the arena's precomputed screens), health,
+        and the fold/stage via `_note_upload`.  Inline mode calls this
+        straight from `_on_model`; pipelined mode from the fold worker
+        under the ingest lock."""
         if self.shard_wire is not None:
-            self._on_shard_upload(msg)
+            self._on_shard_upload(msg, pre=pre)
             return
         # barrier semantics: wait for every sampled silo
         # (check_whether_all_receive, FedAvgServerManager.py:51)
@@ -1341,6 +1481,11 @@ class FedAvgServerActor(ServerManager):
                             self.round_idx, msg.sender_id)
         if self._first_upload_t is None:
             self._first_upload_t = time.monotonic()
+        if pre is not None and pre.structural_ok and pre.tree is not None:
+            # the arena already staged the payload on the device —
+            # downstream (fold/health) consumes the staged tree, so the
+            # fold's H2D transfer is the arena's ONE device_put
+            upload = pre.tree
         entry = (upload, msg.get(Message.ARG_NUM_SAMPLES))
         upload_norm = None
         if self.admission is not None:
@@ -1348,7 +1493,7 @@ class FedAvgServerActor(ServerManager):
                     self._perf_phase("admission"):
                 verdict = self.admission.admit(
                     msg.sender_id, upload, msg.get(Message.ARG_NUM_SAMPLES),
-                    self.params, self.round_idx)
+                    self.params, self.round_idx, pre=pre)
             if verdict.ok:
                 entry = (upload, verdict.num_samples)
                 # the screen's one O(model) norm pass is shared: health
@@ -1379,19 +1524,24 @@ class FedAvgServerActor(ServerManager):
                                              entry[1], norm=upload_norm)
         self._note_upload(msg.sender_id, entry)
 
-    def _on_shard_upload(self, msg: Message) -> None:
+    def _on_shard_upload(self, msg: Message, pre=None) -> None:
         """One shard slice of a silo's upload (the sharded wire): screen
         it per shard at arrival; the silo reaches the barrier only when
         its LAST slice completes admission (or its first slice fails
         it).  A whole-model upload on the sharded wire (a rejoin
         warm-up train, a mis-launched silo) is structural damage — it
         rejects at weight 0 like any fingerprint mismatch instead of
-        wedging the fold."""
+        wedging the fold.  ``pre`` is the shard arena's precomputed
+        screen (pipelined path): `ShardAdmission.offer` consumes its
+        facts and banks the staged device slice."""
         from fedml_tpu.shard_spine.admission import ACCEPT, WAIT
         silo = msg.sender_id
         if self._first_upload_t is None:
             self._first_upload_t = time.monotonic()
         shard = msg.get(Message.ARG_SHARD)
+        payload = msg.get(Message.ARG_MODEL_PARAMS)
+        if pre is not None and pre.structural_ok and pre.tree is not None:
+            payload = pre.tree
         with self._span("ingest:admission", deterministic=True), \
                 self._perf_phase("admission"):
             if shard is None:
@@ -1403,8 +1553,9 @@ class FedAvgServerActor(ServerManager):
             else:
                 status, info = self.shard_wire.admission.offer(
                     silo, shard, msg.get(Message.ARG_SHARD_COUNT),
-                    msg.get(Message.ARG_MODEL_PARAMS),
-                    msg.get(Message.ARG_NUM_SAMPLES), self.round_idx)
+                    payload,
+                    msg.get(Message.ARG_NUM_SAMPLES), self.round_idx,
+                    pre=pre)
         if status == WAIT:
             return
         if status != ACCEPT:
@@ -1805,6 +1956,12 @@ class FedAvgServerActor(ServerManager):
             # and fails the run loudly (the test-mode contract).
             extra = ({"shards": self.shard_wire.num_shards}
                      if self.shard_wire is not None else {})
+            # the round's post-aggregate global CRC: the ingest bench's
+            # bit-parity gate compares this sequence between the inline
+            # and pipelined twins (utils.journal.tree_crc — the same
+            # checksum the crash journal trusts)
+            from fedml_tpu.utils.journal import tree_crc
+            extra["global_crc"] = tree_crc(self._host_params())
             if self.server_opt is not None:
                 extra["server_opt"] = self.server_opt.name
             if decision is not None:
@@ -1830,6 +1987,13 @@ class FedAvgServerActor(ServerManager):
     def finish(self) -> None:
         self._finished = True
         self._cancel_timer(join=True)
+        if self.ingest is not None:
+            # no drain here: finish may run ON a fold worker (the last
+            # round's barrier closed there) and a worker draining its
+            # own queue would deadlock; stop() skips joining the calling
+            # thread for the same reason.  Frames still queued are
+            # post-federation stragglers — stale by construction.
+            self.ingest.stop()
         super().finish()
 
 

@@ -216,6 +216,11 @@ class Message:
         # broadcast at ONE SharedPayload, and to_bytes() reuses its
         # already-serialized block instead of re-encoding the model bytes
         self._shared: Optional["SharedPayload"] = None
+        # raw-frame stash (decode path only): the parsed array headers +
+        # buffer views, so the ingest arena can stage straight from the
+        # frame without a tree walk (`raw_payload`)
+        self._arrays: Optional[dict] = None
+        self._buffers: Optional[List[memoryview]] = None
 
     # -- accessors (reference message.py:26-60) ------------------------------
     @property
@@ -322,10 +327,28 @@ class Message:
                              "{'plain': {...}, 'arrays': {...}}")
         return header
 
+    def raw_payload(self, key: str):
+        """The raw-frame view of one array param, for the ingest arena:
+        ``(leaf_descriptors, spec, buffers)`` — header facts plus the
+        frame's zero-copy buffer views, no tree walk.  ``None`` when the
+        message never crossed the wire (an in-process object message) or
+        carries no such array param."""
+        if self._arrays is None or self._buffers is None:
+            return None
+        info = self._arrays.get(key)
+        if not isinstance(info, dict):
+            return None
+        try:
+            return info["leaves"], info["spec"], self._buffers
+        except (TypeError, KeyError):
+            return None
+
     @classmethod
     def _from_header(cls, header: dict, buffers: List[memoryview]):
         msg = cls.__new__(cls)
         msg._shared = None
+        msg._arrays = header["arrays"]
+        msg._buffers = buffers
         msg.params = dict(header["plain"])
         decoded_payload = False
         for key, info in header["arrays"].items():

@@ -301,6 +301,26 @@ class ExperimentConfig:
     #                                   edge folds its silos locally and
     #                                   ships ONE pre-reduced update per
     #                                   round (cross_silo local backend)
+    # ---- zero-copy pipelined ingest (comm/ingest.py, ISSUE 20) ---------
+    ingest_pipeline: bool = False     # opt-in receive path: the
+    #                                   transport thread only validates
+    #                                   frame headers and enqueues; one
+    #                                   fold worker per shard runs
+    #                                   decode → screen → fold in
+    #                                   arrival order (bit-identical to
+    #                                   the inline path).  cross_silo /
+    #                                   async_fl servers and the
+    #                                   cross_device wave loop; requires
+    #                                   --agg_mode stream on the actor
+    #                                   paths and refuses unproven
+    #                                   combinations loudly (--wire_
+    #                                   compression, grpc backend,
+    #                                   --edge_aggregators, faultline)
+    ingest_queue_depth: int = 64      # bounded per-shard ingest queue
+    #                                   depth; overflow dead-letters
+    #                                   through the degradation fault
+    #                                   feed as a NETWORK fault — never
+    #                                   a trust strike, never silent
     # ---- secure aggregation (secure/protocol.py, ROADMAP item 3) -------
     secagg: str = "off"               # cross_silo live secure aggregation:
     #                                   off | pairwise (one masking group =

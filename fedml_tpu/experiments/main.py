@@ -983,6 +983,21 @@ def run_async_fl(cfg, data, mesh, sink):
                                         ("srv_opt", srv_opt_extra),
                                         ("degrade", degrade_extra)])
 
+    # zero-copy pipelined ingest (comm/ingest.py, ISSUE 20): one fold
+    # worker consumes the buffer-fold queue in arrival order.  No decode
+    # arena here — async uploads are DELTAS screened against the delta
+    # template, and the staleness-discounted buffer path keeps the host
+    # decode (the arena rides the sync paths); what pipelining buys is
+    # decode+screen+fold off the transport thread.
+    ingest = None
+    if cfg.ingest_pipeline:
+        from fedml_tpu.comm.ingest import IngestPipeline
+        ingest = IngestPipeline(
+            num_shards=1, depth=cfg.ingest_queue_depth,
+            fault_feed=((lambda reason, detail:
+                         degrade.note_dead_letter(reason))
+                        if degrade is not None else None))
+
     hub = LocalHub(codec_roundtrip=True)  # exercise the wire codec
     server = AsyncFedServerActor(
         hub.transport(0), init, data.client_num, n_silos,
@@ -994,7 +1009,7 @@ def run_async_fl(cfg, data, mesh, sink):
         admission=admission, defended_aggregate=defended,
         stream_agg=stream, perf=perf, health=health,
         extra_state=extra_state, journal=_make_journal(cfg),
-        server_opt=server_opt, degrade=degrade)
+        server_opt=server_opt, degrade=degrade, ingest=ingest)
     server.register_handlers()
     silos = [FedAvgClientActor(i, hub.transport(i), make_train_fn(i),
                                encode_upload=delta_encoder)
@@ -1003,7 +1018,7 @@ def run_async_fl(cfg, data, mesh, sink):
         s.register_handlers()
     try:
         server.start()
-        hub.pump()
+        hub.pump(idle_hook=(ingest.drain if ingest is not None else None))
     finally:
         if perf is not None:
             perf.close()  # join the RSS sampler thread
@@ -1433,6 +1448,38 @@ def run_cross_silo(cfg, data, mesh, sink):
                                         ("degrade", degrade_extra)])
     journal = _make_journal(cfg)
 
+    # zero-copy pipelined ingest (comm/ingest.py, ISSUE 20): the
+    # transport thread only checks guards and enqueues; one fold worker
+    # per shard runs decode -> screen -> fold in arrival order.  Queue
+    # overflow dead-letters through the degrade tracker's fault feed as
+    # NETWORK evidence (the resilient-transport convention) — never a
+    # trust strike, never silent.
+    ingest = None
+    if cfg.ingest_pipeline:
+        from fedml_tpu.comm.ingest import IngestArena, IngestPipeline
+        ingest = IngestPipeline(
+            num_shards=(shard_spine.num_shards
+                        if shard_spine is not None else 1),
+            depth=cfg.ingest_queue_depth,
+            fault_feed=((lambda reason, detail:
+                         degrade.note_dead_letter(reason))
+                        if degrade is not None else None))
+        if cfg.secagg == "off":
+            # pre-pinned decode arenas, one per shard, templated on the
+            # exact slice layout the wire ships: a frame's float payload
+            # lands via ONE device_put into the flat arena, and the
+            # fused finite+sumsq screen replaces the per-upload host
+            # norm pass.  Masked (secagg) uploads keep the host decode —
+            # a ciphertext norm is PRG noise — but the ring fold still
+            # runs on the worker.
+            if shard_spine is not None:
+                arenas = [IngestArena(sl, name=f"ingest_s{s}", perf=perf)
+                          for s, sl in enumerate(
+                              shard_spine.broadcast_slices(init))]
+            else:
+                arenas = [IngestArena(init, perf=perf)]
+            ingest.attach_arenas(arenas)
+
     def make_server(transport):
         # under the edge topology the root's cohort IS the edge tier:
         # straggler policy, admission, trust, and both agg modes apply
@@ -1451,7 +1498,7 @@ def run_cross_silo(cfg, data, mesh, sink):
             secagg=secagg_root, journal=journal,
             shard_wire=shard_spine,
             server_opt=server_opt, controller=controller,
-            degrade=degrade)
+            degrade=degrade, ingest=ingest)
         s.register_handlers()
         return s
 
@@ -1599,7 +1646,11 @@ def run_cross_silo(cfg, data, mesh, sink):
                     # (no-op without a journal or an open round)
                     e_actor.resume()
                 server.start()
-                hub.pump()
+                # idle_hook: when every inbox is empty the pump drains
+                # queued ingest folds; a truthy processed count means the
+                # drain may have enqueued broadcasts, so pumping resumes
+                hub.pump(idle_hook=(ingest.drain if ingest is not None
+                                    else None))
                 return history[-1] if history else {}
             # chaos delivers delayed/reordered frames on wall-clock timers,
             # which the synchronous pump cannot wait for — drive each actor
@@ -1704,6 +1755,16 @@ def run_cross_device(cfg, data, mesh, sink):
         controller = _make_controller(
             cfg, cohort=cfg.client_num_per_round, epochs=cfg.epochs,
             wave_size=cfg.wave_size, max_cohort=data.client_num)
+    # zero-copy pipelined ingest (ISSUE 20): the wave loop's pipelining
+    # — the main thread keeps launching waves while the fold worker
+    # runs admission/fold/health for completed ones.  submit_wait means
+    # overflow cannot happen (backpressure paces wave launches), so no
+    # fault feed is wired.
+    ingest = None
+    if cfg.ingest_pipeline:
+        from fedml_tpu.comm.ingest import IngestPipeline
+        ingest = IngestPipeline(num_shards=1,
+                                depth=cfg.ingest_queue_depth)
     algo = CrossDevice(
         wl, data, CrossDeviceConfig(
             wave_size=cfg.wave_size, local_alg=cfg.local_alg,
@@ -1715,7 +1776,7 @@ def run_cross_device(cfg, data, mesh, sink):
             wave_adversary=cfg.wave_adversary,
             **_fedavg_cfg_kwargs(cfg)),
         mesh=mesh, sink=sink, perf=perf, health=health, slo=slo,
-        server_opt=server_opt, controller=controller)
+        server_opt=server_opt, controller=controller, ingest=ingest)
     try:
         algo.run(checkpointer=_make_checkpointer(cfg))
     finally:
@@ -2112,6 +2173,53 @@ def main(argv=None) -> Dict[str, Any]:
     if cfg.error_feedback and cfg.wire_compression == "none":
         raise ValueError("--error_feedback requires --wire_compression "
                          "topk or int8")
+    # zero-copy pipelined ingest (comm/ingest.py, ISSUE 20): the
+    # bit-parity contract is proven per combination — every combination
+    # WITHOUT a parity pin refuses at config time with its reason
+    # instead of silently falling back to the inline path
+    if cfg.ingest_queue_depth < 1:
+        raise ValueError(f"--ingest_queue_depth must be >= 1, got "
+                         f"{cfg.ingest_queue_depth}")
+    if cfg.ingest_pipeline:
+        if cfg.algo not in ("cross_silo", "async_fl", "cross_device"):
+            raise ValueError(
+                f"--ingest_pipeline pipelines the SERVER receive path "
+                f"(cross_silo / async_fl) and the cross_device wave "
+                f"loop; --algo {cfg.algo} has no ingest hot path and "
+                f"would silently run inline")
+        if cfg.wire_compression != "none":
+            raise ValueError(
+                "--ingest_pipeline x --wire_compression is unproven: "
+                "the decompress + error-feedback settlement runs on the "
+                "transport thread today, and no bit-parity pin covers "
+                "decode-on-worker — drop one flag")
+        if cfg.silo_backend != "local" and cfg.algo != "cross_device":
+            raise ValueError(
+                f"--ingest_pipeline x --silo_backend "
+                f"{cfg.silo_backend!r} is unproven: the parity and "
+                f"journal-recovery pins drive the local hub; the grpc "
+                f"receive path needs its own soak before the pipeline "
+                f"rides it")
+        if cfg.edge_aggregators > 0:
+            raise ValueError(
+                "--ingest_pipeline x --edge_aggregators is unproven: "
+                "edges fold on their own actors and no pin covers a "
+                "pipelined edge tier — drop one flag")
+        if any((cfg.chaos_drop, cfg.chaos_delay, cfg.chaos_dup,
+                cfg.chaos_reorder, cfg.chaos_corrupt)):
+            raise ValueError(
+                "--ingest_pipeline x --chaos_* is unproven: chaos "
+                "switches the hub to the threaded drive and no parity "
+                "pin covers wall-clock chaos timers racing the fold "
+                "workers — drop one flag")
+        if cfg.algo == "cross_silo" and cfg.agg_mode != "stream" \
+                and cfg.secagg == "off":
+            raise ValueError(
+                "--ingest_pipeline pipelines the STREAMING fold "
+                "(decode -> screen -> fold at arrival); --agg_mode "
+                "stack banks uploads instead of folding them, so "
+                "there is nothing to hide behind the network — use "
+                "--agg_mode stream")
     # secure aggregation (secure/protocol.py): every incompatible combo
     # fails AT CONFIG TIME — a silently-ignored privacy flag would label
     # plaintext traffic as masked, the worst possible mislabel

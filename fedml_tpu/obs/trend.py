@@ -422,6 +422,102 @@ def validate_ingest_bench(obj: dict,
             problems.append(f"arm {name!r}: "
                             f"{arm['recompiles_after_warmup']} recompiles "
                             f"after warmup with tracing enabled")
+    problems += _validate_ingest_pipeline(obj.get("pipeline"),
+                                          smoke=bool(obj.get("smoke")))
+    return problems
+
+
+def _validate_ingest_pipeline(pipe, smoke: bool = False) -> List[str]:
+    """Re-derive the `--ingest_pipeline` twins' gates (ISSUE 20) from
+    the committed rows themselves — a regenerated artifact cannot carry
+    a green verdict its own rows contradict.  The claims: every twin's
+    pipelined global is bit-equal to inline (the per-round crc32
+    sequence matches exactly), zero recompiles after warmup, the waves
+    twin hides aggregation behind upload production
+    (fold_overlap_ratio >= 0.99, round wall clock <= 1.15x pure network
+    time), the replicated twin drains the wire at least as fast as
+    inline, and the arena + fused screen key one compile-ledger entry
+    each.  Smoke artifacts skip the noise-sensitive numeric
+    re-derivations (they run at relaxed scale) but never reach the
+    committed trend line — ``allow_smoke=False`` already refused them."""
+    problems: List[str] = []
+    if not isinstance(pipe, dict):
+        return ["no pipeline section (the --ingest_pipeline twins are a "
+                "required part of BENCH_ingest.json)"]
+    twins = pipe.get("twins")
+    if not isinstance(twins, dict):
+        return ["pipeline: no twins section"]
+    for tname in ("waves", "replicated", "sharded"):
+        if tname not in twins:
+            problems.append(f"pipeline: missing required twin {tname!r}")
+    for tname, twin in twins.items():
+        if not isinstance(twin, dict):
+            problems.append(f"pipeline twin {tname!r} is not an object")
+            continue
+        gates = twin.get("gates")
+        if not isinstance(gates, dict) or not gates:
+            problems.append(f"pipeline twin {tname!r}: no gate verdicts")
+            continue
+        for gname, verdict in gates.items():
+            if not isinstance(verdict, dict) or "ok" not in verdict:
+                problems.append(f"pipeline twin {tname!r}: gate "
+                                f"{gname!r} without an ok verdict")
+            elif not verdict["ok"]:
+                problems.append(f"pipeline twin {tname!r}: gate "
+                                f"{gname!r} FAILED ({verdict})")
+        rows_in = (twin.get("inline") or {}).get("rows")
+        rows_pi = (twin.get("pipelined") or {}).get("rows")
+        if not (isinstance(rows_in, list) and rows_in
+                and isinstance(rows_pi, list) and rows_pi):
+            problems.append(f"pipeline twin {tname!r}: missing per-round "
+                            f"rows (the gates must be re-derivable)")
+            continue
+        crc_in = [r.get("global_crc") for r in rows_in]
+        crc_pi = [r.get("global_crc") for r in rows_pi]
+        if crc_in != crc_pi or any(c is None for c in crc_in):
+            problems.append(f"pipeline twin {tname!r}: rows contradict "
+                            f"bit-parity (crc {crc_in} vs {crc_pi})")
+        warm = rows_pi[1:]
+        rec = sum(r.get("recompiles", 0) for r in warm)
+        if rec:
+            problems.append(f"pipeline twin {tname!r}: rows carry {rec} "
+                            f"recompiles after warmup")
+        if smoke:
+            continue   # relaxed-scale rows: structural claims only
+        if tname == "waves" and warm:
+            min_ov = min(r.get("fold_overlap_ratio") or 0.0 for r in warm)
+            if min_ov < 0.99:
+                problems.append(f"pipeline twin 'waves': rows re-derive "
+                                f"fold_overlap_ratio {min_ov:.4f} < 0.99")
+            ratios = [r["round_s"] / r["last_arrival_s"] for r in warm
+                      if r.get("last_arrival_s") and r.get("round_s")]
+            if not ratios or max(ratios) > 1.15:
+                problems.append(
+                    f"pipeline twin 'waves': round wall clock is "
+                    f"{max(ratios) if ratios else 'unknown'}x pure "
+                    f"network time (> 1.15x)")
+        if tname == "replicated" and warm:
+            def _bps(rows):
+                net = sum(r.get("last_arrival_s") or 0.0 for r in rows)
+                return (sum(r.get("bytes_in") or 0 for r in rows) / net
+                        if net > 0 else 0.0)
+            bps_in, bps_pi = _bps(rows_in[1:]), _bps(warm)
+            if bps_in <= 0 or bps_pi < bps_in:
+                problems.append(f"pipeline twin 'replicated': rows "
+                                f"re-derive pipelined wire drain "
+                                f"{bps_pi:.0f} B/s < inline "
+                                f"{bps_in:.0f} B/s")
+        if tname in ("replicated", "sharded"):
+            sizes = (twin.get("pipelined") or {}).get("jit_cache_sizes")
+            keys = sorted(k for k in (sizes or {})
+                          if k.startswith("ingest")
+                          and (k.endswith("_arena")
+                               or k.endswith("_screen")))
+            want = 8 if tname == "sharded" else 2
+            if len(keys) != want or any(sizes[k] != 1 for k in keys):
+                problems.append(f"pipeline twin {tname!r}: arena/screen "
+                                f"jits do not key exactly one ledger "
+                                f"entry each ({keys})")
     return problems
 
 
@@ -1058,8 +1154,13 @@ def main(argv=None) -> int:
             bindings = sorted({r.get("binding")
                                for a in arms.values()
                                for r in (a.get("rounds") or [])})
+            twins = (ingest_obj.get("pipeline") or {}).get("twins", {})
+            waves = twins.get("waves", {})
+            ov = (waves.get("gates", {}).get("fold_overlap", {})
+                  .get("min"))
             print(f"ingest bench: {len(arms)} arm(s) green "
-                  f"(bindings seen: {bindings})")
+                  f"(bindings seen: {bindings}); {len(twins)} pipeline "
+                  f"twin(s) bit-equal (waves fold overlap {ov})")
 
     if args.opt_bench is not None:
         try:

@@ -511,17 +511,27 @@ class AdmissionPipeline:
                                       self.norm_min_history)
 
     def admit(self, silo: int, upload, num_samples, global_params,
-              round_idx: int) -> AdmissionVerdict:
+              round_idx: int, pre=None) -> AdmissionVerdict:
         """Screen one upload.  ``global_params`` is the CURRENT global
         (the reference point for ``kind="params"`` norms; ignored for
         deltas).  Order matters: structural checks run before any tree
-        math touches the payload."""
+        math touches the payload.
+
+        ``pre`` (a `comm.ingest.ArenaScreen`) carries the ingest arena's
+        precomputed screen results: the structural header check stands
+        in for the fingerprint, and the fused device reduction stands in
+        for the host finite/norm passes.  The verdict ORDER is identical
+        — only who computed each fact changes.  Not meaningful for
+        ``kind="masked"`` (the arena stages float payloads only)."""
         if self.trust.state(silo, round_idx) == TrustTracker.QUARANTINED:
             return self._reject(silo, round_idx, "quarantined")
-        try:
-            fp_ok = params_fingerprint(upload) == self.fingerprint
-        except Exception:  # noqa: BLE001 — unhashable garbage payload
-            fp_ok = False
+        if pre is not None:
+            fp_ok = pre.structural_ok
+        else:
+            try:
+                fp_ok = params_fingerprint(upload) == self.fingerprint
+            except Exception:  # noqa: BLE001 — unhashable garbage payload
+                fp_ok = False
         if not fp_ok:
             return self._reject(silo, round_idx, "fingerprint")
         try:
@@ -539,9 +549,10 @@ class AdmissionPipeline:
             self._c_admitted.inc()
             self.trust.record_clean(silo, round_idx)
             return AdmissionVerdict(True, num_samples=n, norm=None)
-        if not _all_finite(upload):
+        if not (pre.finite if pre is not None else _all_finite(upload)):
             return self._reject(silo, round_idx, "nonfinite")
-        norm = (_update_norm(upload, self._reference_leaves(global_params))
+        norm = (pre.norm if pre is not None else
+                _update_norm(upload, self._reference_leaves(global_params))
                 if self.kind == "params" else _norm(upload))
         self._h_norm.observe(norm)
         thresh = self.norm_threshold()

@@ -152,6 +152,177 @@ def run_arm(name, rounds, extra, workdir):
     return arm, failures
 
 
+def _run_pipeline_member(name, extra, rounds, workdir, pipelined):
+    """One member of an inline/pipelined twin: a fresh subprocess on the
+    REAL federation (same seed — the config default — on both members),
+    slimmed to the per-round facts the committed gates re-derive from."""
+    import subprocess
+    run_dir = os.path.join(workdir, name)
+    cmd = [sys.executable, "-m", "fedml_tpu",
+           "--model", "lr", "--dataset", "mnist",
+           "--comm_round", str(rounds),
+           "--frequency_of_the_test", str(rounds),
+           "--batch_size", "8", "--epochs", "1", "--log_stdout", "false",
+           "--perf", "true", "--perf_strict", "true", "--telemetry", "true",
+           "--run_dir", run_dir,
+           "--perf_ledger", os.path.join(run_dir, "perf.jsonl")] + extra
+    if pipelined:
+        cmd += ["--ingest_pipeline", "true"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=1800)
+    if proc.returncode != 0:
+        raise SystemExit(f"pipeline member {name} failed "
+                         f"rc={proc.returncode}:\n{proc.stderr[-3000:]}")
+    ledger = os.path.join(run_dir, "perf.jsonl")
+    rows = [json.loads(l) for l in open(ledger) if l.strip()]
+    slim = []
+    for r in rows:
+        cp = r.get("critical_path") or {}
+        slim.append({
+            "round": r.get("round"),
+            "global_crc": r.get("global_crc"),
+            "fold_overlap_ratio": cp.get("fold_overlap_ratio"),
+            "last_arrival_s": cp.get("last_arrival_s"),
+            "round_s": cp.get("round_s"),
+            "bytes_in": (r.get("wire") or {}).get("bytes_in", 0),
+            "recompiles": r.get("recompiles", 0),
+        })
+    return {"rows": slim,
+            "jit_cache_sizes": rows[-1].get("jit_cache_sizes", {})}
+
+
+def pipeline_twins(smoke, workdir):
+    """ISSUE 20's proof: inline vs `--ingest_pipeline` twins, same seed,
+    fresh subprocess each.  The committed claims:
+
+      * ``waves`` (cross-device, >=2048 uploads per round): the
+        pipelined member hides aggregation entirely behind upload
+        production — ``fold_overlap_ratio >= 0.99`` and round wall
+        clock <= 1.15x pure network time (t0 -> last arrival);
+      * ``replicated`` (cross-silo stream): the transport thread only
+        validates + enqueues, so the wire drains at least as fast as
+        inline (bytes_in / last_arrival_s), and the arena + fused
+        screen key ONE compile-ledger entry each with zero recompiles
+        after warmup under --perf_strict;
+      * ``sharded`` (--model_shards 4): per-shard arenas, same
+        single-entry ledger pin;
+      * every twin: the final models are BIT-EQUAL — the pipelined
+        fold order per shard is deterministic arrival order, so the
+        global is bit-identical to inline (the crc32 sequence in the
+        perf ledger, one per round, must match exactly).
+
+    Smoke mode shrinks scale and relaxes the noise-sensitive numeric
+    thresholds (overlap/wall/wire-speed) — the structural gates
+    (bit-parity, single-entry ledger, zero recompiles) stay strict.
+    The committed-trend validator re-derives the strict thresholds
+    from the rows itself and refuses smoke artifacts outright."""
+    cohort = 128 if smoke else 2048
+    cd_rounds = 2 if smoke else 3
+    silo_rounds = 2 if smoke else 4
+    n_silo = 4 if smoke else 8
+    th_overlap = 0.5 if smoke else 0.99
+    th_wall = 1.5 if smoke else 1.15
+    th_wire = 0.5 if smoke else 1.0
+    silo = ["--algo", "cross_silo", "--agg_mode", "stream",
+            "--client_num_in_total", str(n_silo),
+            "--client_num_per_round", str(n_silo),
+            "--admission", "on"]
+    twins_cfg = {
+        "waves": (cd_rounds, [
+            "--algo", "cross_device",
+            "--client_num_in_total", str(cohort),
+            "--client_num_per_round", str(cohort),
+            "--wave_size", "4", "--admission", "on"]),
+        "replicated": (silo_rounds, silo),
+        "sharded": (silo_rounds, silo + ["--model_shards", "4"]),
+    }
+    failures, twins = [], {}
+    for tname, (rounds, extra) in twins_cfg.items():
+        print(f"== pipeline twin {tname}: rounds={rounds}")
+        inline = _run_pipeline_member(
+            f"pipe_{tname}_inline", extra, rounds, workdir, False)
+        piped = _run_pipeline_member(
+            f"pipe_{tname}_pipelined", extra, rounds, workdir, True)
+        gates = {}
+
+        crc_in = [r["global_crc"] for r in inline["rows"]]
+        crc_pi = [r["global_crc"] for r in piped["rows"]]
+        bit_equal = bool(crc_in) and crc_in == crc_pi
+        gates["bit_equal_finals"] = {"ok": bit_equal, "rounds": len(crc_in)}
+        if not bit_equal:
+            failures.append(f"pipeline/{tname}: pipelined global is NOT "
+                            f"bit-equal to inline (crc {crc_in} vs "
+                            f"{crc_pi})")
+
+        warm = [r for r in piped["rows"][1:]]
+        recompiles = sum(r["recompiles"] for r in warm)
+        gates["zero_recompiles_after_warmup"] = {
+            "ok": recompiles == 0, "count": recompiles}
+        if recompiles:
+            failures.append(f"pipeline/{tname}: {recompiles} recompiles "
+                            f"after warmup under --perf_strict")
+
+        if tname == "waves":
+            min_ov = min(r["fold_overlap_ratio"] for r in warm)
+            gates["fold_overlap"] = {"ok": min_ov >= th_overlap,
+                                     "min": round(min_ov, 6),
+                                     "threshold": th_overlap}
+            if min_ov < th_overlap:
+                failures.append(f"pipeline/waves: fold_overlap_ratio "
+                                f"{min_ov:.4f} < {th_overlap}")
+            max_wall = max(r["round_s"] / r["last_arrival_s"]
+                           for r in warm)
+            gates["network_bound_wall_clock"] = {
+                "ok": max_wall <= th_wall, "max_ratio": round(max_wall, 6),
+                "threshold": th_wall}
+            if max_wall > th_wall:
+                failures.append(f"pipeline/waves: round wall clock is "
+                                f"{max_wall:.3f}x pure network time "
+                                f"(> {th_wall}x)")
+        if tname == "replicated":
+            def _bps(member):
+                rows = member["rows"][1:]
+                net = sum(r["last_arrival_s"] for r in rows)
+                return (sum(r["bytes_in"] for r in rows) / net
+                        if net > 0 else 0.0)
+            bps_in, bps_pi = _bps(inline), _bps(piped)
+            ok = bps_in > 0 and bps_pi >= th_wire * bps_in
+            gates["wire_speed"] = {
+                "ok": ok, "inline_bps": round(bps_in, 1),
+                "pipelined_bps": round(bps_pi, 1),
+                "min_ratio": th_wire}
+            if not ok:
+                failures.append(f"pipeline/replicated: pipelined wire "
+                                f"drain {bps_pi:.0f} B/s < {th_wire}x "
+                                f"inline ({bps_in:.0f} B/s)")
+        if tname in ("replicated", "sharded"):
+            sizes = piped["jit_cache_sizes"]
+            arena_keys = sorted(k for k in sizes
+                                if k.startswith("ingest")
+                                and (k.endswith("_arena")
+                                     or k.endswith("_screen")))
+            want = 8 if tname == "sharded" else 2
+            ok = (len(arena_keys) == want
+                  and all(sizes[k] == 1 for k in arena_keys))
+            gates["arena_single_jit_entry"] = {
+                "ok": ok, "entries": {k: sizes.get(k) for k in arena_keys},
+                "expected_keys": want}
+            if not ok:
+                failures.append(f"pipeline/{tname}: arena/screen jits do "
+                                f"not key exactly one ledger entry each "
+                                f"({ {k: sizes.get(k) for k in arena_keys} })")
+
+        ov = [r["fold_overlap_ratio"] for r in warm]
+        print(f"   bit_equal={bit_equal} recompiles={recompiles} "
+              f"overlap={[round(o, 4) for o in ov]}")
+        twins[tname] = {
+            "config": {"rounds": rounds, "args": extra},
+            "inline": inline, "pipelined": piped, "gates": gates}
+    import jax
+    return {"backend": jax.default_backend(), "twins": twins}, failures
+
+
 def disabled_pin_arm():
     """The cost contract's other half, measured in THIS process with
     observability off: the span helpers return the shared null context
@@ -242,6 +413,8 @@ def main(argv=None):
     failures += fails
     if arm is not None:
         arms["disabled_pin"] = arm
+    pipeline, fails = pipeline_twins(args.smoke, workdir)
+    failures += fails
 
     artifact = {
         "bench": "ingest", "version": 1, "smoke": bool(args.smoke),
@@ -249,8 +422,10 @@ def main(argv=None):
                  "records are advisory context; the pinned claims are "
                  "structural (record on every round, >=95%% coverage, 0 "
                  "recompiles after warmup with tracing, zero-allocation "
-                 "disabled mode)"),
+                 "disabled mode) plus the pipeline twins' re-derivable "
+                 "rows (bit-equal finals, fold overlap, wire speed)"),
         "arms": arms,
+        "pipeline": pipeline,
     }
     from fedml_tpu.obs import trend
     failures += [f"schema: {x}"

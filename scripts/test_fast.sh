@@ -48,6 +48,11 @@ grep -q "fused=True" tests/test_shard_spine.py  # fused-finalize parity too
 # plain bit-identity, sharded state round-trip, crash kill->resume with
 # optimizer slots, controller determinism, config-gate matrix
 [ -f tests/test_server_opt.py ]
+# ISSUE 20 zero-copy pipelined ingest: arena fused-screen numeric pin,
+# per-shard order preservation, backpressure dead-letter attribution,
+# pipelined==inline bit-parity (replicated/sharded/secagg), the
+# kill-mid-queue journal composition, and the config-gate matrix
+[ -f tests/test_ingest_pipeline.py ]
 # ISSUE 19 sustained-degradation spine: adaptive deadline determinism,
 # quorum/partition verdict matrix, the payload-only strike invariant,
 # dead-letter attribution, and the resume-path straggler-timer audit

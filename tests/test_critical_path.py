@@ -127,7 +127,11 @@ def test_phase_vocabulary_mapping():
     assert cpath.phase_bucket("decode") == "decode"
     assert cpath.phase_bucket("broadcast_serialize") == "network"
     assert cpath.phase_bucket("admission") == "admission"
-    for name in ("fold", "journal", "unmask", "shard_finalize", "wave"):
+    # a cross-device "wave" span is the server *producing* an upload —
+    # it plays the network's role in the round (the fold either hides
+    # behind it, pipelined, or doesn't), so it buckets as network
+    assert cpath.phase_bucket("wave") == "network"
+    for name in ("fold", "journal", "unmask", "shard_finalize"):
         assert cpath.phase_bucket(name) == "fold"
     cp = _cp()
     cp.note("straggler_wait", 5.0, t1=5.0)
@@ -347,9 +351,32 @@ def _ingest_bench(**over):
            "arms": {"cross_silo": dict(arm), "cross_device": dict(arm),
                     "sharded": dict(arm), "secagg": dict(arm),
                     "disabled_pin": {"backend": "cpu", "gates":
-                                     {"overhead": {"ok": True}}}}}
+                                     {"overhead": {"ok": True}}}},
+           "pipeline": {"twins": {n: _pipeline_twin(n) for n in
+                                  ("waves", "replicated", "sharded")}}}
     obj.update(over)
     return obj
+
+
+def _pipeline_twin(name):
+    """Minimal green `--ingest_pipeline` twin: bit-equal crc sequences,
+    0 recompiles, rows that re-derive the waves overlap/wall-clock and
+    replicated wire-drain gates, one arena+screen ledger entry each."""
+    def _row(r):
+        return {"round": r, "global_crc": 7 + r,
+                "fold_overlap_ratio": 0.995, "last_arrival_s": 0.1,
+                "round_s": 0.1, "bytes_in": 1000, "recompiles": 0}
+    twin = {"gates": {"bit_equal_finals": {"ok": True}},
+            "inline": {"rows": [_row(0), _row(1)]},
+            "pipelined": {"rows": [_row(0), _row(1)]}}
+    if name == "sharded":
+        twin["pipelined"]["jit_cache_sizes"] = {
+            f"ingest_s{s}_{kind}": 1
+            for s in range(4) for kind in ("arena", "screen")}
+    elif name == "replicated":
+        twin["pipelined"]["jit_cache_sizes"] = {"ingest_arena": 1,
+                                                "ingest_screen": 1}
+    return twin
 
 
 def test_validate_ingest_bench_accepts_committed_shape():
@@ -377,6 +404,25 @@ def test_validate_ingest_bench_rejects_failures():
     obj = _ingest_bench()
     obj["arms"]["cross_device"]["recompiles_after_warmup"] = 1
     assert any("recompiles" in p for p in trend.validate_ingest_bench(obj))
+    # the --ingest_pipeline twins are required, and their bit-parity is
+    # re-derived from the crc rows — a green verdict cannot survive
+    # rows that contradict it
+    obj = _ingest_bench()
+    del obj["pipeline"]
+    assert any("pipeline" in p for p in trend.validate_ingest_bench(obj))
+    obj = _ingest_bench()
+    obj["pipeline"]["twins"]["waves"]["pipelined"]["rows"][1][
+        "global_crc"] = 999
+    assert any("bit-parity" in p for p in trend.validate_ingest_bench(obj))
+    obj = _ingest_bench()
+    obj["pipeline"]["twins"]["waves"]["pipelined"]["rows"][1][
+        "fold_overlap_ratio"] = 0.5
+    assert any("fold_overlap" in p
+               for p in trend.validate_ingest_bench(obj))
+    obj = _ingest_bench()
+    obj["pipeline"]["twins"]["replicated"]["pipelined"][
+        "jit_cache_sizes"]["ingest_arena"] = 2
+    assert any("ledger" in p for p in trend.validate_ingest_bench(obj))
 
 
 # ---------------------------------------------------------------------------

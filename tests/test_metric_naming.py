@@ -178,6 +178,14 @@ EXPECTED = {
     "fedml_ingest_fold_overlap_ratio",
     "fedml_ingest_phase_utilization_ratio",
     "fedml_ingest_uploads_total",
+    # PR 20: the zero-copy pipelined receive path (comm/ingest.py):
+    # live per-shard fold-queue depth, frames validated + enqueued by
+    # the transport thread, and frames load-shed when a queue is full
+    # (each shed frame is also dead-lettered under
+    # fedml_comm_dead_letter_total{reason="ingest_overflow"})
+    "fedml_ingest_queue_depth_value",
+    "fedml_ingest_enqueued_total",
+    "fedml_ingest_overflow_total",
     # PR 18: the server-optimizer spine (server_opt/optimizer.py): steps
     # applied, pseudo-gradient/update norms, per-step wall time; and the
     # adaptive round controller (server_opt/controller.py): the live

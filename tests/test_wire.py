@@ -558,3 +558,73 @@ class TestIncrementalStaging:
             assert counters["fedml_wire_fanout_total"] == 8.0
         finally:
             telemetry.disable()
+
+
+class TestZeroCopyDecode:
+    """The decode side never copies: every non-empty array leaf of a
+    decoded frame is a read-only view into the inbound frame bytes.
+    This is what lets the ingest arena gather frame->device with no
+    intermediate host materialization (fedml_tpu/comm/ingest.py)."""
+
+    def test_every_leaf_aliases_the_frame(self):
+        data = _payload_msg(_edge_tree(5)).to_bytes()
+        frame = np.frombuffer(data, np.uint8)
+        out = Message.from_bytes(data)
+        leaves = jax.tree.leaves(out.get(Message.ARG_MODEL_PARAMS))
+        assert leaves
+        for leaf in leaves:
+            if not isinstance(leaf, np.ndarray):
+                continue   # plain scalars/strings ride the JSON header
+            arr = leaf
+            if arr.size == 0:
+                continue   # empty leaves own no bytes to share
+            assert np.shares_memory(arr, frame), arr.dtype
+            assert not arr.flags.writeable
+
+    def test_aliasing_covers_awkward_dtypes_and_shapes(self):
+        """0-d scalars, bools, int8 codes, float16, and leaves encoded
+        from non-contiguous sources all decode as frame views — the
+        encode-side ``ascontiguousarray`` is the only copy."""
+        rng = np.random.RandomState(11)
+        tree = {
+            "zero_d": np.float32(3.25),
+            "flags": np.array([True, False, True]),
+            "codes": rng.randint(-128, 128, (32,)).astype(np.int8),
+            "half": rng.randn(5).astype(np.float16),
+            "noncontig": rng.randn(6, 6).T,
+            "strided": np.arange(20)[::2],
+        }
+        data = _payload_msg(tree).to_bytes()
+        frame = np.frombuffer(data, np.uint8)
+        out = Message.from_bytes(data).get(Message.ARG_MODEL_PARAMS)
+        _assert_tree_equal(out, jax.tree.map(np.asarray, tree))
+        for key, leaf in out.items():
+            assert np.shares_memory(np.asarray(leaf), frame), key
+
+    def test_raw_payload_buffers_alias_the_frame(self):
+        """``raw_payload`` — the arena's staging input — hands back the
+        frame's own buffer views, not copies."""
+        tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4)}
+        data = _payload_msg(tree).to_bytes()
+        frame = np.frombuffer(data, np.uint8)
+        out = Message.from_bytes(data)
+        raw = out.raw_payload(Message.ARG_MODEL_PARAMS)
+        assert raw is not None
+        descr, spec, buffers = raw
+        assert len(descr) == 1
+        view = np.frombuffer(buffers[descr[0]["idx"]], np.float32)
+        assert np.shares_memory(view, frame)
+        np.testing.assert_array_equal(view.reshape(3, 4), tree["w"])
+
+    def test_per_shard_slice_trees_alias_one_frame(self):
+        """A sharded upload is several subtrees in ONE frame; each
+        shard's decoded slices view the same frame bytes, so per-shard
+        staging still costs zero host copies."""
+        rng = np.random.RandomState(13)
+        shards = {f"shard_{s}": {"w": rng.randn(8, 4).astype(np.float32)}
+                  for s in range(3)}
+        data = _payload_msg(shards).to_bytes()
+        frame = np.frombuffer(data, np.uint8)
+        out = Message.from_bytes(data).get(Message.ARG_MODEL_PARAMS)
+        for name, sub in out.items():
+            assert np.shares_memory(np.asarray(sub["w"]), frame), name
